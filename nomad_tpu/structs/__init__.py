@@ -1,0 +1,28 @@
+"""Core domain model for the TPU-native orchestrator.
+
+This package is the rebuild's equivalent of the reference's nomad/structs —
+see SURVEY.md §2.2. Everything schedulable flows through these types.
+"""
+from .consts import *  # noqa: F401,F403
+from .resources import (AllocatedDeviceResource, AllocatedResources,
+                        AllocatedSharedResources, AllocatedTaskResources,
+                        ComparableResources, NetworkResource, NodeDevice,
+                        NodeDeviceResource, NodeReservedResources,
+                        NodeResources, Port, RequestedDevice, Resources)
+from .node import (DrainStrategy, DriverInfo, HostVolumeConfig, Node,
+                   NodeEvent, resolve_node_target, is_unique_key)
+from .job import (Affinity, Artifact, Constraint, DispatchPayloadConfig,
+                  EphemeralDisk, Job, LogConfig, MigrateStrategy,
+                  ParameterizedJobConfig, PeriodicConfig, ReschedulePolicy,
+                  RestartPolicy, Service, ServiceCheck, Spread, SpreadTarget,
+                  Task, TaskGroup, Template, UpdateStrategy, VolumeMount,
+                  VolumeRequest)
+from .alloc import (AllocDeploymentStatus, AllocMetric, Allocation,
+                    DesiredTransition, RescheduleEvent, RescheduleTracker,
+                    TaskEvent, TaskState, alloc_name)
+from .eval_plan import (Deployment, DeploymentState, DeploymentStatusUpdate,
+                        Evaluation, Plan, PlanResult)
+from .funcs import (BINPACK_MAX_FIT_SCORE, allocs_fit, filter_terminal_allocs,
+                    score_fit)
+from .network import NetworkIndex
+from .devices import DeviceAccounter

@@ -1,0 +1,187 @@
+"""Resource-math golden tests mirroring reference funcs.go semantics
+(reference: nomad/structs/funcs_test.go behaviors)."""
+import math
+
+from nomad_tpu import mock, structs
+from nomad_tpu.structs import (AllocatedResources, AllocatedTaskResources,
+                               ComparableResources, NetworkIndex,
+                               NetworkResource, Port, allocs_fit, score_fit)
+
+
+def make_alloc(cpu, mem, ports=(), ip="192.168.0.100"):
+    a = mock.alloc()
+    tr = AllocatedTaskResources(cpu=cpu, memory_mb=mem)
+    if ports:
+        tr.networks = [NetworkResource(
+            device="eth0", ip=ip,
+            reserved_ports=[Port(label=f"p{p}", value=p) for p in ports])]
+    a.allocated_resources = AllocatedResources(tasks={"web": tr})
+    return a
+
+
+def test_allocs_fit_basic():
+    n = mock.node()
+    # node: 4000 cpu / 8192 mem, reserved 100 / 256
+    a1 = make_alloc(1000, 1024)
+    fit, dim, used = allocs_fit(n, [a1])
+    assert fit and dim == ""
+    assert used.cpu == 1100 and used.memory_mb == 1280
+
+
+def test_allocs_fit_exhausted_dimension():
+    n = mock.node()
+    big = make_alloc(5000, 128)
+    fit, dim, _ = allocs_fit(n, [big])
+    assert not fit and dim == "cpu"
+    big = make_alloc(100, 9000)
+    fit, dim, _ = allocs_fit(n, [big])
+    assert not fit and dim == "memory"
+
+
+def test_allocs_fit_terminal_ignored():
+    n = mock.node()
+    a = make_alloc(5000, 9000)
+    a.desired_status = structs.ALLOC_DESIRED_STOP
+    fit, dim, used = allocs_fit(n, [a])
+    assert fit
+    assert used.cpu == 100  # only node reserved
+
+
+def test_allocs_fit_port_collision():
+    n = mock.node()
+    a1 = make_alloc(100, 100, ports=(8080,))
+    a2 = make_alloc(100, 100, ports=(8080,))
+    fit, dim, _ = allocs_fit(n, [a1, a2])
+    assert not fit and dim == "reserved port collision"
+
+
+def test_allocs_fit_node_reserved_port_collision():
+    n = mock.node()  # reserves host port 22 on its own IP
+    a = make_alloc(100, 100, ports=(22,), ip=n.node_resources.networks[0].ip)
+    fit, dim, _ = allocs_fit(n, [a])
+    assert not fit and dim == "reserved port collision"
+
+
+def test_score_fit_endpoints():
+    n = mock.node()
+    n.reserved_resources = structs.NodeReservedResources()
+    # empty node: free=1.0 in both dims -> 20 - 2*10 = 0
+    empty = ComparableResources()
+    assert score_fit(n, empty) == 0.0
+    # perfectly utilized -> 20 - 2*10^0 = 18
+    full = ComparableResources(cpu=4000, memory_mb=8192)
+    assert abs(score_fit(n, full) - 18.0) < 1e-9
+    # half utilized: 20 - 2*10^0.5
+    half = ComparableResources(cpu=2000, memory_mb=4096)
+    expect = 20 - 2 * math.pow(10, 0.5)
+    assert abs(score_fit(n, half) - expect) < 1e-9
+
+
+def test_score_fit_respects_reserved():
+    n = mock.node()  # reserved 100cpu/256mb
+    full = ComparableResources(cpu=3900, memory_mb=7936)
+    assert abs(score_fit(n, full) - 18.0) < 1e-9
+
+
+def test_network_index_assign():
+    n = mock.node()
+    idx = NetworkIndex()
+    assert not idx.set_node(n)
+    ask = NetworkResource(mbits=100, dynamic_ports=[Port(label="http")],
+                          reserved_ports=[Port(label="ssh", value=8022)])
+    offer, err = idx.assign_network(ask, seed=7)
+    assert err == "" and offer is not None
+    assert offer.ip == n.node_resources.networks[0].ip
+    assert offer.dynamic_ports[0].value >= 20000
+    assert offer.reserved_ports[0].value == 8022
+
+
+def test_network_index_bandwidth_overcommit():
+    n = mock.node()  # 1000 mbits
+    idx = NetworkIndex()
+    idx.set_node(n)
+    ask = NetworkResource(mbits=1500)
+    offer, err = idx.assign_network(ask)
+    assert offer is None and err == "bandwidth exceeded"
+
+
+def test_computed_class_stability_and_uniqueness():
+    n1 = mock.node()
+    n2 = mock.node()
+    # ids/names differ but class-relevant identity matches
+    assert n1.computed_class == n2.computed_class
+    n3 = mock.node()
+    n3.attributes["arch"] = "arm64"
+    n3.compute_class()
+    assert n3.computed_class != n1.computed_class
+    # unique.* keys are excluded from hashing
+    n4 = mock.node()
+    n4.attributes["unique.hostname"] = "different"
+    n4.compute_class()
+    assert n4.computed_class == n1.computed_class
+
+
+def test_alloc_name_index():
+    a = mock.alloc()
+    a.name = "job.web[3]"
+    assert a.index() == 3
+
+
+def _simulate_delays(policy, n, now=1000.0):
+    """Walk the delay series the way the broker would: each reschedule event
+    records the delay that was applied (reference NextDelay reads history)."""
+    a = mock.alloc()
+    a.reschedule_tracker = structs.RescheduleTracker()
+    out = []
+    t = now
+    for _ in range(n):
+        d = a.next_delay(policy)
+        out.append(d)
+        a.reschedule_tracker.events.append(
+            structs.RescheduleEvent(reschedule_time=t, delay_s=d))
+        t += d
+        a.modify_time = t  # last event time tracks the failure time
+    return out
+
+
+def test_reschedule_next_delay_exponential():
+    pol = structs.ReschedulePolicy(delay_s=5, delay_function="exponential",
+                                   max_delay_s=100, unlimited=True)
+    assert _simulate_delays(pol, 7) == [5, 10, 20, 40, 80, 100, 100]
+
+
+def test_reschedule_next_delay_fibonacci():
+    pol = structs.ReschedulePolicy(delay_s=5, delay_function="fibonacci",
+                                   max_delay_s=1000, unlimited=True)
+    assert _simulate_delays(pol, 6) == [5, 5, 10, 15, 25, 40]
+
+
+def test_reschedule_fibonacci_ceiling_reset():
+    # two consecutive events at max_delay hold at max (reference ceiling reset)
+    a = mock.alloc()
+    pol = structs.ReschedulePolicy(delay_s=5, delay_function="fibonacci",
+                                   max_delay_s=50, unlimited=True)
+    a.reschedule_tracker = structs.RescheduleTracker(events=[
+        structs.RescheduleEvent(reschedule_time=100, delay_s=50),
+        structs.RescheduleEvent(reschedule_time=150, delay_s=50)])
+    a.modify_time = 160
+    assert a.next_delay(pol) == 50
+
+
+def test_reschedule_preempted_alloc_not_rescheduled():
+    a = mock.alloc()
+    a.desired_status = "evict"
+    a.client_status = structs.ALLOC_CLIENT_FAILED
+    pol = structs.ReschedulePolicy(unlimited=True)
+    assert not a.should_reschedule(pol, 100.0, 100.0)
+
+
+def test_device_accounter():
+    n = mock.gpu_node(n_gpus=2)
+    acct = structs.DeviceAccounter(n)
+    free = acct.free_instances("nvidia", "gpu", "1080ti")
+    assert len(free) == 2
+    assert not acct.add_reserved("nvidia", "gpu", "1080ti", [free[0]])
+    assert len(acct.free_instances("nvidia", "gpu", "1080ti")) == 1
+    # double-claim collides
+    assert acct.add_reserved("nvidia", "gpu", "1080ti", [free[0]])
