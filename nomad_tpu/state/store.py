@@ -1,0 +1,626 @@
+"""In-memory replicated state store with snapshots and blocking watches.
+
+Reference: nomad/state/state_store.go (go-memdb MVCC tables) + schema.go.
+Rebuild notes: instead of radix-tree MVCC we keep plain dict tables plus
+secondary indexes, and give schedulers immutable *snapshots* (shallow table
+copies). Entries are treated as immutable once inserted — writers replace
+objects, never mutate in place — which is what makes the shallow snapshot
+sound (same discipline the reference enforces via memdb).
+
+Every write carries a raft-style log index; per-table indexes power blocking
+queries (reference: rpc.go blocking-query min-index machinery).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..structs import (ALLOC_CLIENT_LOST, ALLOC_DESIRED_STOP, Allocation,
+                       Deployment, Evaluation, Job, JOB_STATUS_DEAD,
+                       JOB_STATUS_PENDING, JOB_STATUS_RUNNING, Node,
+                       NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE, Plan,
+                       PlanResult)
+from ..structs.consts import (EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
+                              EVAL_STATUS_PENDING, JOB_TYPE_SYSTEM)
+
+TABLES = ("nodes", "jobs", "job_versions", "job_summaries", "evals", "allocs",
+          "deployments", "periodic_launches", "scheduler_config", "indexes",
+          "acl_policies", "acl_tokens", "scaling_policies", "scaling_events",
+          "vault_accessors", "csi_volumes", "csi_plugins", "cluster_meta")
+
+
+class JobSummary:
+    """Per-task-group alloc status counts (reference: structs.JobSummary)."""
+
+    def __init__(self, job_id: str, namespace: str):
+        self.job_id = job_id
+        self.namespace = namespace
+        # tg -> {"queued":n,"complete":n,"failed":n,"running":n,"starting":n,"lost":n}
+        self.summary: Dict[str, Dict[str, int]] = {}
+        self.children_pending = 0
+        self.children_running = 0
+        self.children_dead = 0
+        self.create_index = 0
+        self.modify_index = 0
+
+    def copy(self) -> "JobSummary":
+        s = JobSummary(self.job_id, self.namespace)
+        s.summary = {k: dict(v) for k, v in self.summary.items()}
+        s.children_pending = self.children_pending
+        s.children_running = self.children_running
+        s.children_dead = self.children_dead
+        s.create_index = self.create_index
+        s.modify_index = self.modify_index
+        return s
+
+
+class SchedulerConfiguration:
+    """Runtime-tunable knobs (reference: structs.SchedulerConfiguration).
+
+    `solver_backend` is the switch SURVEY §5.6 calls out: "host" runs the
+    scalar reference-semantics path, "tpu" the batched JAX solve.
+    """
+
+    def __init__(self, preemption_system=True, preemption_service=False,
+                 preemption_batch=False, solver_backend="tpu"):
+        self.preemption_system_enabled = preemption_system
+        self.preemption_service_enabled = preemption_service
+        self.preemption_batch_enabled = preemption_batch
+        self.solver_backend = solver_backend
+        self.create_index = 0
+        self.modify_index = 0
+
+
+class StateSnapshot:
+    """Immutable point-in-time view handed to schedulers.
+
+    Exposes the same read API as the live store (reference:
+    scheduler.State interface, scheduler/scheduler.go:65).
+    """
+
+    def __init__(self, tables: Dict[str, dict], indexes: Dict[str, int],
+                 index: int):
+        self._t = tables
+        self._ix = dict(indexes)
+        self.index = index
+
+    # -- nodes --
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._t["nodes"].get(node_id)
+
+    def nodes(self) -> Iterable[Node]:
+        return self._t["nodes"].values()
+
+    def ready_nodes_in_dcs(self, datacenters: List[str]
+                           ) -> Tuple[List[Node], Dict[str, int]]:
+        """Reference: scheduler/util.go:233 readyNodesInDCs."""
+        dcs = set(datacenters)
+        out, by_dc = [], {}
+        for n in self._t["nodes"].values():
+            if not n.ready():
+                continue
+            if n.datacenter not in dcs and "*" not in dcs:
+                continue
+            out.append(n)
+            by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
+        return out, by_dc
+
+    # -- jobs --
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._t["jobs"].get((namespace, job_id))
+
+    def jobs(self) -> Iterable[Job]:
+        return self._t["jobs"].values()
+
+    def jobs_by_namespace(self, namespace: str) -> List[Job]:
+        return [j for (ns, _), j in self._t["jobs"].items() if ns == namespace]
+
+    def job_versions(self, namespace: str, job_id: str) -> List[Job]:
+        return list(self._t["job_versions"].get((namespace, job_id), ()))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str,
+                              version: int) -> Optional[Job]:
+        for j in self._t["job_versions"].get((namespace, job_id), ()):
+            if j.version == version:
+                return j
+        return None
+
+    def job_summary(self, namespace: str, job_id: str) -> Optional[JobSummary]:
+        return self._t["job_summaries"].get((namespace, job_id))
+
+    # -- evals --
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._t["evals"].get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [e for e in self._t["evals"].values()
+                if e.job_id == job_id and e.namespace == namespace]
+
+    def evals(self) -> Iterable[Evaluation]:
+        return self._t["evals"].values()
+
+    # -- allocs --
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._t["allocs"].get(alloc_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._t.get("_allocs_by_node", {}).get(node_id, ())
+        return [self._t["allocs"][i] for i in ids if i in self._t["allocs"]]
+
+    def allocs_by_node_terminal(self, node_id: str,
+                                terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      anyCreateIndex: bool = True) -> List[Allocation]:
+        ids = self._t.get("_allocs_by_job", {}).get((namespace, job_id), ())
+        return [self._t["allocs"][i] for i in ids if i in self._t["allocs"]]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return [a for a in self._t["allocs"].values() if a.eval_id == eval_id]
+
+    def allocs(self) -> Iterable[Allocation]:
+        return self._t["allocs"].values()
+
+    # -- deployments --
+    def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
+        return self._t["deployments"].get(dep_id)
+
+    def deployments_by_job(self, namespace: str, job_id: str) -> List[Deployment]:
+        return [d for d in self._t["deployments"].values()
+                if d.job_id == job_id and d.namespace == namespace]
+
+    def latest_deployment_by_job(self, namespace: str,
+                                 job_id: str) -> Optional[Deployment]:
+        deps = self.deployments_by_job(namespace, job_id)
+        if not deps:
+            return None
+        return max(deps, key=lambda d: d.create_index)
+
+    # -- config / meta --
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._t["scheduler_config"].get("config") or SchedulerConfiguration()
+
+    def table_index(self, table: str) -> int:
+        return self._ix.get(table, 0)
+
+
+class StateStore(StateSnapshot):
+    """The live, writable store. Reads are inherited from StateSnapshot."""
+
+    def __init__(self) -> None:
+        tables: Dict[str, dict] = {name: {} for name in TABLES}
+        tables["_allocs_by_node"] = {}
+        tables["_allocs_by_job"] = {}
+        super().__init__(tables, {}, 0)
+        self._lock = threading.RLock()
+        self._watch = threading.Condition(self._lock)
+
+    # -- snapshot & watch --
+    def snapshot(self) -> StateSnapshot:
+        with self._lock:
+            copied = {}
+            for name, table in self._t.items():
+                if name in ("_allocs_by_node", "_allocs_by_job"):
+                    copied[name] = {k: set(v) for k, v in table.items()}
+                else:
+                    copied[name] = dict(table)
+            return StateSnapshot(copied, self._ix, self.index)
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self.index
+
+    def wait_for_index(self, index: int, timeout: float = 5.0) -> int:
+        """Block until the store reaches `index` (reference: worker.go:228
+        snapshotMinIndex). Returns the current index."""
+        deadline = None
+        with self._watch:
+            while self.index < index:
+                import time
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                self._watch.wait(remain)
+            return self.index
+
+    def wait_for_change(self, min_index: int, timeout: float) -> int:
+        """Blocking-query primitive: wait until store index > min_index."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._watch:
+            while self.index <= min_index:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                self._watch.wait(remain)
+            return self.index
+
+    def _bump(self, table: str, index: int) -> None:
+        self.index = max(self.index, index)
+        self._ix[table] = max(self._ix.get(table, 0), index)
+        self._watch.notify_all()
+
+    # -- nodes --
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            existing = self._t["nodes"].get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = index
+            node.modify_index = index
+            if not node.computed_class:
+                node.compute_class()
+            self._t["nodes"][node.id] = node
+            self._bump("nodes", index)
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            self._t["nodes"].pop(node_id, None)
+            self._bump("nodes", index)
+
+    def update_node_status(self, index: int, node_id: str, status: str,
+                           updated_at: float = 0.0) -> None:
+        with self._lock:
+            n = self._t["nodes"].get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            n2 = _copy.copy(n)
+            n2.status = status
+            n2.status_updated_at = updated_at
+            n2.modify_index = index
+            self._t["nodes"][node_id] = n2
+            self._bump("nodes", index)
+
+    def update_node_eligibility(self, index: int, node_id: str,
+                                eligibility: str) -> None:
+        with self._lock:
+            n = self._t["nodes"].get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            n2 = _copy.copy(n)
+            n2.scheduling_eligibility = eligibility
+            n2.modify_index = index
+            self._t["nodes"][node_id] = n2
+            self._bump("nodes", index)
+
+    def update_node_drain(self, index: int, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> None:
+        with self._lock:
+            n = self._t["nodes"].get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy as _copy
+            n2 = _copy.copy(n)
+            n2.drain_strategy = drain_strategy
+            n2.drain = drain_strategy is not None
+            if drain_strategy is not None:
+                n2.scheduling_eligibility = NODE_SCHED_INELIGIBLE
+            elif mark_eligible:
+                n2.scheduling_eligibility = NODE_SCHED_ELIGIBLE
+            n2.modify_index = index
+            self._t["nodes"][node_id] = n2
+            self._bump("nodes", index)
+
+    # -- jobs --
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            key = (job.namespace, job.id)
+            existing = self._t["jobs"].get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.job_modify_index = index
+                if self._job_spec_changed(existing, job):
+                    job.version = existing.version + 1
+                else:
+                    job.version = existing.version
+            else:
+                job.create_index = index
+                job.job_modify_index = index
+                job.version = 0
+            job.modify_index = index
+            self._t["jobs"][key] = job
+            versions = list(self._t["job_versions"].get(key, ()))
+            if not versions or versions[0].version != job.version:
+                versions.insert(0, job)
+                from ..structs.consts import MAX_RETAINED_JOB_VERSIONS
+                del versions[MAX_RETAINED_JOB_VERSIONS:]
+            else:
+                versions[0] = job
+            self._t["job_versions"][key] = versions
+            self._ensure_summary(index, job)
+            self._bump("jobs", index)
+
+    @staticmethod
+    def _job_spec_changed(old: Job, new: Job) -> bool:
+        """Did the user-facing spec change? (reference: Job.SpecChanged)"""
+        import copy as _copy
+        a, b = _copy.copy(old), _copy.copy(new)
+        for j in (a, b):
+            j.version = 0
+            j.status = ""
+            j.status_description = ""
+            j.stable = False
+            j.create_index = j.modify_index = j.job_modify_index = 0
+            j.submit_time = 0.0
+        return a != b
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            self._t["jobs"].pop(key, None)
+            self._t["job_versions"].pop(key, None)
+            self._t["job_summaries"].pop(key, None)
+            self._t["periodic_launches"].pop(key, None)
+            self._bump("jobs", index)
+
+    def update_job_stability(self, index: int, namespace: str, job_id: str,
+                             version: int, stable: bool) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            for tbl in ("jobs",):
+                j = self._t[tbl].get(key)
+                if j is not None and j.version == version:
+                    import copy as _copy
+                    j2 = _copy.copy(j)
+                    j2.stable = stable
+                    j2.modify_index = index
+                    self._t[tbl][key] = j2
+            versions = list(self._t["job_versions"].get(key, ()))
+            for i, jv in enumerate(versions):
+                if jv.version == version:
+                    import copy as _copy
+                    j2 = _copy.copy(jv)
+                    j2.stable = stable
+                    versions[i] = j2
+            self._t["job_versions"][key] = versions
+            self._bump("jobs", index)
+
+    def _ensure_summary(self, index: int, job: Job) -> None:
+        key = (job.namespace, job.id)
+        summary = self._t["job_summaries"].get(key)
+        if summary is None:
+            summary = JobSummary(job.id, job.namespace)
+            summary.create_index = index
+        else:
+            summary = summary.copy()
+        for tg in job.task_groups:
+            summary.summary.setdefault(tg.name, {
+                "queued": 0, "complete": 0, "failed": 0,
+                "running": 0, "starting": 0, "lost": 0})
+        summary.modify_index = index
+        self._t["job_summaries"][key] = summary
+
+    def update_job_summary_queued(self, index: int, namespace: str,
+                                  job_id: str, queued: Dict[str, int]) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            summary = self._t["job_summaries"].get(key)
+            if summary is None:
+                return
+            summary = summary.copy()
+            for tg, n in queued.items():
+                summary.summary.setdefault(tg, {
+                    "queued": 0, "complete": 0, "failed": 0,
+                    "running": 0, "starting": 0, "lost": 0})["queued"] = n
+            summary.modify_index = index
+            self._t["job_summaries"][key] = summary
+            self._bump("job_summaries", index)
+
+    # -- evals --
+    def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
+        with self._lock:
+            for e in evals:
+                existing = self._t["evals"].get(e.id)
+                if existing is not None:
+                    e.create_index = existing.create_index
+                else:
+                    e.create_index = index
+                e.modify_index = index
+                self._t["evals"][e.id] = e
+                self._refresh_job_status(index, e.namespace, e.job_id)
+            self._bump("evals", index)
+
+    def delete_eval(self, index: int, eval_ids: List[str],
+                    alloc_ids: List[str] = ()) -> None:
+        with self._lock:
+            for eid in eval_ids:
+                self._t["evals"].pop(eid, None)
+            for aid in alloc_ids:
+                self._remove_alloc(aid)
+            self._bump("evals", index)
+            if alloc_ids:
+                self._bump("allocs", index)
+
+    def _refresh_job_status(self, index: int, namespace: str,
+                            job_id: str) -> None:
+        """Keep Job.status in sync as evals/allocs flow (simplified
+        reference: state_store.go setJobStatus/getJobStatus — called from
+        eval upserts, plan application and client alloc updates)."""
+        key = (namespace, job_id)
+        job = self._t["jobs"].get(key)
+        if job is None:
+            return
+        has_live_alloc = any(
+            not self._t["allocs"][a].terminal_status()
+            for a in self._t["_allocs_by_job"].get(key, ())
+            if a in self._t["allocs"])
+        has_open_eval = any(
+            e.job_id == job_id and e.namespace == namespace
+            and e.status in (EVAL_STATUS_PENDING, EVAL_STATUS_BLOCKED)
+            for e in self._t["evals"].values())
+        new_status = JOB_STATUS_DEAD
+        if job.stopped():
+            new_status = JOB_STATUS_DEAD
+        elif has_live_alloc:
+            new_status = JOB_STATUS_RUNNING
+        elif has_open_eval or job.is_periodic() or job.is_parameterized():
+            new_status = JOB_STATUS_PENDING
+        if new_status != job.status:
+            import copy as _copy
+            j2 = _copy.copy(job)
+            j2.status = new_status
+            j2.modify_index = index
+            self._t["jobs"][key] = j2
+
+    # -- allocs --
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        with self._lock:
+            for a in allocs:
+                self._upsert_alloc_locked(index, a)
+            for key in {(a.namespace, a.job_id) for a in allocs}:
+                self._refresh_job_status(index, *key)
+            self._bump("allocs", index)
+
+    def _upsert_alloc_locked(self, index: int, a: Allocation) -> None:
+        existing = self._t["allocs"].get(a.id)
+        if existing is not None:
+            a.create_index = existing.create_index
+            # server-side upserts keep client-reported state unless newer
+            if not a.task_states and existing.task_states:
+                a.task_states = existing.task_states
+            if a.client_status == "" and existing.client_status:
+                a.client_status = existing.client_status
+        else:
+            a.create_index = index
+        a.modify_index = index
+        self._t["allocs"][a.id] = a
+        self._t["_allocs_by_node"].setdefault(a.node_id, set()).add(a.id)
+        self._t["_allocs_by_job"].setdefault(
+            (a.namespace, a.job_id), set()).add(a.id)
+
+    def _remove_alloc(self, alloc_id: str) -> None:
+        a = self._t["allocs"].pop(alloc_id, None)
+        if a is None:
+            return
+        s = self._t["_allocs_by_node"].get(a.node_id)
+        if s:
+            s.discard(alloc_id)
+        s = self._t["_allocs_by_job"].get((a.namespace, a.job_id))
+        if s:
+            s.discard(alloc_id)
+
+    def update_allocs_from_client(self, index: int,
+                                  updates: List[Allocation]) -> None:
+        """Apply client status updates (reference: fsm.go:749
+        applyAllocClientUpdate — merges client fields into stored alloc)."""
+        with self._lock:
+            for upd in updates:
+                existing = self._t["allocs"].get(upd.id)
+                if existing is None:
+                    continue
+                import copy as _copy
+                a = _copy.copy(existing)
+                a.client_status = upd.client_status
+                a.client_description = upd.client_description
+                a.task_states = dict(upd.task_states)
+                a.deployment_status = upd.deployment_status
+                a.modify_index = index
+                a.modify_time = upd.modify_time or a.modify_time
+                self._t["allocs"][a.id] = a
+            for key in {(u.namespace, u.job_id) for u in updates}:
+                self._refresh_job_status(index, *key)
+            self._bump("allocs", index)
+
+    def update_alloc_desired_transition(self, index: int, alloc_ids: List[str],
+                                        transition) -> None:
+        with self._lock:
+            for aid in alloc_ids:
+                existing = self._t["allocs"].get(aid)
+                if existing is None:
+                    continue
+                import copy as _copy
+                a = _copy.copy(existing)
+                a.desired_transition = transition
+                a.modify_index = index
+                self._t["allocs"][aid] = a
+            self._bump("allocs", index)
+
+    # -- plan results (the single commit path; reference fsm.go:918) --
+    def upsert_plan_results(self, index: int, result: PlanResult,
+                            job: Optional[Job] = None) -> None:
+        with self._lock:
+            for allocs in result.node_update.values():
+                for a in allocs:
+                    existing = self._t["allocs"].get(a.id)
+                    if existing is not None and a.job is None:
+                        a.job = existing.job
+                    self._upsert_alloc_locked(index, a)
+            for allocs in result.node_allocation.values():
+                for a in allocs:
+                    if a.job is None:
+                        a.job = job
+                    self._upsert_alloc_locked(index, a)
+            for allocs in result.node_preemptions.values():
+                for a in allocs:
+                    existing = self._t["allocs"].get(a.id)
+                    if existing is not None and a.job is None:
+                        a.job = existing.job
+                    self._upsert_alloc_locked(index, a)
+            if result.deployment is not None:
+                self._upsert_deployment_locked(index, result.deployment)
+            for du in result.deployment_updates:
+                self._apply_deployment_update_locked(index, du)
+            touched = set()
+            for m in (result.node_update, result.node_allocation,
+                      result.node_preemptions):
+                for allocs in m.values():
+                    touched.update((a.namespace, a.job_id) for a in allocs)
+            for key in touched:
+                self._refresh_job_status(index, *key)
+            self._bump("allocs", index)
+
+    # -- deployments --
+    def upsert_deployment(self, index: int, dep: Deployment) -> None:
+        with self._lock:
+            self._upsert_deployment_locked(index, dep)
+            self._bump("deployments", index)
+
+    def _upsert_deployment_locked(self, index: int, dep: Deployment) -> None:
+        existing = self._t["deployments"].get(dep.id)
+        if existing is not None:
+            dep.create_index = existing.create_index
+        else:
+            dep.create_index = index
+        dep.modify_index = index
+        self._t["deployments"][dep.id] = dep
+
+    def _apply_deployment_update_locked(self, index: int, du) -> None:
+        dep = self._t["deployments"].get(du.deployment_id)
+        if dep is None:
+            return
+        d2 = dep.copy()
+        d2.status = du.status
+        d2.status_description = du.status_description
+        d2.modify_index = index
+        self._t["deployments"][du.deployment_id] = d2
+
+    def delete_deployment(self, index: int, dep_ids: List[str]) -> None:
+        with self._lock:
+            for did in dep_ids:
+                self._t["deployments"].pop(did, None)
+            self._bump("deployments", index)
+
+    # -- scheduler config --
+    def set_scheduler_config(self, index: int,
+                             cfg: SchedulerConfiguration) -> None:
+        with self._lock:
+            cfg.modify_index = index
+            self._t["scheduler_config"]["config"] = cfg
+            self._bump("scheduler_config", index)
+
+    # -- periodic launches --
+    def upsert_periodic_launch(self, index: int, namespace: str, job_id: str,
+                               launch_time: float) -> None:
+        with self._lock:
+            self._t["periodic_launches"][(namespace, job_id)] = launch_time
+            self._bump("periodic_launches", index)
+
+    def periodic_launch(self, namespace: str, job_id: str) -> Optional[float]:
+        return self._t["periodic_launches"].get((namespace, job_id))

@@ -211,17 +211,22 @@ class Allocation:
 
     # -- rescheduling (reference: Allocation.ShouldReschedule / NextRescheduleTime) --
     def should_reschedule(self, policy: Optional[ReschedulePolicy],
-                          fail_time: float, now: float) -> bool:
+                          fail_time: float) -> bool:
         if self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
             return False
         if self.client_status != ALLOC_CLIENT_FAILED:
             return False
+        return self.reschedule_eligible(policy, fail_time)
+
+    def reschedule_eligible(self, policy: Optional[ReschedulePolicy],
+                            fail_time: float) -> bool:
+        """Reference: Allocation.RescheduleEligible."""
         if policy is None:
+            return False
+        if not (policy.attempts > 0 or policy.unlimited):
             return False
         if policy.unlimited:
             return True
-        if policy.attempts <= 0:
-            return False
         attempted = self.reschedule_attempts_in_interval(policy, fail_time)
         return attempted < policy.attempts
 
@@ -249,7 +254,8 @@ class Allocation:
         elif fn == RESCHEDULE_DELAY_FIBONACCI:
             if len(events) >= 2:
                 d1, d2 = events[-1].delay_s, events[-2].delay_s
-                if policy.max_delay_s and d1 == policy.max_delay_s == d2:
+                # ceiling reset: series restarted at base after hitting max
+                if d2 == policy.max_delay_s and d1 == policy.delay_s:
                     delay = d1
                 else:
                     delay = d1 + d2
@@ -264,16 +270,24 @@ class Allocation:
         return delay
 
     def next_reschedule_time(self, policy: Optional[ReschedulePolicy]):
-        """Returns (eligible_time, True) when a delayed reschedule applies."""
-        if policy is None or self.client_status != ALLOC_CLIENT_FAILED:
+        """Returns (eligible_time, eligible) for a delayed reschedule
+        (reference: Allocation.NextRescheduleTime)."""
+        if (policy is None
+                or self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT)
+                or self.client_status != ALLOC_CLIENT_FAILED):
             return 0.0, False
         fail_time = self.last_event_time()
         if fail_time <= 0:
             return 0.0, False
-        if not (policy.unlimited or (policy.attempts > 0 and
-                self.reschedule_attempts_in_interval(policy, fail_time) < policy.attempts)):
-            return 0.0, False
-        return fail_time + self.next_delay(policy), True
+        next_delay = self.next_delay(policy)
+        eligible = policy.unlimited or (policy.attempts > 0
+                                        and self.reschedule_tracker is None)
+        if (policy.attempts > 0 and self.reschedule_tracker
+                and self.reschedule_tracker.events):
+            attempted = self.reschedule_attempts_in_interval(policy, fail_time)
+            eligible = (attempted < policy.attempts
+                        and next_delay < policy.interval_s)
+        return fail_time + next_delay, eligible
 
     def last_event_time(self) -> float:
         last = 0.0

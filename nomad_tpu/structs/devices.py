@@ -47,14 +47,15 @@ class DeviceAccounter:
 
     def add_reserved(self, vendor: str, typ: str, name: str,
                      device_ids: List[str]) -> bool:
-        key = (vendor, typ, name)
-        acct = self.devices.get(key)
+        """Mark instance ids used; True only on genuine double-claims.
+        Unknown device groups / stale instance ids are skipped (reference
+        devices.go AddReserved tolerates re-fingerprinted inventory)."""
+        acct = self.devices.get((vendor, typ, name))
         if acct is None:
-            return True
+            return False
         collision = False
         for inst_id in device_ids:
             if inst_id not in acct.instances:
-                collision = True
                 continue
             acct.instances[inst_id] += 1
             if acct.instances[inst_id] > 1:

@@ -39,9 +39,9 @@ class NetworkIndex:
                 self.avail_bandwidth[n.device] = max(
                     self.avail_bandwidth.get(n.device, 0), n.mbits)
         reserved = node.reserved_resources.parsed_ports()
-        for n in self.avail_networks:
+        for ip in {n.ip for n in self.avail_networks}:
             for port in reserved:
-                if not self._add_used_port(n.ip, port):
+                if not self._add_used_port(ip, port):
                     collide = True
         return collide
 

@@ -156,8 +156,8 @@ def test_reschedule_next_delay_fibonacci():
     assert _simulate_delays(pol, 6) == [5, 5, 10, 15, 25, 40]
 
 
-def test_reschedule_fibonacci_ceiling_reset():
-    # two consecutive events at max_delay hold at max (reference ceiling reset)
+def test_reschedule_fibonacci_ceiling_clamp():
+    # two consecutive events at max_delay clamp at max while failing promptly
     a = mock.alloc()
     pol = structs.ReschedulePolicy(delay_s=5, delay_function="fibonacci",
                                    max_delay_s=50, unlimited=True)
@@ -173,7 +173,54 @@ def test_reschedule_preempted_alloc_not_rescheduled():
     a.desired_status = "evict"
     a.client_status = structs.ALLOC_CLIENT_FAILED
     pol = structs.ReschedulePolicy(unlimited=True)
-    assert not a.should_reschedule(pol, 100.0, 100.0)
+    assert not a.should_reschedule(pol, 100.0)
+
+
+def test_reschedule_fibonacci_series_restart_after_ceiling():
+    # series that reset at ceiling: [..., max, base] -> next is base again
+    a = mock.alloc()
+    pol = structs.ReschedulePolicy(delay_s=5, delay_function="fibonacci",
+                                   max_delay_s=50, unlimited=True)
+    a.reschedule_tracker = structs.RescheduleTracker(events=[
+        structs.RescheduleEvent(reschedule_time=100, delay_s=50),
+        structs.RescheduleEvent(reschedule_time=150, delay_s=5)])
+    a.modify_time = 156
+    assert a.next_delay(pol) == 5
+
+
+def test_reschedule_quiet_period_resets_to_base():
+    # clamp hit but alloc was quiet longer than the max delay -> base
+    a = mock.alloc()
+    pol = structs.ReschedulePolicy(delay_s=5, delay_function="exponential",
+                                   max_delay_s=50, unlimited=True)
+    a.reschedule_tracker = structs.RescheduleTracker(events=[
+        structs.RescheduleEvent(reschedule_time=100, delay_s=50)])
+    a.modify_time = 1000  # quiet for 900s > 50s
+    assert a.next_delay(pol) == 5
+
+
+def test_next_reschedule_time_guards():
+    a = mock.alloc()
+    a.client_status = structs.ALLOC_CLIENT_FAILED
+    a.modify_time = 500.0
+    pol = structs.ReschedulePolicy(delay_s=30, delay_function="constant",
+                                   unlimited=True)
+    t, ok = a.next_reschedule_time(pol)
+    assert ok and t == 530.0
+    # stopped alloc is never eligible
+    a.desired_status = structs.ALLOC_DESIRED_STOP
+    assert a.next_reschedule_time(pol) == (0.0, False)
+    # attempts-limited: delay grown past interval -> ineligible
+    b = mock.alloc()
+    b.client_status = structs.ALLOC_CLIENT_FAILED
+    b.modify_time = 500.0
+    lim = structs.ReschedulePolicy(delay_s=400, delay_function="exponential",
+                                   interval_s=600, attempts=5, max_delay_s=0,
+                                   unlimited=False)
+    b.reschedule_tracker = structs.RescheduleTracker(events=[
+        structs.RescheduleEvent(reschedule_time=499, delay_s=400)])
+    t, ok = b.next_reschedule_time(lim)
+    assert not ok  # next delay 800 >= interval 600
 
 
 def test_device_accounter():
@@ -185,3 +232,12 @@ def test_device_accounter():
     assert len(acct.free_instances("nvidia", "gpu", "1080ti")) == 1
     # double-claim collides
     assert acct.add_reserved("nvidia", "gpu", "1080ti", [free[0]])
+
+
+def test_set_node_two_networks_same_ip_no_false_collision():
+    n = mock.node()
+    ip = n.node_resources.networks[0].ip
+    n.node_resources.networks.append(
+        NetworkResource(device="eth0", cidr="10.0.0.0/8", ip=ip, mbits=1000))
+    idx = NetworkIndex()
+    assert not idx.set_node(n)  # reserved port 22 added once per unique IP
