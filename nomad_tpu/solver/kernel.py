@@ -1,0 +1,217 @@
+"""The jitted placement solve.
+
+Replaces the reference's per-placement iterator chain
+(scheduler/stack.go:107 Select -> feasible.go checks -> rank.go scoring ->
+select.go limit/max) with dense tensor math over the full node axis:
+
+  static feasibility mask  [G, N]   (constraints, dc, host-evaluated ops)
+  `lax.scan` over placements: fit-check + score + masked top-k + commit
+
+The scan is the equivalent of the reference's in-plan visibility
+(scheduler/context.go:120 ProposedAllocs): each placement sees all resources
+committed by earlier placements in the batch. Scores follow the reference's
+conditional-append-then-average normalization (rank.go:667).
+
+Where the reference subsamples nodes (limit = max(2, log2 N),
+scheduler/stack.go:80-87), this solve scores every node — strictly better
+placements at far higher eval throughput.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT, OP_NE,
+                        OP_NONE, OP_NOT_SET, R_CPU, R_MEM)
+
+TOP_K = 4
+NEG_INF = -1e30
+
+
+def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
+             ) -> jnp.ndarray:
+    """Evaluate vectorizable constraint ops.
+
+    vals: [N, C] node value ranks (-1 missing); op/rank: [C].
+    Semantics mirror scheduler/feasible.go:671 checkConstraint — note `!=`
+    passes when the attribute is missing.
+    """
+    found = vals >= 0
+    eq = found & (vals == rank[None, :])
+    res = jnp.ones_like(found)
+    res = jnp.where(op[None, :] == OP_EQ, eq, res)
+    res = jnp.where(op[None, :] == OP_NE, ~eq, res)
+    res = jnp.where(op[None, :] == OP_LT, found & (vals < rank[None, :]), res)
+    res = jnp.where(op[None, :] == OP_LE, found & (vals <= rank[None, :]), res)
+    res = jnp.where(op[None, :] == OP_GT, found & (vals > rank[None, :]), res)
+    res = jnp.where(op[None, :] == OP_GE, found & (vals >= rank[None, :]), res)
+    res = jnp.where(op[None, :] == OP_IS_SET, found, res)
+    res = jnp.where(op[None, :] == OP_NOT_SET, ~found, res)
+    return res
+
+
+class SolveResult(NamedTuple):
+    choice: jnp.ndarray        # [K, TOP_K] node indices, best first
+    choice_ok: jnp.ndarray     # [K, TOP_K] bool (feasible + fits)
+    score: jnp.ndarray         # [K, TOP_K] final normalized scores
+    n_feasible: jnp.ndarray    # [K] feasible node count at step
+    n_exhausted: jnp.ndarray   # [K] feasible but resource-exhausted
+    dim_exhausted: jnp.ndarray  # [K, R] counts per exhausted dimension
+    feas: jnp.ndarray          # [G, N] static feasibility mask
+    cons_filtered: jnp.ndarray  # [G, C] nodes filtered per constraint slot
+    used_final: jnp.ndarray    # [N, R] resource usage after all commits
+
+
+@functools.partial(jax.jit, static_argnames=())
+def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
+                 ask_res, ask_desired, dc_ok, host_ok, coll0, penalty,
+                 c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
+                 sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
+                 sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place
+                 ) -> SolveResult:
+    Np = avail.shape[0]
+    Gp = ask_res.shape[0]
+    C = c_op.shape[1]
+    K = p_ask.shape[0]
+
+    # ---------- static feasibility [Gp, Np] ----------
+    def per_ask_feas(g):
+        vals = attr_rank[:, c_col[g]]                      # [Np, C]
+        ok = _op_eval(vals, c_op[g], c_rank[g])            # [Np, C]
+        base = valid & dc_ok[g][node_dc] & host_ok[g]      # [Np]
+        # per-constraint filtered counts with sequential (first-fail) credit
+        passed_prev = jnp.cumprod(
+            jnp.concatenate([jnp.ones((Np, 1), bool), ok[:, :-1]], axis=1),
+            axis=1).astype(bool)
+        first_fail = base[:, None] & passed_prev & ~ok
+        filtered = first_fail.sum(axis=0)                  # [C]
+        return base & ok.all(axis=1), filtered
+
+    feas, cons_filtered = lax.map(per_ask_feas, jnp.arange(Gp))
+
+    # affinity matches are also placement-invariant: [Gp, Np]
+    def per_ask_aff(g):
+        vals = attr_rank[:, a_col[g]]                      # [Np, CA]
+        match = _op_eval(vals, a_op[g], a_rank[g])
+        return (match * a_weight[g][None, :]).sum(axis=1)  # [Np]
+
+    aff_score = lax.map(per_ask_aff, jnp.arange(Gp)) + a_host
+
+    # ---------- placement scan ----------
+    def step(carry, p):
+        used, dev_used, coll, sp_used = carry
+        g = p_ask[p]
+        active = p < n_place
+        res_g = ask_res[g]
+
+        after = used + res_g[None, :]                      # [Np, R]
+        fit_dims = after <= avail                          # [Np, R]
+        fit = fit_dims.all(axis=1)
+        dev_after = dev_used + dev_ask[g][None, :]
+        dev_fit = (dev_after <= dev_cap).all(axis=1)
+
+        feas_g = feas[g]
+        placeable = feas_g & fit & dev_fit
+
+        # -- binpack (funcs.go:155 ScoreFit, normalized rank.go:441) --
+        denom_cpu = avail[:, R_CPU]
+        denom_mem = avail[:, R_MEM]
+        util_cpu = after[:, R_CPU] + reserved[:, R_CPU]
+        util_mem = after[:, R_MEM] + reserved[:, R_MEM]
+        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+        free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
+        free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
+        raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
+        binpack = jnp.where(ok_denoms,
+                            jnp.clip(raw, 0.0, 18.0) / 18.0, 0.0)
+
+        # -- job anti-affinity (rank.go:462) --
+        collg = coll[g]
+        anti = jnp.where(collg > 0, -(collg + 1.0) / ask_desired[g], 0.0)
+        anti_counts = collg > 0
+
+        # -- node reschedule penalty (rank.go:532) --
+        pen = jnp.where(penalty[g], -1.0, 0.0)
+        pen_counts = penalty[g]
+
+        # -- node affinity (rank.go:577; append-if-nonzero) --
+        affg = aff_score[g]
+        aff_counts = affg != 0.0
+
+        # -- spread (spread.go; append-if-nonzero) --
+        def one_spread(s):
+            col = sp_col[g, s]
+            has = col >= 0
+            v = attr_rank[:, jnp.maximum(col, 0)]          # [Np]
+            has_v = v >= 0
+            vc = jnp.maximum(v, 0)
+            used_vec = sp_used[g, s]                       # [V]
+            cur = jnp.where(has_v, used_vec[vc], 0.0)
+            # targeted scoring (desired counts, +1 for this placement)
+            desired = jnp.where(has_v, sp_desired[g, s, vc], -1.0)
+            desired = jnp.where(desired < 0, sp_implicit[g, s], desired)
+            boost = ((desired - (cur + 1.0)) / jnp.maximum(desired, 1e-9)
+                     ) * sp_weight[g, s]
+            targeted = jnp.where(~has_v, -1.0,
+                                 jnp.where(desired <= 0, -1.0, boost))
+            # even-spread scoring (spread.go evenSpreadScoreBoost)
+            present = used_vec > 0
+            any_present = present.any()
+            minc = jnp.min(jnp.where(present, used_vec, jnp.inf))
+            maxc = jnp.max(jnp.where(present, used_vec, -jnp.inf))
+            delta_boost = (minc - cur) / jnp.maximum(minc, 1e-9)
+            even = jnp.where(cur != minc, delta_boost,
+                             jnp.where(minc == maxc, -1.0,
+                                       (maxc - minc) / jnp.maximum(minc, 1e-9)))
+            even = jnp.where(~has_v, -1.0, even)
+            even = jnp.where(any_present, even, 0.0)
+            contrib = jnp.where(sp_targeted[g, s], targeted, even)
+            return jnp.where(has, contrib, 0.0)
+
+        S = sp_col.shape[1]
+        sp_scores = lax.map(one_spread, jnp.arange(S))     # [S, Np]
+        spread_total = sp_scores.sum(axis=0)
+        spread_counts = spread_total != 0.0
+
+        # -- normalization: mean over appended scorers (rank.go:667) --
+        n_scorers = (1.0 + anti_counts + pen_counts + aff_counts
+                     + spread_counts)
+        total = (binpack + anti + pen + affg + spread_total) / n_scorers
+        score = jnp.where(placeable, total, NEG_INF)
+
+        top_score, top_idx = lax.top_k(score, TOP_K)
+        top_ok = (top_score > NEG_INF / 2) & active
+        choice = top_idx[0]
+        ok = top_ok[0]
+
+        # -- commit the winner --
+        add = jnp.where(ok, 1.0, 0.0)
+        used = used.at[choice].add(res_g * add)
+        dev_used = dev_used.at[choice].add(dev_ask[g] * add)
+        coll = coll.at[g, choice].add(add)
+        # spread usage: bump the chosen node's value per spread slot
+        ch_vals = attr_rank[choice, jnp.maximum(sp_col[g], 0)]   # [S]
+        valid_slot = (sp_col[g] >= 0) & (ch_vals >= 0)
+        sp_used = sp_used.at[g, jnp.arange(S),
+                             jnp.maximum(ch_vals, 0)].add(
+            jnp.where(valid_slot, add, 0.0))
+
+        n_feas = (feas_g & valid).sum()
+        n_exh = (feas_g & valid & ~(fit & dev_fit)).sum()
+        dim_exh = (feas_g[:, None] & valid[:, None] & ~fit_dims).sum(axis=0)
+
+        return ((used, dev_used, coll, sp_used),
+                (top_idx, top_ok, top_score, n_feas, n_exh, dim_exh))
+
+    init = (used0, dev_used0, coll0, sp_used0)
+    (used_final, _, _, _), outs = lax.scan(init=init, xs=jnp.arange(K), f=step)
+    top_idx, top_ok, top_score, n_feas, n_exh, dim_exh = outs
+
+    return SolveResult(choice=top_idx, choice_ok=top_ok, score=top_score,
+                       n_feasible=n_feas, n_exhausted=n_exh,
+                       dim_exhausted=dim_exh, feas=feas,
+                       cons_filtered=cons_filtered, used_final=used_final)

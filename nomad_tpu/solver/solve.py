@@ -1,0 +1,222 @@
+"""Solver orchestration: pack -> device solve -> unpack into placements.
+
+The discrete leftovers the tensor solve can't express (exact port picking,
+device instance IDs — SURVEY §7.3) are fixed up host-side here, walking the
+kernel's top-K candidates per placement so a port/instance conflict falls
+through to the next-best node instead of failing the eval.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..structs import (AllocatedDeviceResource, AllocatedResources,
+                       AllocatedSharedResources, AllocatedTaskResources,
+                       AllocMetric, DeviceAccounter, NetworkIndex, Node)
+from .kernel import TOP_K, solve_kernel
+from .tensorize import (NUM_R, PackedBatch, PlacementAsk, Tensorizer,
+                        R_CPU, R_DISK, R_MEM, R_NET)
+
+_DIM_NAMES = {R_CPU: "cpu", R_MEM: "memory", R_DISK: "disk", R_NET: "network"}
+
+
+@dataclass
+class Placement:
+    ask_index: int
+    node: Optional[Node]
+    score: float
+    metrics: AllocMetric
+    resources: Optional[AllocatedResources] = None
+    failed_reason: str = ""
+
+
+@dataclass
+class SolveOutput:
+    placements: List[Placement]
+    class_eligibility: List[Dict[str, bool]] = field(default_factory=list)
+    # ^ per ask: computed-class -> any feasible node of that class
+
+
+class Solver:
+    """Stateful wrapper owning tensorizer memoization. One per scheduler
+    worker (reference analog: the Stack owned by each scheduler)."""
+
+    def __init__(self) -> None:
+        self._tensorizer = Tensorizer()
+
+    def solve(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
+              allocs_by_node: Optional[Dict[str, list]] = None,
+              by_dc: Optional[Dict[str, int]] = None) -> SolveOutput:
+        if not asks:
+            return SolveOutput(placements=[])
+        pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
+        res = _run_kernel(pb)
+
+        choice = np.asarray(res.choice)
+        choice_ok = np.asarray(res.choice_ok)
+        score = np.asarray(res.score)
+        n_feasible = np.asarray(res.n_feasible)
+        n_exhausted = np.asarray(res.n_exhausted)
+        dim_exhausted = np.asarray(res.dim_exhausted)
+        feas = np.asarray(res.feas)
+        cons_filtered = np.asarray(res.cons_filtered)
+
+        # host fixup state: per-node port/device accounting incl. in-batch.
+        # host_used is the AUTHORITATIVE usage: when a placement falls through
+        # to a lower-ranked candidate, the kernel's in-batch commit charged
+        # the wrong node, so every candidate is re-checked against host_used
+        # before acceptance.
+        net_cache: Dict[int, NetworkIndex] = {}
+        dev_cache: Dict[int, DeviceAccounter] = {}
+        host_used = pb.used0.copy()
+
+        placements: List[Placement] = []
+        for p in range(pb.n_place):
+            g = int(pb.p_ask[p])
+            ask = asks[g]
+            m = AllocMetric()
+            m.nodes_evaluated = pb.n_real
+            m.nodes_available = dict(by_dc or {})
+            m.nodes_filtered = pb.n_real - int(n_feasible[p])
+            for ci, label in enumerate(pb.constraint_labels[g]):
+                cnt = int(cons_filtered[g, ci])
+                if cnt:
+                    m.constraint_filtered[label] = cnt
+            m.nodes_exhausted = int(n_exhausted[p])
+            for d in range(NUM_R):
+                cnt = int(dim_exhausted[p, d])
+                if cnt:
+                    m.dimension_exhausted[_DIM_NAMES[d]] = cnt
+
+            placed = None
+            ask_vec = pb.ask_res[g]
+            for k in range(TOP_K):
+                if not choice_ok[p, k]:
+                    break
+                ni = int(choice[p, k])
+                node = nodes[ni]
+                if not np.all(host_used[ni] + ask_vec <= pb.avail[ni]):
+                    continue
+                resources = self._host_commit(node, ni, ask, net_cache,
+                                              dev_cache, allocs_by_node)
+                if resources is None:
+                    continue
+                host_used[ni] += ask_vec
+                m.score_meta = [
+                    {"node_id": pb.node_ids[int(choice[p, j])],
+                     "normalized_score": float(score[p, j])}
+                    for j in range(TOP_K) if choice_ok[p, j]]
+                placed = Placement(ask_index=g, node=node,
+                                   score=float(score[p, k]), metrics=m,
+                                   resources=resources)
+                break
+            if placed is None:
+                reason = ("resources exhausted" if n_feasible[p] > 0
+                          else "no feasible nodes")
+                placed = Placement(ask_index=g, node=None, score=0.0,
+                                   metrics=m, failed_reason=reason)
+            placements.append(placed)
+
+        # class eligibility for blocked-eval optimization
+        class_elig: List[Dict[str, bool]] = []
+        node_class = pb.node_class[:pb.n_real]
+        inv_class = {v: k for k, v in pb.class_ids.items()}
+        for g in range(pb.n_asks):
+            fg = feas[g, :pb.n_real]
+            elig: Dict[str, bool] = {}
+            for cid, cname in inv_class.items():
+                members = node_class == cid
+                if members.any():
+                    elig[cname] = bool(fg[members].any())
+            class_elig.append(elig)
+
+        return SolveOutput(placements=placements,
+                           class_eligibility=class_elig)
+
+    def _host_commit(self, node: Node, node_ix: int, ask: PlacementAsk,
+                     net_cache: Dict[int, NetworkIndex],
+                     dev_cache: Dict[int, DeviceAccounter],
+                     allocs_by_node) -> Optional[AllocatedResources]:
+        """Build AllocatedResources with real ports + device instance ids.
+
+        Works on clones and reserves each offer immediately, so multiple
+        tasks in one group see each other's ports/instances; the clone is
+        only promoted into the cache on success (all-or-nothing).
+        Returns None if the discrete assignment fails on this node.
+        """
+        idx = net_cache.get(node_ix)
+        if idx is None:
+            idx = NetworkIndex()
+            idx.set_node(node)
+            if allocs_by_node:
+                idx.add_allocs(allocs_by_node.get(node.id, ()))
+            net_cache[node_ix] = idx
+        acct = dev_cache.get(node_ix)
+        if acct is None:
+            acct = DeviceAccounter(node)
+            if allocs_by_node:
+                acct.add_allocs(allocs_by_node.get(node.id, ()))
+            dev_cache[node_ix] = acct
+
+        idx = idx.clone()
+        acct = acct.clone()
+
+        out = AllocatedResources()
+        for t in ask.tg.tasks:
+            tr = AllocatedTaskResources(cpu=t.resources.cpu,
+                                        memory_mb=t.resources.memory_mb)
+            for ask_net in t.resources.networks:
+                offer, _err = idx.assign_network(ask_net)
+                if offer is None:
+                    return None
+                idx.add_reserved(offer)
+                tr.networks.append(offer)
+            for d in t.resources.devices:
+                got = self._assign_devices(acct, node, d)
+                if got is None:
+                    return None
+                acct.add_reserved(got.vendor, got.type, got.name,
+                                  got.device_ids)
+                tr.devices.append(got)
+            out.tasks[t.name] = tr
+        shared_nets = []
+        for ask_net in ask.tg.networks:
+            offer, _err = idx.assign_network(ask_net)
+            if offer is None:
+                return None
+            idx.add_reserved(offer)
+            shared_nets.append(offer)
+        out.shared = AllocatedSharedResources(
+            disk_mb=ask.tg.ephemeral_disk.size_mb, networks=shared_nets)
+        net_cache[node_ix] = idx
+        dev_cache[node_ix] = acct
+        return out
+
+    @staticmethod
+    def _assign_devices(acct: DeviceAccounter, node: Node, req
+                        ) -> Optional[AllocatedDeviceResource]:
+        """Pick free instance ids matching the request pattern
+        (reference: scheduler/device.go:32 AssignDevice)."""
+        for dev in node.node_resources.devices:
+            dv, dt, dm = dev.id_tuple()
+            if not req.matches(dv, dt, dm):
+                continue
+            free = acct.free_instances(dv, dt, dm)
+            if len(free) >= req.count:
+                return AllocatedDeviceResource(
+                    vendor=dv, type=dt, name=dm,
+                    device_ids=free[:req.count])
+        return None
+
+
+def _run_kernel(pb: PackedBatch):
+    return solve_kernel(
+        pb.avail, pb.reserved, pb.used0, pb.valid, pb.node_dc, pb.attr_rank,
+        pb.ask_res, pb.ask_desired, pb.dc_ok, pb.host_ok, pb.coll0,
+        pb.penalty, pb.c_op, pb.c_col, pb.c_rank, pb.a_op, pb.a_col,
+        pb.a_rank, pb.a_weight, pb.a_host, pb.sp_col, pb.sp_weight,
+        pb.sp_targeted,
+        pb.sp_desired, pb.sp_implicit, pb.sp_used0, pb.dev_cap, pb.dev_used0,
+        pb.dev_ask, pb.p_ask, pb.n_place)

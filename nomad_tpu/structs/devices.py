@@ -25,6 +25,12 @@ class DeviceAccounter:
             insts = {inst.id: 0 for inst in dev.instances if inst.healthy}
             self.devices[dev.id_tuple()] = DeviceAccounterInstance(insts)
 
+    def clone(self) -> "DeviceAccounter":
+        c = object.__new__(DeviceAccounter)
+        c.devices = {k: DeviceAccounterInstance(dict(v.instances))
+                     for k, v in self.devices.items()}
+        return c
+
     def add_allocs(self, allocs) -> bool:
         """Mark instances used by allocs; True if oversubscribed/collision."""
         collision = False

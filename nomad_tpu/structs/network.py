@@ -29,6 +29,14 @@ class NetworkIndex:
     def release(self) -> None:
         self.__init__()
 
+    def clone(self) -> "NetworkIndex":
+        c = NetworkIndex()
+        c.avail_networks = list(self.avail_networks)
+        c.avail_bandwidth = dict(self.avail_bandwidth)
+        c.used_ports = {ip: set(s) for ip, s in self.used_ports.items()}
+        c.used_bandwidth = dict(self.used_bandwidth)
+        return c
+
     # -- building the index --
     def set_node(self, node) -> bool:
         """Register node networks + reserved ports. True on collision."""

@@ -69,14 +69,14 @@ class RequestedDevice:
         return (parts[0], parts[1], "/".join(parts[2:]))
 
     def matches(self, vendor: str, typ: str, model: str) -> bool:
-        v, t, m = self.id_tuple()
-        if v and v != vendor:
-            return False
-        if t and t != typ:
-            return False
-        if m and m != model:
-            return False
-        return True
+        return device_pattern_matches(self.id_tuple(), (vendor, typ, model))
+
+
+def device_pattern_matches(pattern: Tuple[str, str, str],
+                           ident: Tuple[str, str, str]) -> bool:
+    """Wildcard device matching: empty pattern parts match anything
+    (reference: structs.RequestedDevice ID semantics)."""
+    return all(not p or p == d for p, d in zip(pattern, ident))
 
 
 @dataclass

@@ -1,0 +1,371 @@
+"""Differential tests: TPU solve vs host (scalar) reference semantics.
+
+Mirrors the strategy of SURVEY §7.2 step 3: feasible set must match exactly;
+chosen node must be argmax-equivalent on the scoring math.
+"""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler import feasible as hostfeas
+from nomad_tpu.structs import (Affinity, Constraint, NodeDevice,
+                               NodeDeviceResource, Port, RequestedDevice,
+                               Spread, SpreadTarget, score_fit,
+                               ComparableResources)
+from nomad_tpu.solver.solve import Solver
+from nomad_tpu.solver.tensorize import PlacementAsk
+
+
+def make_nodes(n, dc_cycle=("dc1",)):
+    nodes = []
+    for i in range(n):
+        nd = mock.node(datacenter=dc_cycle[i % len(dc_cycle)])
+        nodes.append(nd)
+    return nodes
+
+
+def simple_ask(job=None, count=1, **kw):
+    job = job or mock.job()
+    return PlacementAsk(job=job, tg=job.task_groups[0], count=count, **kw)
+
+
+def test_feasibility_parity_mixed_constraints():
+    rng = np.random.default_rng(42)
+    nodes = []
+    for i in range(40):
+        n = mock.node()
+        n.attributes["arch"] = rng.choice(["x86", "arm64", "riscv"])
+        n.attributes["cpu.frequency"] = str(rng.choice(["1200", "2400", "3600"]))
+        n.attributes["driver.docker.version"] = rng.choice(
+            ["17.05.0", "18.09.1", "19.03.5"])
+        n.attributes["tags"] = rng.choice(["a,b", "b,c", "a,c,d"])
+        if rng.random() < 0.5:
+            n.attributes["special"] = "yes"
+        n.compute_class()
+        nodes.append(n)
+
+    job = mock.job()
+    job.constraints = [
+        Constraint("${attr.kernel.name}", "linux", "="),
+        Constraint("${attr.arch}", "riscv", "!="),
+        Constraint("${attr.cpu.frequency}", "2400", ">="),  # lexical
+        Constraint("${attr.driver.docker.version}", ">= 18.0", "version"),
+        Constraint("${attr.tags}", "a", "set_contains"),
+        Constraint("${attr.special}", "", "is_set"),
+    ]
+    job.task_groups[0].constraints = []
+    ask = simple_ask(job)
+
+    solver = Solver()
+    out = solver.solve(nodes, [ask])
+    pb = solver._tensorizer.pack(nodes, [ask])
+    from nomad_tpu.solver.solve import _run_kernel
+    feas = np.asarray(_run_kernel(pb).feas)[0, :len(nodes)]
+
+    for i, n in enumerate(nodes):
+        ok, why = hostfeas.group_feasible(n, job, job.task_groups[0])
+        assert bool(feas[i]) == ok, (
+            f"node {i}: device={bool(feas[i])} host={ok} ({why}) "
+            f"attrs={n.attributes}")
+
+
+def test_binpack_argmax_matches_host():
+    nodes = make_nodes(10)
+    # give each node distinct existing load
+    allocs_by_node = {}
+    for i, n in enumerate(nodes):
+        a = mock.alloc()
+        a.node_id = n.id
+        a.allocated_resources.tasks["web"].cpu = 300 * i
+        a.allocated_resources.tasks["web"].memory_mb = 128 * i
+        a.allocated_resources.tasks["web"].networks = []
+        allocs_by_node[n.id] = [a]
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+
+    out = Solver().solve(nodes, [ask], allocs_by_node)
+    assert out.placements[0].node is not None
+
+    # host-side argmax over score_fit with the same util definition
+    from nomad_tpu.solver.tensorize import group_resource_vector
+    res = group_resource_vector(tg)
+    best, best_score = None, -1
+    for i, n in enumerate(nodes):
+        a = allocs_by_node[n.id][0]
+        util = ComparableResources(
+            cpu=int(a.allocated_resources.tasks["web"].cpu + res[0] + 100),
+            memory_mb=int(a.allocated_resources.tasks["web"].memory_mb
+                          + res[1] + 256))
+        fit_ok, _, _ = structs.allocs_fit(
+            n, allocs_by_node[n.id] + [_fake_alloc(res)])
+        if not fit_ok:
+            continue
+        sc = score_fit(n, util)
+        if sc > best_score:
+            best, best_score = n.id, sc
+    assert out.placements[0].node.id == best
+    assert abs(out.placements[0].score - best_score / 18.0) < 1e-5
+
+
+def _fake_alloc(res):
+    a = mock.alloc()
+    tr = a.allocated_resources.tasks["web"]
+    tr.cpu, tr.memory_mb, tr.networks = int(res[0]), int(res[1]), []
+    return a
+
+
+def test_in_batch_visibility():
+    # two nodes, 3 placements of 1500cpu each: third must fail or go to the
+    # node that still fits after the first two committed in-batch
+    nodes = make_nodes(2)
+    for n in nodes:
+        n.node_resources.cpu = 3200
+        n.node_resources.memory_mb = 8192
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.cpu = 1500
+    tg.tasks[0].resources.memory_mb = 512
+    tg.tasks[0].resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=3)
+    out = Solver().solve(nodes, [ask])
+    placed_nodes = [p.node.id for p in out.placements if p.node]
+    assert len(placed_nodes) == 3
+    # each node fits two (3200-100 reserved)/1500 = 2; 3 placements over 2 nodes
+    from collections import Counter
+    counts = Counter(placed_nodes)
+    assert max(counts.values()) == 2 and min(counts.values()) == 1
+
+
+def test_anti_affinity_distributes():
+    nodes = make_nodes(4)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 64
+    tg.tasks[0].resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=4)
+    out = Solver().solve(nodes, [ask])
+    placed = [p.node.id for p in out.placements]
+    # anti-affinity should spread one per node
+    assert len(set(placed)) == 4
+
+
+def test_spread_even_across_dcs():
+    nodes = make_nodes(6, dc_cycle=("dc1", "dc2", "dc3"))
+    job = mock.job(datacenters=["dc1", "dc2", "dc3"])
+    tg = job.task_groups[0]
+    tg.count = 6
+    tg.tasks[0].resources.networks = []
+    tg.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+    ask = PlacementAsk(job=job, tg=tg, count=6)
+    out = Solver().solve(nodes, [ask])
+    dcs = [p.node.datacenter for p in out.placements if p.node]
+    from collections import Counter
+    c = Counter(dcs)
+    assert len(dcs) == 6
+    assert set(c.values()) == {2}, c  # even 2-2-2
+
+
+def test_spread_targeted_percentages():
+    nodes = make_nodes(8, dc_cycle=("dc1", "dc2"))
+    job = mock.job(datacenters=["dc1", "dc2"])
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].resources.networks = []
+    tg.spreads = [Spread(attribute="${node.datacenter}", weight=100,
+                         spread_targets=[SpreadTarget("dc1", 75),
+                                         SpreadTarget("dc2", 25)])]
+    ask = PlacementAsk(job=job, tg=tg, count=4)
+    out = Solver().solve(nodes, [ask])
+    from collections import Counter
+    c = Counter(p.node.datacenter for p in out.placements if p.node)
+    assert c["dc1"] == 3 and c["dc2"] == 1, c
+
+
+def test_affinity_weights_attract():
+    nodes = make_nodes(6)
+    for i, n in enumerate(nodes):
+        n.attributes["rack"] = "r1" if i < 2 else "r2"
+        n.compute_class()
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.affinities = [Affinity("${attr.rack}", "r1", "=", weight=100)]
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+    out = Solver().solve(nodes, [ask])
+    assert out.placements[0].node.attributes["rack"] == "r1"
+
+
+def test_device_scheduling():
+    nodes = make_nodes(3)
+    gpu = mock.gpu_node(n_gpus=2)
+    nodes.append(gpu)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.devices = [RequestedDevice(name="nvidia/gpu", count=2)]
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+    out = Solver().solve(nodes, [ask])
+    p = out.placements[0]
+    assert p.node is not None and p.node.id == gpu.id
+    devs = p.resources.tasks["web"].devices
+    assert len(devs) == 1 and len(devs[0].device_ids) == 2
+    # second ask for 2 more gpus must fail (instances exhausted in-batch)
+    ask2 = PlacementAsk(job=mock.job(), tg=tg, count=2)
+    out2 = Solver().solve(nodes, [ask2], allocs_by_node={})
+    ok = [p for p in out2.placements if p.node]
+    assert len(ok) == 1
+
+
+def test_infeasible_reports_metrics():
+    nodes = make_nodes(5)
+    job = mock.job()
+    job.constraints = [Constraint("${attr.arch}", "sparc", "=")]
+    ask = simple_ask(job)
+    out = Solver().solve(nodes, [ask])
+    p = out.placements[0]
+    assert p.node is None
+    assert p.failed_reason == "no feasible nodes"
+    assert p.metrics.nodes_filtered == 5
+    assert any("sparc" in k for k in p.metrics.constraint_filtered)
+    # class eligibility: the single mock class is ineligible
+    assert out.class_eligibility[0] and not any(
+        out.class_eligibility[0].values())
+
+
+def test_exhausted_reports_dimension():
+    nodes = make_nodes(2)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.cpu = 100000
+    tg.tasks[0].resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+    out = Solver().solve(nodes, [ask])
+    p = out.placements[0]
+    assert p.node is None
+    assert p.failed_reason == "resources exhausted"
+    assert p.metrics.dimension_exhausted.get("cpu") == 2
+
+
+def test_static_port_collision_falls_through():
+    nodes = make_nodes(3)
+    # all three nodes feasible; best node already has port 8080 taken
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = [structs.NetworkResource(
+        mbits=10, reserved_ports=[Port(label="http", value=8080)])]
+    # preload an alloc holding 8080 on every node except one
+    allocs_by_node = {}
+    for n in nodes[:2]:
+        a = mock.alloc()
+        a.node_id = n.id
+        a.allocated_resources.tasks["web"].networks = [
+            structs.NetworkResource(device="eth0",
+                                    ip=n.node_resources.networks[0].ip,
+                                    reserved_ports=[Port("http", 8080)])]
+        allocs_by_node[n.id] = [a]
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+    out = Solver().solve(nodes, [ask], allocs_by_node)
+    p = out.placements[0]
+    assert p.node is not None
+    assert p.node.id == nodes[2].id
+    ports = p.resources.tasks["web"].networks[0].reserved_ports
+    assert ports[0].value == 8080
+
+
+def test_reschedule_penalty_avoids_previous_node():
+    nodes = make_nodes(2)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=1,
+                       penalty_nodes=frozenset({nodes[0].id}))
+    out = Solver().solve(nodes, [ask])
+    assert out.placements[0].node.id == nodes[1].id
+
+
+def test_multi_task_ports_and_devices_unique():
+    # two tasks each asking one dynamic port and one GPU on the same node:
+    # offers must not collide (incremental reservation within the group)
+    n = mock.gpu_node(n_gpus=2)
+    job = mock.job()
+    tg = job.task_groups[0]
+    t1 = tg.tasks[0]
+    t1.resources.networks = [structs.NetworkResource(
+        mbits=1, dynamic_ports=[Port(label="a")])]
+    t1.resources.devices = [RequestedDevice(name="nvidia/gpu", count=1)]
+    import copy
+    t2 = copy.deepcopy(t1)
+    t2.name = "web2"
+    tg.tasks.append(t2)
+    out = Solver().solve([n], [PlacementAsk(job=job, tg=tg, count=1)])
+    p = out.placements[0]
+    assert p.node is not None
+    p1 = p.resources.tasks["web"].networks[0].dynamic_ports[0].value
+    p2 = p.resources.tasks["web2"].networks[0].dynamic_ports[0].value
+    assert p1 != p2
+    g1 = p.resources.tasks["web"].devices[0].device_ids
+    g2 = p.resources.tasks["web2"].devices[0].device_ids
+    assert set(g1).isdisjoint(g2)
+
+
+def test_host_affinity_version_operand():
+    nodes = make_nodes(4)
+    for i, n in enumerate(nodes):
+        n.attributes["driver.docker.version"] = "19.03.5" if i == 2 else "17.05.0"
+        n.compute_class()
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.affinities = [Affinity("${attr.driver.docker.version}", ">= 19.0",
+                              "version", weight=100)]
+    out = Solver().solve(nodes, [PlacementAsk(job=job, tg=tg, count=1)])
+    assert out.placements[0].node.id == nodes[2].id
+
+
+def test_fallback_does_not_overcommit():
+    # two nodes each fitting exactly one instance; the better node has a
+    # port conflict so placement 1 falls back to node B; placement 2 must
+    # NOT also land on B (host capacity recheck)
+    nodes = make_nodes(2)
+    for n in nodes:
+        n.node_resources.cpu = 1700   # fits one 1500cpu alloc (100 reserved)
+    a = mock.alloc()
+    a.node_id = nodes[0].id
+    a.allocated_resources.tasks["web"].cpu = 0
+    a.allocated_resources.tasks["web"].memory_mb = 0
+    a.allocated_resources.tasks["web"].networks = [structs.NetworkResource(
+        device="eth0", ip=nodes[0].node_resources.networks[0].ip,
+        reserved_ports=[Port("x", 9999)])]
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.cpu = 1500
+    tg.tasks[0].resources.memory_mb = 256
+    tg.tasks[0].resources.networks = [structs.NetworkResource(
+        mbits=1, reserved_ports=[Port(label="x", value=9999)])]
+    ask = PlacementAsk(job=job, tg=tg, count=2)
+    out = Solver().solve(nodes, [ask], {nodes[0].id: [a]})
+    placed = [p for p in out.placements if p.node]
+    assert len(placed) == 1
+    assert placed[0].node.id == nodes[1].id
+
+
+def test_version_prerelease_not_matched():
+    nodes = make_nodes(2)
+    nodes[0].attributes["v"] = "18.09.1-beta"
+    nodes[1].attributes["v"] = "18.09.1"
+    for n in nodes:
+        n.compute_class()
+    job = mock.job()
+    job.constraints = [Constraint("${attr.v}", ">= 18.0", "version")]
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=1)
+    out = Solver().solve(nodes, [ask])
+    assert out.placements[0].node.id == nodes[1].id
+    from nomad_tpu.scheduler.feasible import check_version_match
+    assert not check_version_match("18.09.1-beta", ">= 18.0")
+    assert check_version_match("18.09.1", ">= 18.0")
