@@ -76,16 +76,47 @@ def parse_version_constraint(expr: str):
     return out
 
 
+_SEMVER_RE = re.compile(
+    r"^(\d+)\.(\d+)\.(\d+)(?:-([0-9A-Za-z-]+(?:\.[0-9A-Za-z-]+)*))?"
+    r"(?:\+([0-9A-Za-z-]+(?:\.[0-9A-Za-z-]+)*))?$")
+
+
+def parse_semver(s: str):
+    """Strict Semver 2.0 parse: exactly MAJOR.MINOR.PATCH, no 'v' prefix
+    (reference: helper/constraints/semver — 'only accept properly
+    formatted Semver versions')."""
+    m = _SEMVER_RE.match(s.strip())
+    if not m:
+        return None
+    return (int(m.group(1)), int(m.group(2)), int(m.group(3))), m.group(4) or ""
+
+
+def parse_semver_constraint(expr: str):
+    out = []
+    for part in expr.split(","):
+        m = _CONSTRAINT_OP_RE.match(part)
+        if not m:
+            return None
+        op = m.group(1) or "="
+        ver = parse_semver(m.group(2))
+        if ver is None:
+            return None
+        out.append((op, ver, m.group(2)))
+    return out
+
+
 def check_version_match(lval: str, constraint_expr: str,
                         strict_semver: bool = False) -> bool:
     key = ("s:" if strict_semver else "v:") + constraint_expr
     parsed = _VERSION_CACHE.get(key)
     if key not in _VERSION_CACHE:
-        parsed = parse_version_constraint(constraint_expr)
+        parsed = (parse_semver_constraint(constraint_expr) if strict_semver
+                  else parse_version_constraint(constraint_expr))
         _VERSION_CACHE[key] = parsed
     if parsed is None:
         return False
-    ver = parse_version(str(lval))
+    ver = (parse_semver(str(lval)) if strict_semver
+           else parse_version(str(lval)))
     if ver is None:
         return False
     for op, cver, raw in parsed:

@@ -29,6 +29,9 @@ class Interner:
     def lookup(self, i: int) -> str:
         return self._strs[i]
 
+    def items(self):
+        return self._ids.items()
+
     def __len__(self) -> int:
         return len(self._strs)
 

@@ -68,7 +68,8 @@ class SolveResult(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=())
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
-                 ask_res, ask_desired, dc_ok, host_ok, coll0, penalty,
+                 ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
+                 penalty,
                  c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
                  sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
                  sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place
@@ -103,7 +104,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
     # ---------- placement scan ----------
     def step(carry, p):
-        used, dev_used, coll, sp_used = carry
+        used, dev_used, coll, sp_used, blocked = carry
         g = p_ask[p]
         active = p < n_place
         res_g = ask_res[g]
@@ -114,7 +115,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         dev_after = dev_used + dev_ask[g][None, :]
         dev_fit = (dev_after <= dev_cap).all(axis=1)
 
-        feas_g = feas[g]
+        feas_g = feas[g] & ~blocked[g]
         placeable = feas_g & fit & dev_fit
 
         # -- binpack (funcs.go:155 ScoreFit, normalized rank.go:441) --
@@ -193,6 +194,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         used = used.at[choice].add(res_g * add)
         dev_used = dev_used.at[choice].add(dev_ask[g] * add)
         coll = coll.at[g, choice].add(add)
+        # distinct_hosts: later placements of any ask sharing this ask's
+        # distinct group (same job for job-level constraints) skip the node
+        same_grp = (distinct == distinct[g]) & (distinct[g] >= 0)   # [Gp]
+        hit = (jnp.arange(Np) == choice) & ok                       # [Np]
+        blocked = blocked | (same_grp[:, None] & hit[None, :])
         # spread usage: bump the chosen node's value per spread slot
         ch_vals = attr_rank[choice, jnp.maximum(sp_col[g], 0)]   # [S]
         valid_slot = (sp_col[g] >= 0) & (ch_vals >= 0)
@@ -204,11 +210,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         n_exh = (feas_g & valid & ~(fit & dev_fit)).sum()
         dim_exh = (feas_g[:, None] & valid[:, None] & ~fit_dims).sum(axis=0)
 
-        return ((used, dev_used, coll, sp_used),
+        return ((used, dev_used, coll, sp_used, blocked),
                 (top_idx, top_ok, top_score, n_feas, n_exh, dim_exh))
 
-    init = (used0, dev_used0, coll0, sp_used0)
-    (used_final, _, _, _), outs = lax.scan(init=init, xs=jnp.arange(K), f=step)
+    init = (used0, dev_used0, coll0, sp_used0,
+            jnp.zeros((Gp, Np), bool))
+    (used_final, _, _, _, _), outs = lax.scan(init=init, xs=jnp.arange(K),
+                                              f=step)
     top_idx, top_ok, top_score, n_feas, n_exh, dim_exh = outs
 
     return SolveResult(choice=top_idx, choice_ok=top_ok, score=top_score,

@@ -67,10 +67,13 @@ class Solver:
         # host_used is the AUTHORITATIVE usage: when a placement falls through
         # to a lower-ranked candidate, the kernel's in-batch commit charged
         # the wrong node, so every candidate is re-checked against host_used
-        # before acceptance.
+        # before acceptance. Likewise distinct_hosts / distinct_property are
+        # re-enforced here across in-batch commits.
         net_cache: Dict[int, NetworkIndex] = {}
         dev_cache: Dict[int, DeviceAccounter] = {}
         host_used = pb.used0.copy()
+        chosen_by_ask: Dict[int, set] = {}
+        prop_used: Dict[int, Dict[str, Dict[str, int]]] = {}
 
         placements: List[Placement] = []
         for p in range(pb.n_place):
@@ -99,11 +102,22 @@ class Solver:
                 node = nodes[ni]
                 if not np.all(host_used[ni] + ask_vec <= pb.avail[ni]):
                     continue
+                gid = int(pb.distinct[g])
+                if gid >= 0 and ni in chosen_by_ask.get(gid, ()):
+                    continue
+                prop_vals = self._property_fit(node, ask, prop_used.get(g))
+                if prop_vals is None:
+                    continue
                 resources = self._host_commit(node, ni, ask, net_cache,
                                               dev_cache, allocs_by_node)
                 if resources is None:
                     continue
                 host_used[ni] += ask_vec
+                if gid >= 0:
+                    chosen_by_ask.setdefault(gid, set()).add(ni)
+                for target, val in prop_vals:
+                    by_val = prop_used.setdefault(g, {}).setdefault(target, {})
+                    by_val[val] = by_val.get(val, 0) + 1
                 m.score_meta = [
                     {"node_id": pb.node_ids[int(choice[p, j])],
                      "normalized_score": float(score[p, j])}
@@ -195,6 +209,31 @@ class Solver:
         return out
 
     @staticmethod
+    def _property_fit(node: Node, ask: PlacementAsk,
+                      used: Optional[Dict[str, Dict[str, int]]]):
+        """Check distinct_property limits against existing + in-batch counts.
+        Returns the node's (target, value) pairs to charge on acceptance, or
+        None if any property is at its limit."""
+        if not ask.property_limits:
+            return ()
+        from ..structs import resolve_node_target
+        out = []
+        for target, (limit, existing) in ask.property_limits.items():
+            val, ok = resolve_node_target(node, target)
+            if not ok:
+                # nodes missing the property are infeasible for
+                # distinct_property (reference: propertyset.go:240)
+                return None
+            val = str(val)
+            count = existing.get(val, 0)
+            if used:
+                count += used.get(target, {}).get(val, 0)
+            if count + 1 > limit:
+                return None
+            out.append((target, val))
+        return out
+
+    @staticmethod
     def _assign_devices(acct: DeviceAccounter, node: Node, req
                         ) -> Optional[AllocatedDeviceResource]:
         """Pick free instance ids matching the request pattern
@@ -214,7 +253,8 @@ class Solver:
 def _run_kernel(pb: PackedBatch):
     return solve_kernel(
         pb.avail, pb.reserved, pb.used0, pb.valid, pb.node_dc, pb.attr_rank,
-        pb.ask_res, pb.ask_desired, pb.dc_ok, pb.host_ok, pb.coll0,
+        pb.ask_res, pb.ask_desired, pb.distinct, pb.dc_ok, pb.host_ok,
+        pb.coll0,
         pb.penalty, pb.c_op, pb.c_col, pb.c_rank, pb.a_op, pb.a_col,
         pb.a_rank, pb.a_weight, pb.a_host, pb.sp_col, pb.sp_weight,
         pb.sp_targeted,

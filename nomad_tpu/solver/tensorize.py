@@ -61,6 +61,10 @@ class PlacementAsk:
     # ^ node ids excluded by distinct_hosts / distinct_property semantics
     spread_seed: Dict[str, Dict[str, int]] = field(default_factory=dict)
     # ^ attr target -> value -> existing count (propertyset seed)
+    property_limits: Dict[str, Tuple[int, Dict[str, int]]] = field(
+        default_factory=dict)
+    # ^ distinct_property: attr target -> (limit, existing count by value);
+    #   enforced host-side across in-batch placements (solve.py)
 
 
 def group_resource_vector(tg: TaskGroup) -> np.ndarray:
@@ -120,8 +124,10 @@ class PackedBatch:
     # ask axis
     n_asks: int
     ask_res: np.ndarray        # [Gp, R]
-    ask_count: np.ndarray      # [Gp] i32
     ask_desired: np.ndarray    # [Gp] f32 tg.count for anti-affinity denom
+    distinct: np.ndarray       # [Gp] i32 distinct_hosts group id (-1 none):
+    #   in-batch placements sharing a group id must land on distinct nodes;
+    #   a job-level constraint puts all the job's asks in one group
     dc_ok: np.ndarray          # [Gp, NDC] bool over interned dc ids
     host_ok: np.ndarray        # [Gp, Np] bool host-evaluated feasibility
     coll0: np.ndarray          # [Gp, Np] f32 same-(job,tg) live counts
@@ -155,13 +161,6 @@ class PackedBatch:
     attr_targets: List[str] = field(default_factory=list)
     constraint_labels: List[List[str]] = field(default_factory=list)
     class_ids: Dict[str, int] = field(default_factory=dict)
-
-    def shape_key(self) -> tuple:
-        return (self.avail.shape[0], self.ask_res.shape[0],
-                self.c_op.shape[1], self.a_op.shape[1],
-                self.sp_col.shape[1], self.sp_desired.shape[2],
-                self.dev_cap.shape[1], self.p_ask.shape[0],
-                self.dc_ok.shape[1])
 
 
 class Tensorizer:
@@ -368,20 +367,29 @@ class Tensorizer:
         dc_ok = np.zeros((Gp, NDC), bool)
         for g, ask in enumerate(asks):
             dcs = set(ask.job.datacenters)
-            for dc, did in dc_interner._ids.items():
+            for dc, did in dc_interner.items():
                 if dc in dcs or "*" in dcs:
                     dc_ok[g, did] = True
 
         # ---- asks ----
         ask_res = np.zeros((Gp, NUM_R), np.float32)
-        ask_count = np.zeros(Gp, np.int32)
         ask_desired = np.ones(Gp, np.float32)
+        distinct = np.full(Gp, -1, np.int32)
+        distinct_interner = Interner()
         coll0 = np.zeros((Gp, Np), np.float32)
         penalty = np.zeros((Gp, Np), bool)
         for g, ask in enumerate(asks):
             ask_res[g] = group_resource_vector(ask.tg)
-            ask_count[g] = ask.count
             ask_desired[g] = max(ask.tg.count, 1)
+            if any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                   for c in ask.job.constraints):
+                # job-level: no two allocs of the job share a node, across
+                # all its task groups in this batch
+                distinct[g] = distinct_interner.intern("job:" + ask.job.id)
+            elif any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                     for c in hostfeas.merged_constraints(ask.job, ask.tg)):
+                distinct[g] = distinct_interner.intern(
+                    f"tg:{ask.job.id}:{ask.tg.name}")
             for nid, cnt in ask.existing_by_node.items():
                 i = node_index.get(nid)
                 if i is not None:
@@ -482,8 +490,8 @@ class Tensorizer:
             node_ids=[n.id for n in nodes], n_real=N,
             avail=avail, reserved=reserved, used0=used0, valid=valid,
             node_class=node_class, node_dc=node_dc, attr_rank=attr_rank,
-            n_asks=G, ask_res=ask_res, ask_count=ask_count,
-            ask_desired=ask_desired, dc_ok=dc_ok, host_ok=host_ok,
+            n_asks=G, ask_res=ask_res, ask_desired=ask_desired,
+            distinct=distinct, dc_ok=dc_ok, host_ok=host_ok,
             coll0=coll0, penalty=penalty,
             c_op=c_op, c_col=c_col, c_rank=c_rank,
             a_op=a_op, a_col=a_col, a_rank=a_rank, a_weight=a_weight,
@@ -494,7 +502,7 @@ class Tensorizer:
             p_ask=p_ask, n_place=len(p_ask_list),
             rank_columns=rank_columns, attr_targets=attr_targets,
             constraint_labels=constraint_labels,
-            class_ids=dict(class_interner._ids),
+            class_ids=dict(class_interner.items()),
         )
 
     def _class_masked(self, nodes: Sequence[Node], c: Constraint) -> np.ndarray:

@@ -82,6 +82,12 @@ DEPLOYMENT_DESC_NEWER_JOB = "Cancelled due to newer version of job"
 DEPLOYMENT_DESC_FAILED_ALLOCS = "Failed due to unhealthy allocations"
 DEPLOYMENT_DESC_PROGRESS_DEADLINE = "Failed due to progress deadline"
 DEPLOYMENT_DESC_SUCCESSFUL = "Deployment completed successfully"
+DEPLOYMENT_DESC_STOPPED_JOB = "Cancelled because job is stopped"
+DEPLOYMENT_DESC_NEEDS_PROMOTION = "Deployment is running but requires manual promotion"
+DEPLOYMENT_DESC_AUTO_PROMOTION = "Deployment is running pending automatic promotion"
+
+# description attached to allocs stopped by a destructive update
+ALLOC_UPDATING = "alloc is being updated due to job update"
 
 # --- Constraint operands (reference: scheduler/feasible.go:671-706) ---
 CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"

@@ -1,0 +1,565 @@
+"""Reconciler behavior tests, mirroring key scheduler/reconcile_test.go
+cases from the reference (place, scale, stop, lost, migrate, updates,
+canaries, reschedule now/later, deployments)."""
+import copy
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.reconcile import (AllocPlaceResult, Reconciler,
+                                           ReconcileResults)
+from nomad_tpu.structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
+                               ALLOC_CLIENT_LOST, ALLOC_CLIENT_RUNNING,
+                               ALLOC_DESIRED_RUN, ALLOC_DESIRED_STOP,
+                               DEPLOYMENT_STATUS_FAILED,
+                               DEPLOYMENT_STATUS_PAUSED,
+                               DEPLOYMENT_STATUS_SUCCESSFUL, AllocDeploymentStatus,
+                               Deployment, DeploymentState, DesiredTransition,
+                               RescheduleTracker, RescheduleEvent,
+                               ReschedulePolicy, TaskState, UpdateStrategy,
+                               alloc_name)
+
+
+def ignore_update_fn(alloc, job, tg):
+    return True, False, None
+
+
+def destructive_update_fn(alloc, job, tg):
+    return False, True, None
+
+
+def inplace_update_fn(alloc, job, tg):
+    updated = copy.copy(alloc)
+    updated.job = job
+    return False, False, updated
+
+
+def running_allocs(job, n, tg="web", node_ids=None):
+    out = []
+    for i in range(n):
+        a = mock.alloc(job=job)
+        a.task_group = tg
+        a.name = alloc_name(job.id, tg, i)
+        a.client_status = ALLOC_CLIENT_RUNNING
+        if node_ids:
+            a.node_id = node_ids[i % len(node_ids)]
+        out.append(a)
+    return out
+
+
+def reconcile(job, allocs, update_fn=ignore_update_fn, deployment=None,
+              tainted=None, batch=False, eval_id="eval-1", now=None,
+              job_id=None):
+    r = Reconciler(update_fn, batch, job_id or (job.id if job else "j"),
+                   job, deployment, allocs, tainted or {}, eval_id, now=now)
+    return r.compute()
+
+
+def place_names(res: ReconcileResults):
+    return sorted(p.name for p in res.place)
+
+
+def stop_ids(res: ReconcileResults):
+    return {s.alloc.id for s in res.stop}
+
+
+def test_place_all_new_job():
+    job = mock.job()
+    job.task_groups[0].count = 4
+    res = reconcile(job, [])
+    assert len(res.place) == 4
+    assert place_names(res) == [alloc_name(job.id, "web", i)
+                                for i in range(4)]
+    assert not res.stop
+    du = res.desired_tg_updates["web"]
+    assert du.place == 4
+
+
+def test_scale_up_fills_lowest_names():
+    job = mock.job()
+    job.task_groups[0].count = 5
+    allocs = running_allocs(job, 3)
+    res = reconcile(job, allocs)
+    assert len(res.place) == 2
+    assert place_names(res) == [alloc_name(job.id, "web", 3),
+                                alloc_name(job.id, "web", 4)]
+
+
+def test_scale_down_stops_highest_names():
+    job = mock.job()
+    job.task_groups[0].count = 3
+    allocs = running_allocs(job, 5)
+    res = reconcile(job, allocs)
+    assert not res.place
+    assert len(res.stop) == 2
+    stopped_names = {s.alloc.name for s in res.stop}
+    assert stopped_names == {alloc_name(job.id, "web", 3),
+                             alloc_name(job.id, "web", 4)}
+
+
+def test_stopped_job_stops_everything():
+    job = mock.job()
+    job.stop = True
+    allocs = running_allocs(job, 4)
+    res = reconcile(job, allocs)
+    assert len(res.stop) == 4
+    assert not res.place
+
+
+def test_removed_group_stops_allocs():
+    job = mock.job()
+    allocs = running_allocs(job, 2, tg="old-group")
+    job.task_groups[0].count = 2
+    res = reconcile(job, allocs)
+    assert {s.alloc.id for s in res.stop} == {a.id for a in allocs}
+    # and the current group still gets placements
+    assert len(res.place) == 2
+
+
+def test_lost_node_replaces_allocs():
+    job = mock.job()
+    job.task_groups[0].count = 3
+    down = mock.node(status=structs.NODE_STATUS_DOWN)
+    allocs = running_allocs(job, 3)
+    allocs[0].node_id = down.id
+    res = reconcile(job, allocs, tainted={down.id: down})
+    lost_stops = [s for s in res.stop if s.client_status == ALLOC_CLIENT_LOST]
+    assert len(lost_stops) == 1 and lost_stops[0].alloc.id == allocs[0].id
+    assert len(res.place) == 1
+    assert res.place[0].name == allocs[0].name
+
+
+def test_deregistered_node_is_lost():
+    job = mock.job()
+    job.task_groups[0].count = 1
+    allocs = running_allocs(job, 1)
+    allocs[0].node_id = "gone"
+    res = reconcile(job, allocs, tainted={"gone": None})
+    assert len(res.stop) == 1
+    assert res.stop[0].client_status == ALLOC_CLIENT_LOST
+    assert len(res.place) == 1
+
+
+def test_drain_migrates_allocs():
+    job = mock.job()
+    job.task_groups[0].count = 2
+    drain_node = mock.node()
+    allocs = running_allocs(job, 2)
+    allocs[0].node_id = drain_node.id
+    allocs[0].desired_transition = DesiredTransition(migrate=True)
+    res = reconcile(job, allocs, tainted={drain_node.id: drain_node})
+    migrating = [s for s in res.stop
+                 if s.status_description == structs.ALLOC_MIGRATING]
+    assert len(migrating) == 1
+    assert len(res.place) == 1
+    assert res.place[0].previous_alloc is allocs[0]
+    assert res.desired_tg_updates["web"].migrate == 1
+
+
+def test_ignore_unchanged():
+    job = mock.job()
+    job.task_groups[0].count = 3
+    allocs = running_allocs(job, 3)
+    res = reconcile(job, allocs)
+    assert res.changes() == 0
+    assert res.desired_tg_updates["web"].ignore == 3
+
+
+def test_inplace_update():
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 2
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 2)
+    res = reconcile(job, allocs, update_fn=inplace_update_fn, job_id=job.id)
+    assert len(res.inplace_update) == 2
+    assert not res.destructive_update
+    assert not res.place
+
+
+def test_destructive_update_unlimited_without_update_strategy():
+    job = mock.job()
+    job.version = 1
+    job.update = None
+    for tg in job.task_groups:
+        tg.update = None
+    job.task_groups[0].count = 3
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 3)
+    res = reconcile(job, allocs, update_fn=destructive_update_fn,
+                    job_id=job.id)
+    assert len(res.destructive_update) == 3
+
+
+def test_destructive_update_respects_max_parallel():
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 6
+    job.task_groups[0].update = UpdateStrategy(max_parallel=2, canary=0)
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 6)
+    res = reconcile(job, allocs, update_fn=destructive_update_fn,
+                    job_id=job.id)
+    assert len(res.destructive_update) == 2
+    du = res.desired_tg_updates["web"]
+    assert du.destructive_update == 2
+    assert du.ignore == 4
+    # a deployment is created to track the rolling update
+    assert res.deployment is not None
+    assert res.deployment.task_groups["web"].desired_total == 6
+
+
+def test_canaries_created_on_destructive_change():
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 4
+    job.task_groups[0].update = UpdateStrategy(max_parallel=2, canary=2)
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 4)
+    res = reconcile(job, allocs, update_fn=destructive_update_fn,
+                    job_id=job.id)
+    canaries = [p for p in res.place if p.canary]
+    assert len(canaries) == 2
+    # no destructive updates until canaries are promoted
+    assert not res.destructive_update
+    assert res.deployment is not None
+    assert res.deployment.task_groups["web"].desired_canaries == 2
+
+
+def test_promoted_canaries_allow_rolling_update():
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 4
+    job.task_groups[0].update = UpdateStrategy(max_parallel=2, canary=2)
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 4)
+
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index)
+    canary_allocs = []
+    for i in range(2):
+        c = mock.alloc(job=job)
+        c.name = alloc_name(job.id, "web", i)
+        c.client_status = ALLOC_CLIENT_RUNNING
+        c.deployment_id = dep.id
+        c.deployment_status = AllocDeploymentStatus(healthy=True, canary=True)
+        canary_allocs.append(c)
+    dep.task_groups["web"] = DeploymentState(
+        promoted=True, desired_canaries=2, desired_total=4,
+        placed_canaries=[c.id for c in canary_allocs],
+        healthy_allocs=2, placed_allocs=2)
+
+    res = reconcile(job, allocs + canary_allocs,
+                    update_fn=destructive_update_fn, deployment=dep,
+                    job_id=job.id)
+    # canaries share names with 2 old allocs: those old ones stop
+    named_stops = {s.alloc.id for s in res.stop}
+    overlapping = {a.id for a in allocs if a.name in
+                   {c.name for c in canary_allocs}}
+    assert overlapping <= named_stops
+
+
+def test_paused_deployment_blocks_placement():
+    job = mock.job()
+    job.task_groups[0].count = 5
+    job.task_groups[0].update = UpdateStrategy(max_parallel=2)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index,
+                     status=DEPLOYMENT_STATUS_PAUSED)
+    dep.task_groups["web"] = DeploymentState(desired_total=5)
+    res = reconcile(job, [], deployment=dep)
+    assert not res.place
+
+
+def test_failed_deployment_still_migrates():
+    """Migrations (drain) proceed even under a failed deployment
+    (reference: reconcile.go:484 'Migrate all the allocations')."""
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index,
+                     status=DEPLOYMENT_STATUS_FAILED)
+    dep.task_groups["web"] = DeploymentState(desired_total=2)
+    node = mock.node()
+    allocs = running_allocs(job, 2)
+    allocs[0].node_id = node.id
+    allocs[0].desired_transition = DesiredTransition(migrate=True)
+    res = reconcile(job, allocs, deployment=dep,
+                    tainted={node.id: node})
+    assert len(res.stop) == 1
+    assert res.stop[0].status_description == structs.ALLOC_MIGRATING
+    assert len(res.place) == 1
+    assert res.place[0].previous_alloc is allocs[0]
+
+
+def test_reschedule_now_failed_alloc():
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=3, interval_s=3600, delay_s=0, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    allocs = running_allocs(job, 2)
+    allocs[0].client_status = ALLOC_CLIENT_FAILED
+    allocs[0].task_states = {"web": TaskState(
+        state="dead", failed=True, finished_at=now)}
+    res = reconcile(job, allocs, now=now)
+    resched = [p for p in res.place if p.reschedule]
+    assert len(resched) == 1
+    assert resched[0].previous_alloc is allocs[0]
+    assert resched[0].name == allocs[0].name
+    # the replaced alloc is marked stopped (reference: markStop rescheduleNow)
+    assert allocs[0].id in {s.alloc.id for s in res.stop}
+    stop = [s for s in res.stop if s.alloc.id == allocs[0].id][0]
+    assert stop.status_description == structs.ALLOC_RESCHEDULED
+
+
+def test_paused_deployment_still_replaces_lost():
+    """Lost-capacity replacement happens even when the deployment is paused
+    (reference: reconcile.go:438-446)."""
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index,
+                     status=DEPLOYMENT_STATUS_PAUSED)
+    dep.task_groups["web"] = DeploymentState(desired_total=2)
+    down = mock.node(status=structs.NODE_STATUS_DOWN)
+    allocs = running_allocs(job, 2)
+    allocs[0].node_id = down.id
+    res = reconcile(job, allocs, deployment=dep, tainted={down.id: down})
+    assert len(res.place) == 1
+    assert res.place[0].name == allocs[0].name
+
+
+def test_no_deployment_created_for_plain_reschedule():
+    """A reschedule of the current job version must not spawn a new
+    deployment (reference: !hadRunning || updatingSpec gate)."""
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=3, interval_s=3600, delay_s=0, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    allocs = running_allocs(job, 2)
+    allocs[0].client_status = ALLOC_CLIENT_FAILED
+    allocs[0].task_states = {"web": TaskState(
+        state="dead", failed=True, finished_at=now)}
+    res = reconcile(job, allocs, now=now)
+    assert res.deployment is None
+
+
+def test_promoted_canaries_survive_failed_deployment():
+    """Only non-promoted canaries are stopped when a deployment fails."""
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1, canary=1)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index,
+                     status=DEPLOYMENT_STATUS_FAILED)
+    canary = mock.alloc(job=job)
+    canary.name = alloc_name(job.id, "web", 0)
+    canary.client_status = ALLOC_CLIENT_RUNNING
+    canary.deployment_id = dep.id
+    canary.deployment_status = AllocDeploymentStatus(healthy=True, canary=True)
+    dep.task_groups["web"] = DeploymentState(
+        promoted=True, desired_canaries=1, desired_total=1,
+        placed_canaries=[canary.id], healthy_allocs=1)
+    res = reconcile(job, [canary], deployment=dep)
+    assert canary.id not in {s.alloc.id for s in res.stop}
+
+
+def test_unhealthy_deployment_not_marked_successful():
+    """No pending work but allocs unhealthy: deployment stays running so
+    auto-revert can still trigger."""
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index)
+    dep.task_groups["web"] = DeploymentState(desired_total=2,
+                                             placed_allocs=2,
+                                             healthy_allocs=0)
+    allocs = running_allocs(job, 2)
+    for a in allocs:
+        a.deployment_id = dep.id
+    res = reconcile(job, allocs, deployment=dep)
+    assert not [u for u in res.deployment_updates
+                if u.status == DEPLOYMENT_STATUS_SUCCESSFUL]
+
+
+def test_scale_up_consumes_rolling_update_limit():
+    """Placements consume max_parallel before destructive updates
+    (reference: limit -= min(len(place), limit))."""
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 8
+    job.task_groups[0].update = UpdateStrategy(max_parallel=2)
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 6)  # scale 6 -> 8: 2 placements
+    res = reconcile(job, allocs, update_fn=destructive_update_fn,
+                    job_id=job.id)
+    assert len(res.place) == 2
+    # both budget slots went to the placements
+    assert not res.destructive_update
+
+
+def test_reschedule_later_creates_followup_eval():
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=3, interval_s=3600, delay_s=60, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    allocs = running_allocs(job, 1)
+    allocs[0].client_status = ALLOC_CLIENT_FAILED
+    allocs[0].task_states = {"web": TaskState(
+        state="dead", failed=True, finished_at=now)}
+    res = reconcile(job, allocs, now=now)
+    assert not [p for p in res.place if p.reschedule]
+    evals = res.desired_followup_evals.get("web", [])
+    assert len(evals) == 1
+    assert evals[0].wait_until == pytest.approx(now + 60, abs=2)
+    # the alloc is annotated with the follow-up eval id
+    assert res.attribute_updates[allocs[0].id].follow_up_eval_id == evals[0].id
+
+
+def test_reschedule_later_batched_in_window():
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=5, interval_s=3600, delay_s=60, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    allocs = running_allocs(job, 3)
+    for i, a in enumerate(allocs):
+        a.client_status = ALLOC_CLIENT_FAILED
+        a.task_states = {"web": TaskState(
+            state="dead", failed=True, finished_at=now + i)}  # within 5s
+    res = reconcile(job, allocs, now=now)
+    evals = res.desired_followup_evals.get("web", [])
+    assert len(evals) == 1
+    assert len(res.attribute_updates) == 3
+
+
+def test_exhausted_reschedule_attempts_not_replaced():
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=1, interval_s=3600, delay_s=0, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    a = running_allocs(job, 1)[0]
+    a.client_status = ALLOC_CLIENT_FAILED
+    a.task_states = {"web": TaskState(state="dead", failed=True,
+                                      finished_at=now)}
+    a.reschedule_tracker = RescheduleTracker(events=[
+        RescheduleEvent(reschedule_time=now - 10, delay_s=0)])
+    res = reconcile(job, [a], now=now)
+    assert not [p for p in res.place if p.reschedule]
+
+
+def test_batch_complete_not_replaced():
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    allocs = running_allocs(job, 2)
+    allocs[0].client_status = ALLOC_CLIENT_COMPLETE
+    allocs[0].task_states = {"web": TaskState(state="dead", failed=False,
+                                              finished_at=time.time())}
+    res = reconcile(job, allocs, batch=True)
+    assert not res.place
+    assert not res.stop
+
+
+def test_batch_failed_replaced():
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = ReschedulePolicy(
+        attempts=3, interval_s=86400, delay_s=0, unlimited=False,
+        delay_function="constant")
+    now = time.time()
+    a = running_allocs(job, 1)[0]
+    a.client_status = ALLOC_CLIENT_FAILED
+    a.task_states = {"web": TaskState(state="dead", failed=True,
+                                      finished_at=now)}
+    res = reconcile(job, [a], batch=True, now=now)
+    resched = [p for p in res.place if p.reschedule]
+    assert len(resched) == 1
+
+
+def test_already_rescheduled_not_replaced_again():
+    job = mock.job()
+    job.task_groups[0].count = 2
+    now = time.time()
+    a = running_allocs(job, 2)[0]
+    a.client_status = ALLOC_CLIENT_FAILED
+    a.next_allocation = "replacement-id"
+    b = running_allocs(job, 2)[1]
+    res = reconcile(job, [a, b], now=now)
+    # one placement to cover a's slot (count accounting), none rescheduled
+    assert not [p for p in res.place if p.reschedule]
+
+
+def test_deployment_completes():
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index)
+    dep.task_groups["web"] = DeploymentState(desired_total=2,
+                                             placed_allocs=2,
+                                             healthy_allocs=2)
+    allocs = running_allocs(job, 2)
+    for a in allocs:
+        a.deployment_id = dep.id
+        a.deployment_status = AllocDeploymentStatus(healthy=True)
+    res = reconcile(job, allocs, deployment=dep)
+    updates = [u for u in res.deployment_updates
+               if u.status == DEPLOYMENT_STATUS_SUCCESSFUL]
+    assert len(updates) == 1
+
+
+def test_old_deployment_cancelled():
+    job = mock.job()
+    job.version = 2
+    job.task_groups[0].count = 1
+    dep = Deployment(job_id=job.id, job_version=1,
+                     job_create_index=job.create_index)
+    allocs = running_allocs(job, 1)
+    res = reconcile(job, allocs, deployment=dep)
+    cancelled = [u for u in res.deployment_updates
+                 if u.status == structs.DEPLOYMENT_STATUS_CANCELLED]
+    assert len(cancelled) == 1
+
+
+def test_failed_deployment_canaries_stopped():
+    job = mock.job()
+    job.version = 1
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(max_parallel=1, canary=1)
+    old_job = mock.job(id=job.id)
+    old_job.version = 0
+    allocs = running_allocs(old_job, 2)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     job_create_index=job.create_index,
+                     status=DEPLOYMENT_STATUS_FAILED)
+    canary = mock.alloc(job=job)
+    canary.name = alloc_name(job.id, "web", 0)
+    canary.client_status = ALLOC_CLIENT_RUNNING
+    canary.deployment_id = dep.id
+    canary.deployment_status = AllocDeploymentStatus(canary=True)
+    dep.task_groups["web"] = DeploymentState(
+        desired_canaries=1, desired_total=2, placed_canaries=[canary.id])
+    res = reconcile(job, allocs + [canary],
+                    update_fn=destructive_update_fn, deployment=dep,
+                    job_id=job.id)
+    assert canary.id in {s.alloc.id for s in res.stop}

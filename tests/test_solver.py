@@ -369,3 +369,130 @@ def test_version_prerelease_not_matched():
     from nomad_tpu.scheduler.feasible import check_version_match
     assert not check_version_match("18.09.1-beta", ">= 18.0")
     assert check_version_match("18.09.1", ">= 18.0")
+
+
+def test_distinct_hosts_in_batch():
+    """Review regression: two placements of a distinct_hosts group must land
+    on different nodes even within one batch (reference: DistinctHostsIterator
+    scheduler/feasible.go:391)."""
+    from nomad_tpu.solver.solve import Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+    from nomad_tpu.structs import Constraint, CONSTRAINT_DISTINCT_HOSTS
+
+    nodes = [mock.node() for _ in range(4)]
+    job = mock.job()
+    job.constraints.append(Constraint(operand=CONSTRAINT_DISTINCT_HOSTS))
+    tg = job.task_groups[0]
+    tg.count = 3
+    for t in tg.tasks:
+        t.resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=3)
+    out = Solver().solve(nodes, [ask])
+    placed_nodes = [p.node.id for p in out.placements if p.node]
+    assert len(placed_nodes) == 3
+    assert len(set(placed_nodes)) == 3
+
+
+def test_distinct_hosts_more_than_nodes_fails_extra():
+    from nomad_tpu.solver.solve import Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+    from nomad_tpu.structs import Constraint, CONSTRAINT_DISTINCT_HOSTS
+
+    nodes = [mock.node() for _ in range(2)]
+    job = mock.job()
+    job.constraints.append(Constraint(operand=CONSTRAINT_DISTINCT_HOSTS))
+    tg = job.task_groups[0]
+    tg.count = 3
+    for t in tg.tasks:
+        t.resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=3)
+    out = Solver().solve(nodes, [ask])
+    placed = [p for p in out.placements if p.node]
+    failed = [p for p in out.placements if not p.node]
+    assert len(placed) == 2
+    assert len(failed) == 1
+    assert len({p.node.id for p in placed}) == 2
+
+
+def test_distinct_property_in_batch():
+    """distinct_property with limit 1 across racks: in-batch placements
+    respect the per-value budget."""
+    from nomad_tpu.solver.solve import Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+
+    nodes = [mock.node() for _ in range(4)]
+    for i, n in enumerate(nodes):
+        n.meta["rack"] = f"r{i % 2}"
+        n.compute_class()
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 3
+    for t in tg.tasks:
+        t.resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=3,
+                       property_limits={"${meta.rack}": (1, {})})
+    out = Solver().solve(nodes, [ask])
+    placed = [p for p in out.placements if p.node]
+    racks = [p.node.meta["rack"] for p in placed]
+    assert len(racks) == len(set(racks))
+
+
+def test_semver_strict_rejects_loose_versions():
+    from nomad_tpu.scheduler.feasible import check_version_match
+    # loose 'version' parsing accepts 2-segment + v-prefixed values
+    assert check_version_match("v1.2", ">= 1.0")
+    # strict semver requires MAJOR.MINOR.PATCH without prefix
+    assert not check_version_match("v1.2", ">= 1.0.0", strict_semver=True)
+    assert not check_version_match("1.2", ">= 1.0.0", strict_semver=True)
+    assert check_version_match("1.2.0", ">= 1.0.0", strict_semver=True)
+    # strict constraint side too
+    assert not check_version_match("1.2.0", ">= 1.0", strict_semver=True)
+
+
+def test_distinct_hosts_job_level_across_groups():
+    """Job-level distinct_hosts forbids co-location across task groups
+    within one batch (reference: feasible.go:475 job collision)."""
+    from nomad_tpu.solver.solve import Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+    from nomad_tpu.structs import Constraint, CONSTRAINT_DISTINCT_HOSTS
+    import copy
+
+    nodes = [mock.node() for _ in range(4)]
+    job = mock.job()
+    job.constraints.append(Constraint(operand=CONSTRAINT_DISTINCT_HOSTS))
+    tg1 = job.task_groups[0]
+    tg1.count = 2
+    for t in tg1.tasks:
+        t.resources.networks = []
+    tg2 = copy.deepcopy(tg1)
+    tg2.name = "api"
+    job.task_groups.append(tg2)
+    asks = [PlacementAsk(job=job, tg=tg1, count=2),
+            PlacementAsk(job=job, tg=tg2, count=2)]
+    out = Solver().solve(nodes, asks)
+    ids = [p.node.id for p in out.placements if p.node]
+    assert len(ids) == 4
+    assert len(set(ids)) == 4
+
+
+def test_distinct_property_missing_attr_infeasible():
+    """Nodes missing the distinct_property attribute are rejected
+    (reference: propertyset.go:240)."""
+    from nomad_tpu.solver.solve import Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+
+    nodes = [mock.node() for _ in range(2)]
+    nodes[0].meta["rack"] = "r1"
+    nodes[0].compute_class()
+    # nodes[1] has no rack meta
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    for t in tg.tasks:
+        t.resources.networks = []
+    ask = PlacementAsk(job=job, tg=tg, count=2,
+                       property_limits={"${meta.rack}": (1, {})})
+    out = Solver().solve(nodes, [ask])
+    placed = [p for p in out.placements if p.node]
+    assert len(placed) == 1
+    assert placed[0].node.id == nodes[0].id
