@@ -1,0 +1,7 @@
+"""Scheduling layer: reconciler, generic/system schedulers, harness.
+
+Reference analog: scheduler/ package (SURVEY §2.1). The placement solve
+itself lives in nomad_tpu.solver (the TPU plane); this package is the
+host-side behavior around it.
+"""
+from .base import new_scheduler  # noqa: F401

@@ -1,0 +1,36 @@
+"""Standalone feasibility-mask kernel.
+
+Computes only the static [G, N] feasibility mask (constraints + dc +
+host-evaluated ops) without the placement scan — used by the system
+scheduler, which forces placements onto specific nodes and only needs
+the mask (reference analog: feasible.go checks without rank/limit).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .kernel import _op_eval
+
+
+@jax.jit
+def _feas_kernel(valid, node_dc, attr_rank, dc_ok, host_ok, c_op, c_col,
+                 c_rank):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def per_ask(g):
+        vals = attr_rank[:, c_col[g]]
+        ok = _op_eval(vals, c_op[g], c_rank[g])
+        base = valid & dc_ok[g][node_dc] & host_ok[g]
+        return base & ok.all(axis=1)
+
+    Gp = c_op.shape[0]
+    return lax.map(per_ask, jnp.arange(Gp))
+
+
+def static_feasibility(pb) -> np.ndarray:
+    """[G, N] bool mask for a PackedBatch."""
+    out = _feas_kernel(pb.valid, pb.node_dc, pb.attr_rank, pb.dc_ok,
+                       pb.host_ok, pb.c_op, pb.c_col, pb.c_rank)
+    return np.asarray(out)

@@ -73,7 +73,8 @@ class Solver:
         dev_cache: Dict[int, DeviceAccounter] = {}
         host_used = pb.used0.copy()
         chosen_by_ask: Dict[int, set] = {}
-        prop_used: Dict[int, Dict[str, Dict[str, int]]] = {}
+        # distinct_property charges shared batch-wide by (scope, target) key
+        prop_used: Dict[tuple, Dict[str, int]] = {}
 
         placements: List[Placement] = []
         for p in range(pb.n_place):
@@ -105,7 +106,7 @@ class Solver:
                 gid = int(pb.distinct[g])
                 if gid >= 0 and ni in chosen_by_ask.get(gid, ()):
                     continue
-                prop_vals = self._property_fit(node, ask, prop_used.get(g))
+                prop_vals = self._property_fit(node, ask, prop_used)
                 if prop_vals is None:
                     continue
                 resources = self._host_commit(node, ni, ask, net_cache,
@@ -115,8 +116,8 @@ class Solver:
                 host_used[ni] += ask_vec
                 if gid >= 0:
                     chosen_by_ask.setdefault(gid, set()).add(ni)
-                for target, val in prop_vals:
-                    by_val = prop_used.setdefault(g, {}).setdefault(target, {})
+                for key, val in prop_vals:
+                    by_val = prop_used.setdefault(key, {})
                     by_val[val] = by_val.get(val, 0) + 1
                 m.score_meta = [
                     {"node_id": pb.node_ids[int(choice[p, j])],
@@ -210,27 +211,28 @@ class Solver:
 
     @staticmethod
     def _property_fit(node: Node, ask: PlacementAsk,
-                      used: Optional[Dict[str, Dict[str, int]]]):
+                      used: Dict[tuple, Dict[str, int]]):
         """Check distinct_property limits against existing + in-batch counts.
-        Returns the node's (target, value) pairs to charge on acceptance, or
+        Limits are keyed (scope, attr target); charges under one key are
+        shared across all asks carrying it (job-level scope spans the whole
+        batch). Returns the (key, value) pairs to charge on acceptance, or
         None if any property is at its limit."""
         if not ask.property_limits:
             return ()
         from ..structs import resolve_node_target
         out = []
-        for target, (limit, existing) in ask.property_limits.items():
+        for key, (limit, existing) in ask.property_limits.items():
+            target = key[1] if isinstance(key, tuple) else key
             val, ok = resolve_node_target(node, target)
             if not ok:
                 # nodes missing the property are infeasible for
                 # distinct_property (reference: propertyset.go:240)
                 return None
             val = str(val)
-            count = existing.get(val, 0)
-            if used:
-                count += used.get(target, {}).get(val, 0)
+            count = existing.get(val, 0) + used.get(key, {}).get(val, 0)
             if count + 1 > limit:
                 return None
-            out.append((target, val))
+            out.append((key, val))
         return out
 
     @staticmethod

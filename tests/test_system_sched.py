@@ -1,0 +1,178 @@
+"""SystemScheduler tests, mirroring key system_sched_test.go cases."""
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.structs import Constraint, EVAL_STATUS_COMPLETE
+
+
+def setup(h, n=5):
+    nodes = [mock.node() for _ in range(n)]
+    for node in nodes:
+        h.store.upsert_node(h.next_index(), node)
+    return nodes
+
+
+def register(h, job, trigger=structs.EVAL_TRIGGER_JOB_REGISTER):
+    h.store.upsert_job(h.next_index(), job)
+    ev = mock.eval_(job_id=job.id, type="system", triggered_by=trigger)
+    return ev
+
+
+def test_system_job_runs_on_every_node():
+    h = Harness()
+    nodes = setup(h, 5)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 5
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+    assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+
+
+def test_system_job_skips_infeasible_nodes():
+    h = Harness()
+    nodes = setup(h, 4)
+    # two nodes lack the required attribute value
+    for n in nodes[:2]:
+        n.attributes["kernel.name"] = "windows"
+        n.compute_class()
+        h.store.upsert_node(h.next_index(), n)
+    job = mock.system_job()   # constraint kernel.name = linux
+    ev = register(h, job)
+    h.process("system", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 2
+    placed_nodes = {a.node_id for a in allocs}
+    assert placed_nodes == {n.id for n in nodes[2:]}
+    # infeasible nodes recorded as failures
+    assert h.evals[-1].failed_tg_allocs
+
+
+def test_system_new_node_gets_alloc():
+    h = Harness()
+    setup(h, 2)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    assert len(h.store.allocs_by_job("default", job.id)) == 2
+
+    new_node = mock.node()
+    h.store.upsert_node(h.next_index(), new_node)
+    ev2 = mock.eval_(job_id=job.id, type="system",
+                     triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE)
+    h.process("system", ev2)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 3
+    assert any(a.node_id == new_node.id for a in allocs)
+
+
+def test_system_node_down_marks_lost():
+    h = Harness()
+    nodes = setup(h, 3)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    for a in h.store.allocs_by_job("default", job.id):
+        a.client_status = structs.ALLOC_CLIENT_RUNNING
+        h.store.upsert_allocs(h.next_index(), [a])
+
+    h.store.update_node_status(h.next_index(), nodes[0].id,
+                               structs.NODE_STATUS_DOWN)
+    ev2 = mock.eval_(job_id=job.id, type="system",
+                     triggered_by=structs.EVAL_TRIGGER_NODE_UPDATE)
+    h.process("system", ev2)
+    lost = [a for a in h.store.allocs_by_job("default", job.id)
+            if a.client_status == structs.ALLOC_CLIENT_LOST]
+    assert len(lost) == 1
+    assert lost[0].node_id == nodes[0].id
+
+
+def test_system_job_deregister_stops_all():
+    h = Harness()
+    setup(h, 3)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+
+    job2 = mock.system_job(id=job.id)
+    job2.stop = True
+    h.store.upsert_job(h.next_index(), job2)
+    ev2 = mock.eval_(job_id=job.id, type="system",
+                     triggered_by=structs.EVAL_TRIGGER_JOB_DEREGISTER)
+    h.process("system", ev2)
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.server_terminal_status()]
+    assert not live
+
+
+def test_system_job_update_replaces_in_place():
+    h = Harness()
+    setup(h, 3)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    before = {a.node_id for a in h.store.allocs_by_job("default", job.id)}
+    for a in h.store.allocs_by_job("default", job.id):
+        a.client_status = structs.ALLOC_CLIENT_RUNNING
+        h.store.upsert_allocs(h.next_index(), [a])
+
+    job2 = mock.system_job(id=job.id)
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    ev2 = register(h, job2, trigger=structs.EVAL_TRIGGER_JOB_REGISTER)
+    h.process("system", ev2)
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.server_terminal_status()]
+    assert len(live) == 3
+    assert {a.node_id for a in live} == before
+    # replacements reference the new job spec
+    assert all(a.job.task_groups[0].tasks[0].config ==
+               {"command": "/bin/other"} for a in live)
+
+
+def test_system_drain_stops_allocs():
+    h = Harness()
+    nodes = setup(h, 2)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    for a in h.store.allocs_by_job("default", job.id):
+        a.client_status = structs.ALLOC_CLIENT_RUNNING
+        h.store.upsert_allocs(h.next_index(), [a])
+
+    h.store.update_node_drain(h.next_index(), nodes[0].id,
+                              structs.DrainStrategy(), False)
+    ev2 = mock.eval_(job_id=job.id, type="system",
+                     triggered_by=structs.EVAL_TRIGGER_NODE_DRAIN)
+    h.process("system", ev2)
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.server_terminal_status()]
+    assert len(live) == 1
+    assert live[0].node_id == nodes[1].id
+
+
+def test_system_update_failure_keeps_old_alloc():
+    """If an updated spec no longer fits a node, the old alloc must keep
+    running (stop retracted; reference: Plan.PopUpdate)."""
+    h = Harness()
+    n = mock.node()
+    n.node_resources.cpu = 700     # fits 500-cpu task, not 600 + overhead
+    n.node_resources.memory_mb = 400
+    n.reserved_resources.cpu = 100
+    n.reserved_resources.memory_mb = 0
+    h.store.upsert_node(h.next_index(), n)
+    job = mock.system_job()
+    ev = register(h, job)
+    h.process("system", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 1
+    allocs[0].client_status = structs.ALLOC_CLIENT_RUNNING
+    h.store.upsert_allocs(h.next_index(), allocs)
+
+    job2 = mock.system_job(id=job.id)
+    job2.task_groups[0].tasks[0].resources.cpu = 900   # won't fit
+    ev2 = register(h, job2)
+    h.process("system", ev2)
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.server_terminal_status()]
+    assert len(live) == 1
+    assert live[0].id == allocs[0].id
