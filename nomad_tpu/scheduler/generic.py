@@ -502,6 +502,9 @@ class GenericScheduler:
         escaped = self._escaped or not self._class_eligibility
         blocked = self.eval.create_blocked_eval(
             self._class_eligibility, escaped, "")
+        # the scheduling snapshot's index, so BlockedEvals can detect
+        # capacity changes that raced this eval (missed-unblock check)
+        blocked.snapshot_index = getattr(self.snapshot, "index", 0)
         if planning_failure:
             blocked.triggered_by = EVAL_TRIGGER_MAX_PLANS
             blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC

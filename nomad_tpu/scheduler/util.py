@@ -145,8 +145,8 @@ def in_place_fits(snapshot, existing: Allocation, job: Job, tg: TaskGroup,
     inplaceUpdate — re-checks feasibility and fit against proposed state
     minus the alloc itself)."""
     from . import feasible as hostfeas
-    from ..structs import (AllocatedResources, AllocatedSharedResources,
-                           AllocatedTaskResources)
+    from ..solver.solve import Solver
+    from ..solver.tensorize import PlacementAsk
 
     node = snapshot.node_by_id(existing.node_id)
     if node is None:
@@ -162,49 +162,10 @@ def in_place_fits(snapshot, existing: Allocation, job: Job, tg: TaskGroup,
                 and a.id not in stopped and a.id != existing.id]
     proposed.extend(plan.node_allocation.get(node.id, []))
 
-    idx = NetworkIndex()
-    idx.set_node(node)
-    idx.add_allocs(proposed)
-    acct = DeviceAccounter(node)
-    acct.add_allocs(proposed)
-
-    out = AllocatedResources()
-    for t in tg.tasks:
-        tr = AllocatedTaskResources(cpu=t.resources.cpu,
-                                    memory_mb=t.resources.memory_mb)
-        for ask_net in t.resources.networks:
-            offer, _err = idx.assign_network(ask_net)
-            if offer is None:
-                return None
-            idx.add_reserved(offer)
-            tr.networks.append(offer)
-        for d in t.resources.devices:
-            placed = None
-            for dev in node.node_resources.devices:
-                dv, dt, dm = dev.id_tuple()
-                if not d.matches(dv, dt, dm):
-                    continue
-                free = acct.free_instances(dv, dt, dm)
-                if len(free) >= d.count:
-                    from ..structs import AllocatedDeviceResource
-                    placed = AllocatedDeviceResource(
-                        vendor=dv, type=dt, name=dm,
-                        device_ids=free[:d.count])
-                    acct.add_reserved(dv, dt, dm, placed.device_ids)
-                    break
-            if placed is None:
-                return None
-            tr.devices.append(placed)
-        out.tasks[t.name] = tr
-    shared_nets = []
-    for ask_net in tg.networks:
-        offer, _err = idx.assign_network(ask_net)
-        if offer is None:
-            return None
-        idx.add_reserved(offer)
-        shared_nets.append(offer)
-    out.shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb,
-                                          networks=shared_nets)
+    out = Solver._host_commit(node, 0, PlacementAsk(job=job, tg=tg, count=1),
+                              {}, {}, {node.id: proposed})
+    if out is None:
+        return None
 
     # total cpu/mem/disk must still fit alongside the other allocs
     from ..structs.funcs import allocs_fit

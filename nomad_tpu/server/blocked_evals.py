@@ -1,0 +1,195 @@
+"""BlockedEvals: evals that failed placement, waiting for capacity.
+
+Reference: nomad/blocked_evals.go — Block :166, class/quota-keyed Unblock
+:418, UnblockNode :501, missed-unblock index check :316, per-job dedup
+with duplicate surfacing :642.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
+
+
+class BlockedEvals:
+    def __init__(self, broker):
+        self._lock = threading.Lock()
+        self._broker = broker
+        self._enabled = False
+        self._captured: Dict[str, Evaluation] = {}
+        self._escaped: Dict[str, Evaluation] = {}
+        self._by_job: Dict[Tuple[str, str], str] = {}
+        self._by_node: Dict[str, List[str]] = {}   # system evals per node
+        self._node_of: Dict[str, str] = {}         # eval id -> node id
+        self._duplicates: List[Evaluation] = []
+        self._dup_event = threading.Event()
+        # class -> latest state index at which capacity changed; an eval
+        # blocked with an older snapshot may have missed that unblock
+        self._unblock_indexes: Dict[str, int] = {}
+        self._stats_blocked = 0
+        self._stats_escaped = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self._captured.clear()
+                self._escaped.clear()
+                self._by_job.clear()
+                self._by_node.clear()
+                self._duplicates.clear()
+                self._unblock_indexes.clear()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # --------------------------------------------------------------- block
+    def block(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            if ev.id in self._captured or ev.id in self._escaped:
+                return
+            namespaced = (ev.namespace, ev.job_id)
+            existing_id = self._by_job.get(namespaced)
+            if existing_id is not None and existing_id != ev.id:
+                # one blocked eval per job: newer wins, older surfaces as a
+                # duplicate for cancellation
+                old = self._captured.pop(existing_id, None) \
+                    or self._escaped.pop(existing_id, None)
+                if old is not None:
+                    self._scrub_node_locked(existing_id)
+                    self._duplicates.append(old)
+                    self._dup_event.set()
+            self._by_job[namespaced] = ev.id
+
+            # missed-unblock check: capacity may have changed between the
+            # scheduler's snapshot and now
+            if self._missed_unblock_locked(ev):
+                self._by_job.pop(namespaced, None)
+                self._broker.enqueue(_reset(ev))
+                return
+
+            if ev.escaped_computed_class or not ev.class_eligibility:
+                self._escaped[ev.id] = ev
+                self._stats_escaped += 1
+            else:
+                self._captured[ev.id] = ev
+                self._stats_blocked += 1
+            if ev.node_id:
+                self._by_node.setdefault(ev.node_id, []).append(ev.id)
+                self._node_of[ev.id] = ev.node_id
+
+    def _missed_unblock_locked(self, ev: Evaluation) -> bool:
+        if not ev.snapshot_index:
+            return False
+        for cls, index in self._unblock_indexes.items():
+            if index <= ev.snapshot_index:
+                continue
+            elig = ev.class_eligibility.get(cls)
+            if elig is None or elig:
+                # unseen or eligible class changed after our snapshot
+                return True
+            if ev.escaped_computed_class:
+                return True
+        return False
+
+    # ------------------------------------------------------------- unblock
+    def unblock(self, computed_class: str, index: int) -> None:
+        """Capacity changed on nodes of `computed_class` at state `index`."""
+        with self._lock:
+            if not self._enabled:
+                return
+            self._unblock_indexes[computed_class] = index
+            unblock: List[Evaluation] = []
+            for eid, ev in list(self._escaped.items()):
+                unblock.append(ev)
+                del self._escaped[eid]
+            for eid, ev in list(self._captured.items()):
+                elig = ev.class_eligibility.get(computed_class)
+                if elig is None or elig:
+                    unblock.append(ev)
+                    del self._captured[eid]
+            for ev in unblock:
+                self._by_job.pop((ev.namespace, ev.job_id), None)
+                self._scrub_node_locked(ev.id)
+        for ev in unblock:
+            self._broker.enqueue(_reset(ev))
+
+    def unblock_all(self, index: int) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            evs = list(self._captured.values()) + list(self._escaped.values())
+            self._captured.clear()
+            self._escaped.clear()
+            self._by_job.clear()
+            self._by_node.clear()
+            self._node_of.clear()
+        for ev in evs:
+            self._broker.enqueue(_reset(ev))
+
+    def unblock_node(self, node_id: str, index: int) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            ids = self._by_node.pop(node_id, [])
+            evs = []
+            for eid in ids:
+                self._node_of.pop(eid, None)
+                ev = self._captured.pop(eid, None) \
+                    or self._escaped.pop(eid, None)
+                if ev is not None:
+                    self._by_job.pop((ev.namespace, ev.job_id), None)
+                    evs.append(ev)
+        for ev in evs:
+            self._broker.enqueue(_reset(ev))
+
+    # ------------------------------------------------------------ plumbing
+    def untrack(self, namespace: str, job_id: str) -> None:
+        """Job deregistered: drop its blocked eval."""
+        with self._lock:
+            eid = self._by_job.pop((namespace, job_id), None)
+            if eid:
+                self._captured.pop(eid, None)
+                self._escaped.pop(eid, None)
+                self._scrub_node_locked(eid)
+
+    def _scrub_node_locked(self, eval_id: str) -> None:
+        nid = self._node_of.pop(eval_id, None)
+        if nid is None:
+            return
+        ids = self._by_node.get(nid)
+        if ids:
+            ids = [i for i in ids if i != eval_id]
+            if ids:
+                self._by_node[nid] = ids
+            else:
+                del self._by_node[nid]
+
+    def get_duplicates(self, timeout: float = 0.0) -> List[Evaluation]:
+        if timeout:
+            self._dup_event.wait(timeout)
+        with self._lock:
+            dups = self._duplicates
+            self._duplicates = []
+            self._dup_event.clear()
+            return dups
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_blocked": len(self._captured),
+                "total_escaped": len(self._escaped),
+            }
+
+
+def _reset(ev: Evaluation) -> Evaluation:
+    import copy
+    e = copy.copy(ev)
+    e.status = EVAL_STATUS_PENDING
+    e.status_description = ""
+    return e

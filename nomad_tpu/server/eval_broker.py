@@ -1,0 +1,324 @@
+"""EvalBroker: leader-only, at-least-once priority work queue for evals.
+
+Semantics mirror nomad/eval_broker.go — per-scheduler-type priority heaps
+(:65), per-job serialization so at most one eval per job is in flight
+(:277-297), blocking Dequeue (:329), Ack/Nack with nack-timer redelivery
+and a delivery limit that shunts flapping evals to a `_failed` queue
+(:23, :531, :595), and delayed evals via a wait-until heap (:89, :751).
+
+This is also the TPU batching point (SURVEY §2.5): `dequeue_batch` drains
+up to K ready evals of one scheduler type — each for a different job, by
+construction of the per-job serialization — so a worker can coalesce them
+into a single batched device solve.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..structs import EVAL_STATUS_PENDING, Evaluation
+from ..utils.ids import generate_uuid
+
+FAILED_QUEUE = "_failed"
+DEFAULT_NACK_DELAY_S = 5.0
+DEFAULT_INITIAL_NACK_DELAY_S = 1.0
+DEFAULT_DELIVERY_LIMIT = 3
+
+
+class _Heap:
+    """Max-priority heap with FIFO tie-break."""
+
+    def __init__(self) -> None:
+        self._h: List[tuple] = []
+        self._count = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._h, (-ev.priority, next(self._count), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._h:
+            return None
+        return heapq.heappop(self._h)[2]
+
+    def peek_priority(self) -> Optional[int]:
+        if not self._h:
+            return None
+        return -self._h[0][0]
+
+    def __len__(self) -> int:
+        return len(self._h)
+
+
+class _Unack:
+    def __init__(self, ev: Evaluation, token: str):
+        self.eval = ev
+        self.token = token
+        self.nack_timer: Optional[threading.Timer] = None
+
+
+class EvalBroker:
+    def __init__(self, nack_delay_s: float = DEFAULT_NACK_DELAY_S,
+                 initial_nack_delay_s: float = DEFAULT_INITIAL_NACK_DELAY_S,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+        self._lock = threading.Condition()
+        self._enabled = False
+        self._ready: Dict[str, _Heap] = {}
+        self._unack: Dict[str, _Unack] = {}
+        self._job_evals: Dict[Tuple[str, str], str] = {}   # (ns, job) -> eval
+        self._blocked: Dict[Tuple[str, str], _Heap] = {}   # per-job backlog
+        self._requeue: Dict[str, Evaluation] = {}  # token-gated re-enqueue
+        self._waiting: Dict[str, Evaluation] = {}  # delayed (wait_until)
+        self._delay_heap: List[tuple] = []
+        self._dequeues = 0
+        self._nacks = 0
+        self.nack_delay_s = nack_delay_s
+        self.initial_nack_delay_s = initial_nack_delay_s
+        self.delivery_limit = delivery_limit
+        self._deliveries: Dict[str, int] = {}
+        self._delay_thread: Optional[threading.Thread] = None
+        self._stop_delay = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev = self._enabled
+            self._enabled = enabled
+        if prev and not enabled:
+            self.flush()
+        if enabled and not prev:
+            self._stop_delay.clear()
+            self._delay_thread = threading.Thread(
+                target=self._run_delayed_watcher, daemon=True)
+            self._delay_thread.start()
+        if not enabled:
+            self._stop_delay.set()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def flush(self) -> None:
+        with self._lock:
+            for u in self._unack.values():
+                if u.nack_timer:
+                    u.nack_timer.cancel()
+            self._ready.clear()
+            self._unack.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._requeue.clear()
+            self._waiting.clear()
+            self._delay_heap.clear()
+            self._deliveries.clear()
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------- enqueue
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(ev, ev.type)
+
+    def enqueue_all(self, evals: Dict[Evaluation, str]) -> None:
+        """Enqueue evals, re-enqueueing those we hold unacked (token map
+        eval -> token proves ownership)."""
+        with self._lock:
+            for ev, token in evals.items():
+                if token:
+                    self._process_waiting_enqueue_locked(ev, token)
+                else:
+                    self._enqueue_locked(ev, ev.type)
+
+    def _process_waiting_enqueue_locked(self, ev: Evaluation,
+                                        token: str) -> None:
+        u = self._unack.get(ev.id)
+        if u is not None and u.token == token:
+            self._requeue[ev.id] = ev
+        else:
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        if ev.id in self._unack or ev.id in self._waiting:
+            return
+        if ev.wait_until and ev.wait_until > _time.time():
+            self._waiting[ev.id] = ev
+            heapq.heappush(self._delay_heap, (ev.wait_until, ev.id))
+            self._lock.notify_all()
+            return
+        namespaced = (ev.namespace, ev.job_id)
+        if queue != FAILED_QUEUE and ev.job_id:
+            holder = self._job_evals.get(namespaced)
+            if holder is not None and holder != ev.id:
+                self._blocked.setdefault(namespaced, _Heap()).push(ev)
+                return
+            self._job_evals[namespaced] = ev.id
+        self._ready.setdefault(queue, _Heap()).push(ev)
+        self._lock.notify_all()
+
+    # ------------------------------------------------------------- dequeue
+    def dequeue(self, sched_types: Sequence[str], timeout: float = 0.0
+                ) -> Tuple[Optional[Evaluation], str]:
+        deadline = _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ev = self._dequeue_locked(sched_types)
+                if ev is not None:
+                    token = generate_uuid()
+                    u = _Unack(ev, token)
+                    self._unack[ev.id] = u
+                    self._deliveries[ev.id] = \
+                        self._deliveries.get(ev.id, 0) + 1
+                    self._dequeues += 1
+                    self._start_nack_timer(u)
+                    return ev, token
+                remain = deadline - _time.monotonic()
+                if remain <= 0 or not self._enabled:
+                    return None, ""
+                self._lock.wait(remain)
+
+    def dequeue_batch(self, sched_types: Sequence[str], max_batch: int,
+                      timeout: float = 0.0
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Drain up to max_batch ready evals (the TPU coalescing point).
+        Blocks for the first eval only; the rest are taken opportunistically."""
+        first, token = self.dequeue(sched_types, timeout)
+        if first is None:
+            return []
+        out = [(first, token)]
+        while len(out) < max_batch:
+            ev, tok = self.dequeue(sched_types, 0.0)
+            if ev is None:
+                break
+            out.append((ev, tok))
+        return out
+
+    def _dequeue_locked(self, sched_types: Sequence[str]
+                        ) -> Optional[Evaluation]:
+        best_q, best_pri = None, None
+        for q in sched_types:
+            h = self._ready.get(q)
+            if h is None or not len(h):
+                continue
+            pri = h.peek_priority()
+            if best_pri is None or pri > best_pri:
+                best_q, best_pri = q, pri
+        if best_q is None:
+            return None
+        return self._ready[best_q].pop()
+
+    def _start_nack_timer(self, u: _Unack) -> None:
+        t = threading.Timer(self.nack_delay_s,
+                            self._nack_timeout, args=(u.eval.id, u.token))
+        t.daemon = True
+        u.nack_timer = t
+        t.start()
+
+    def _nack_timeout(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            u = self._unack.get(eval_id)
+            if u is None or u.token != token:
+                return
+        self.nack(eval_id, token)
+
+    # ------------------------------------------------------------ ack/nack
+    def ack(self, eval_id: str, token: str) -> Optional[str]:
+        with self._lock:
+            u = self._unack.get(eval_id)
+            if u is None or u.token != token:
+                return "token mismatch"
+            if u.nack_timer:
+                u.nack_timer.cancel()
+            del self._unack[eval_id]
+            self._deliveries.pop(eval_id, None)
+            ev = u.eval
+            self._release_job_slot_locked(ev, eval_id)
+            requeue = self._requeue.pop(eval_id, None)
+            if requeue is not None:
+                self._enqueue_locked(requeue, requeue.type)
+            return None
+
+    def _release_job_slot_locked(self, ev: Evaluation,
+                                 eval_id: str) -> None:
+        """Free the job's serialization slot and promote its next blocked
+        eval, if any."""
+        namespaced = (ev.namespace, ev.job_id)
+        if self._job_evals.get(namespaced) != eval_id:
+            return
+        del self._job_evals[namespaced]
+        backlog = self._blocked.get(namespaced)
+        if backlog is not None and len(backlog):
+            nxt = backlog.pop()
+            if not len(backlog):
+                del self._blocked[namespaced]
+            self._job_evals[namespaced] = nxt.id
+            self._ready.setdefault(nxt.type, _Heap()).push(nxt)
+            self._lock.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> Optional[str]:
+        with self._lock:
+            u = self._unack.get(eval_id)
+            if u is None or u.token != token:
+                return "token mismatch"
+            if u.nack_timer:
+                u.nack_timer.cancel()
+            del self._unack[eval_id]
+            self._requeue.pop(eval_id, None)
+            self._nacks += 1
+            ev = u.eval
+            self._release_job_slot_locked(ev, eval_id)
+            if self._deliveries.get(eval_id, 0) >= self.delivery_limit:
+                # too many failed deliveries: park it for the leader reaper
+                self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
+                self._lock.notify_all()
+                return None
+            # redeliver after a compounding delay
+            delay = (self.initial_nack_delay_s
+                     * max(1, self._deliveries.get(eval_id, 1)))
+            ev2 = ev
+            deadline = _time.time() + delay
+            self._waiting[ev2.id] = ev2
+            heapq.heappush(self._delay_heap, (deadline, ev2.id))
+            self._lock.notify_all()
+            return None
+
+    # ------------------------------------------------------ delayed watcher
+    def _run_delayed_watcher(self) -> None:
+        while not self._stop_delay.is_set():
+            with self._lock:
+                now = _time.time()
+                wait = 0.1
+                while self._delay_heap and self._delay_heap[0][0] <= now:
+                    _, eid = heapq.heappop(self._delay_heap)
+                    ev = self._waiting.pop(eid, None)
+                    if ev is not None:
+                        ev2 = ev
+                        if ev2.wait_until:
+                            import copy
+                            ev2 = copy.copy(ev)
+                            ev2.wait_until = 0.0
+                        self._enqueue_locked(ev2, ev2.type)
+                if self._delay_heap:
+                    wait = min(wait, max(0.0,
+                                         self._delay_heap[0][0] - now))
+            self._stop_delay.wait(max(wait, 0.01))
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_ready": sum(len(h) for h in self._ready.values()),
+                "total_unacked": len(self._unack),
+                "total_blocked": sum(len(h) for h in self._blocked.values()),
+                "total_waiting": len(self._waiting),
+                "by_scheduler": {q: len(h) for q, h in self._ready.items()},
+                "dequeues": self._dequeues,
+                "nacks": self._nacks,
+            }
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            u = self._unack.get(eval_id)
+            return u.token if u else None

@@ -1,0 +1,161 @@
+"""The plan applier — the single serialization point of the control plane.
+
+Workers plan optimistically against snapshots; this component re-validates
+every plan against the LATEST state before commit, dropping per-node
+placements that no longer fit, and hands partial committers a refresh
+index so they retry against fresh data.
+
+Reference: nomad/plan_apply.go — planApply loop :71-178, evaluatePlan
+:399, evaluatePlanPlacements :436 (per-node fit re-check with partial
+commit + RefreshIndex :568-584), evaluateNodePlan :628, applyPlan :204.
+The reference fans per-node checks over an EvaluatePool of NumCPU/2
+goroutines; here a single pass suffices because the fit check itself is
+vector math (structs.funcs.allocs_fit), and the TPU batch already did
+the heavy scoring.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..structs import (ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED,
+                       EVAL_TRIGGER_PREEMPTION, Allocation, Evaluation, Plan,
+                       PlanResult)
+from ..structs.funcs import allocs_fit
+from .plan_queue import PendingPlan, PlanQueue
+
+# applier callback: (plan, result) -> commit index. In the single-server
+# build this writes the state store directly; under raft it is the
+# ApplyPlanResults log entry.
+ApplyFn = Callable[[Plan, PlanResult], int]
+
+
+def evaluate_node_plan(snapshot, plan: Plan, node_id: str
+                       ) -> Tuple[bool, str]:
+    """Can this node accommodate the plan's allocations for it?
+    (reference: plan_apply.go:628)."""
+    new_allocs = plan.node_allocation.get(node_id, [])
+    if not new_allocs:
+        return True, ""
+    node = snapshot.node_by_id(node_id)
+    if node is None:
+        return False, "node does not exist"
+    if node.terminal_status():
+        return False, "node is not ready for placements"
+    if node.drain or not node.ready():
+        return False, "node is not eligible"
+
+    existing = [a for a in snapshot.allocs_by_node(node_id)
+                if not a.terminal_status()]
+    remove_ids = {a.id for a in plan.node_update.get(node_id, [])}
+    remove_ids.update(a.id for a in plan.node_preemptions.get(node_id, []))
+    proposed = [a for a in existing if a.id not in remove_ids]
+    # an update of an existing alloc replaces it
+    new_ids = {a.id for a in new_allocs}
+    proposed = [a for a in proposed if a.id not in new_ids]
+    proposed.extend(new_allocs)
+
+    fit, reason, _used = allocs_fit(node, proposed, check_devices=True)
+    if not fit:
+        return False, reason or "does not fit"
+    return True, ""
+
+
+def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
+    """Re-check the whole plan against `snapshot`, keeping only nodes that
+    still fit; partial results carry a refresh index."""
+    result = PlanResult(
+        node_update=dict(plan.node_update),
+        node_preemptions=dict(plan.node_preemptions),
+        deployment=plan.deployment,
+        deployment_updates=list(plan.deployment_updates))
+
+    if plan.all_at_once:
+        # all-or-nothing: any failing node voids every placement
+        for node_id in plan.node_allocation:
+            ok, _why = evaluate_node_plan(snapshot, plan, node_id)
+            if not ok:
+                result.node_allocation = {}
+                result.deployment = None
+                result.deployment_updates = []
+                result.refresh_index = snapshot.latest_index() \
+                    if hasattr(snapshot, "latest_index") else snapshot.index
+                return result
+        result.node_allocation = dict(plan.node_allocation)
+        return result
+
+    partial = False
+    for node_id in plan.node_allocation:
+        ok, _why = evaluate_node_plan(snapshot, plan, node_id)
+        if ok:
+            result.node_allocation[node_id] = plan.node_allocation[node_id]
+        else:
+            partial = True
+    if partial:
+        result.refresh_index = max(snapshot.table_index("nodes"),
+                                   snapshot.table_index("allocs"))
+        # a partial commit voids the deployment objects — the scheduler
+        # recreates them on retry (reference: plan_apply.go:560-566)
+        result.deployment = None
+        result.deployment_updates = []
+    return result
+
+
+class PlanApplier:
+    """Owns the applier loop: dequeue pending plan -> evaluate -> apply."""
+
+    def __init__(self, queue: PlanQueue, store, apply_fn: ApplyFn,
+                 create_evals: Optional[Callable[[List[Evaluation]], None]]
+                 = None):
+        self.queue = queue
+        self.store = store
+        self.apply_fn = apply_fn
+        self.create_evals = create_evals
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(0.2)
+            if pending is None:
+                continue
+            try:
+                self.apply_one(pending)
+            except Exception as e:   # keep the applier alive
+                pending.future.respond(None, f"plan apply error: {e}")
+
+    def apply_one(self, pending: PendingPlan) -> None:
+        plan = pending.plan
+        snapshot = self.store.snapshot()
+        result = evaluate_plan(snapshot, plan)
+        if result.is_no_op() and not result.refresh_index:
+            pending.future.respond(result, None)
+            return
+        index = self.apply_fn(plan, result)
+        result.alloc_index = index
+
+        # preempted allocs need follow-up evals for their jobs
+        if self.create_evals and plan.node_preemptions:
+            preempted_jobs = {}
+            for allocs in plan.node_preemptions.values():
+                for a in allocs:
+                    preempted_jobs[(a.namespace, a.job_id)] = a
+            evals = []
+            for (ns, job_id), a in preempted_jobs.items():
+                evals.append(Evaluation(
+                    namespace=ns, job_id=job_id,
+                    type=a.job.type if a.job else "service",
+                    priority=a.job.priority if a.job else 50,
+                    triggered_by=EVAL_TRIGGER_PREEMPTION))
+            self.create_evals(evals)
+        pending.future.respond(result, None)

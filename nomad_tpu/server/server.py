@@ -1,0 +1,248 @@
+"""Single-process server: state store + broker + workers + plan applier.
+
+This is the control-plane container (reference: nomad/server.go Server +
+the FSM apply paths in nomad/fsm.go). In this build the replicated log is
+an in-process critical section (`_apply` bumps a monotonic index and
+writes the store — the same contract raft's FSM apply gives the
+reference); the raft transport drops in underneath later without
+touching the layers above (SURVEY §7.2 step 6).
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, Iterable, List, Optional
+
+from ..scheduler.util import tainted_nodes
+from ..state.store import StateStore
+from ..structs import (ALLOC_CLIENT_FAILED, EVAL_STATUS_PENDING,
+                       EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_JOB_REGISTER,
+                       EVAL_TRIGGER_NODE_UPDATE,
+                       EVAL_TRIGGER_RETRY_FAILED_ALLOC, JOB_TYPE_CORE,
+                       JOB_TYPE_SERVICE, NODE_STATUS_DOWN, NODE_STATUS_READY,
+                       SCHEDULERS, Allocation, Evaluation, Job, Node, Plan,
+                       PlanResult)
+from ..utils.ids import generate_uuid
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .worker import Worker
+
+
+class Server:
+    def __init__(self, num_workers: int = 2,
+                 enabled_schedulers: Optional[List[str]] = None,
+                 batch_size: int = 8):
+        self.store = StateStore()
+        self.broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self.broker)
+        self.plan_queue = PlanQueue()
+        self.batch_size = batch_size
+        self._apply_lock = threading.Lock()
+        self.planner = PlanApplier(self.plan_queue, self.store,
+                                   self._apply_plan, self._create_evals)
+        self.enabled_schedulers = enabled_schedulers or [
+            s for s in SCHEDULERS if s != JOB_TYPE_CORE]
+        self.workers = [Worker(self, self.enabled_schedulers)
+                        for _ in range(num_workers)]
+        self._started = False
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Establish leadership: enable leader-only services + workers
+        (reference: leader.go:197 establishLeadership)."""
+        self.broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.planner.start()
+        for w in self.workers:
+            w.start()
+        self._started = True
+        self._restore_evals()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            w.shutdown()
+        self.planner.stop()
+        self.plan_queue.set_enabled(False)
+        self.broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self._started = False
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals from state (leader.go:245)."""
+        for ev in list(self.store.evals()):
+            if ev.should_enqueue():
+                self.broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    # -------------------------------------------------------- write paths
+    def _next_index(self) -> int:
+        return self.store.latest_index() + 1
+
+    def register_node(self, node: Node) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            existing = self.store.node_by_id(node.id)
+            self.store.upsert_node(index, node)
+        # new capacity unblocks waiters keyed by the node's class
+        if node.ready():
+            self.blocked_evals.unblock(node.computed_class, index)
+        if existing is None and node.ready():
+            self._create_node_evals_for_system_jobs(node, index)
+        return index
+
+    def update_node_status(self, node_id: str, status: str) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_node_status(index, node_id, status)
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            return index
+        if status == NODE_STATUS_DOWN:
+            self._create_node_evals(node, index)
+        elif status == NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class, index)
+            self._create_node_evals_for_system_jobs(node, index)
+        return index
+
+    def update_node_drain(self, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_node_drain(index, node_id, drain_strategy,
+                                         mark_eligible)
+        node = self.store.node_by_id(node_id)
+        if node is not None:
+            self._create_node_evals(node, index)
+        return index
+
+    def register_job(self, job: Job) -> Evaluation:
+        job.canonicalize()
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.upsert_job(index, job)
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            job_modify_index=job.modify_index, status=EVAL_STATUS_PENDING)
+        self._create_evals([ev])
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str,
+                       purge: bool = False) -> Optional[Evaluation]:
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        with self._apply_lock:
+            index = self._next_index()
+            if purge:
+                self.store.delete_job(index, namespace, job_id)
+            else:
+                import copy
+                j2 = copy.copy(job)
+                j2.stop = True
+                self.store.upsert_job(index, j2)
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace, priority=job.priority, type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_DEREGISTER, job_id=job_id,
+            status=EVAL_STATUS_PENDING)
+        self._create_evals([ev])
+        return ev
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> int:
+        """Client status sync (reference: node_endpoint.go:1063
+        Node.UpdateAlloc -> fsm.go:749)."""
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_allocs_from_client(index, updates)
+        evals: List[Evaluation] = []
+        unblock_nodes = set()
+        for upd in updates:
+            alloc = self.store.alloc_by_id(upd.id)
+            if alloc is None:
+                continue
+            if alloc.client_terminal_status():
+                unblock_nodes.add(alloc.node_id)
+            # failed allocs trigger a reschedule eval
+            if upd.client_status == ALLOC_CLIENT_FAILED and alloc.job:
+                tg = alloc.job.lookup_task_group(alloc.task_group)
+                policy = tg.reschedule_policy if tg else None
+                if policy and (policy.unlimited or policy.attempts > 0):
+                    evals.append(Evaluation(
+                        namespace=alloc.namespace, type=alloc.job.type,
+                        priority=alloc.job.priority, job_id=alloc.job_id,
+                        triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                        status=EVAL_STATUS_PENDING))
+        if evals:
+            self._create_evals(evals)
+        for nid in unblock_nodes:
+            node = self.store.node_by_id(nid)
+            if node is not None:
+                self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    # ----------------------------------------------------- eval plumbing
+    def _create_evals(self, evals: List[Evaluation]) -> None:
+        """Raft-apply eval upserts, then route to broker / blocked list
+        (reference: fsm.go:680 handleUpsertedEval)."""
+        if not evals:
+            return
+        with self._apply_lock:
+            index = self._next_index()
+            for ev in evals:
+                if not ev.create_time:
+                    ev.create_time = _time.time()
+                ev.modify_time = _time.time()
+                ev.snapshot_index = ev.snapshot_index or index
+            self.store.upsert_evals(index, list(evals))
+        for ev in evals:
+            if ev.should_enqueue():
+                self.broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def upsert_evals(self, evals: List[Evaluation]) -> None:
+        self._create_evals(evals)
+
+    def _create_node_evals(self, node: Node, index: int) -> None:
+        """One eval per job with allocs on the node, plus system jobs
+        (reference: node_endpoint.go:1348 createNodeEvals)."""
+        evals: List[Evaluation] = []
+        seen = set()
+        for a in self.store.allocs_by_node(node.id):
+            key = (a.namespace, a.job_id)
+            if key in seen or a.terminal_status():
+                continue
+            seen.add(key)
+            job = a.job or self.store.job_by_id(*key)
+            evals.append(Evaluation(
+                namespace=a.namespace, job_id=a.job_id,
+                type=job.type if job else JOB_TYPE_SERVICE,
+                priority=job.priority if job else 50,
+                triggered_by=EVAL_TRIGGER_NODE_UPDATE, node_id=node.id,
+                node_modify_index=node.modify_index,
+                status=EVAL_STATUS_PENDING))
+        self._create_evals(evals)
+
+    def _create_node_evals_for_system_jobs(self, node: Node,
+                                           index: int) -> None:
+        evals = []
+        for job in self.store.jobs():
+            if job.is_system() and not job.stopped():
+                evals.append(Evaluation(
+                    namespace=job.namespace, job_id=job.id, type=job.type,
+                    priority=job.priority,
+                    triggered_by=EVAL_TRIGGER_NODE_UPDATE, node_id=node.id,
+                    status=EVAL_STATUS_PENDING))
+        self._create_evals(evals)
+
+    # ------------------------------------------------------- plan applier
+    def _apply_plan(self, plan: Plan, result: PlanResult) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.upsert_plan_results(index, result, plan.job)
+        return index

@@ -1,0 +1,87 @@
+"""Scheduler worker: dequeue evals, invoke the scheduler, submit plans.
+
+Reference: nomad/worker.go — run loop :105, dequeueEvaluation :142,
+snapshotMinIndex wait :228, invokeScheduler :244, SubmitPlan :277 with
+refresh-on-partial-commit :309. The worker is also the scheduler's
+Planner (scheduler/scheduler.go:106).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..scheduler.base import new_scheduler
+from ..structs import Evaluation, Plan, PlanResult
+
+DEQUEUE_TIMEOUT_S = 0.2
+
+
+class Worker(threading.Thread):
+    def __init__(self, server, sched_types: List[str]):
+        super().__init__(daemon=True)
+        self.server = server
+        self.sched_types = list(sched_types)
+        self._shutdown = threading.Event()
+        self.paused = threading.Event()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    def run(self) -> None:
+        while not self._shutdown.is_set():
+            if self.paused.is_set():
+                self._shutdown.wait(0.1)
+                continue
+            ev, token = self.server.broker.dequeue(self.sched_types,
+                                                   DEQUEUE_TIMEOUT_S)
+            if ev is None:
+                continue
+            try:
+                self._process(ev, token)
+            except Exception:
+                # a poisoned eval must not kill the worker; the nack path
+                # redelivers it until the delivery limit parks it
+                pass
+
+    def _process(self, ev: Evaluation, token: str) -> None:
+        server = self.server
+        # wait for local state to reach the eval's creation point
+        wait_index = max(ev.modify_index, ev.snapshot_index)
+        server.store.wait_for_index(wait_index, timeout=5.0)
+        try:
+            sched = new_scheduler(ev.type, server.store, self)
+            err = sched.process(ev)
+        except Exception as e:
+            server.broker.nack(ev.id, token)
+            err = str(e)
+            return
+        if err is not None:
+            server.broker.nack(ev.id, token)
+        else:
+            server.broker.ack(ev.id, token)
+
+    # ---------------------------------------------------- Planner interface
+    def submit_plan(self, plan: Plan
+                    ) -> Tuple[Optional[PlanResult], Optional[object]]:
+        pending = self.server.plan_queue.enqueue(plan)
+        if pending is None:
+            return None, None
+        result, err = pending.future.wait(30.0)
+        if err is not None or result is None:
+            return None, None
+        if result.refresh_index:
+            # partial commit: catch up past the conflicting writes and hand
+            # the scheduler a fresh snapshot to retry against
+            self.server.store.wait_for_index(result.refresh_index,
+                                             timeout=5.0)
+            return result, self.server.store.snapshot()
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.upsert_evals([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.server.upsert_evals([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)

@@ -150,7 +150,8 @@ class Solver:
         return SolveOutput(placements=placements,
                            class_eligibility=class_elig)
 
-    def _host_commit(self, node: Node, node_ix: int, ask: PlacementAsk,
+    @staticmethod
+    def _host_commit(node: Node, node_ix: int, ask: PlacementAsk,
                      net_cache: Dict[int, NetworkIndex],
                      dev_cache: Dict[int, DeviceAccounter],
                      allocs_by_node) -> Optional[AllocatedResources]:
@@ -189,7 +190,7 @@ class Solver:
                 idx.add_reserved(offer)
                 tr.networks.append(offer)
             for d in t.resources.devices:
-                got = self._assign_devices(acct, node, d)
+                got = Solver._assign_devices(acct, node, d)
                 if got is None:
                     return None
                 acct.add_reserved(got.vendor, got.type, got.name,

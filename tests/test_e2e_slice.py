@@ -1,0 +1,165 @@
+"""Minimum end-to-end slice (SURVEY §7.2 step 6): submit job -> eval ->
+TPU solve -> plan -> apply -> sim client runs the task."""
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.sim import SimClient, wait_until
+from nomad_tpu.server.server import Server
+
+
+@pytest.fixture
+def cluster():
+    server = Server(num_workers=2)
+    server.start()
+    clients = []
+    for _ in range(4):
+        c = SimClient(server, mock.node())
+        c.start()
+        clients.append(c)
+    yield server, clients
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+def live_allocs(server, job_id, status=None):
+    out = [a for a in server.store.allocs_by_job("default", job_id)
+           if not a.server_terminal_status()]
+    if status:
+        out = [a for a in out if a.client_status == status]
+    return out
+
+
+def test_service_job_end_to_end(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 4
+    server.register_job(job)
+    assert wait_until(lambda: len(live_allocs(
+        server, job.id, structs.ALLOC_CLIENT_RUNNING)) == 4, timeout=10)
+    ev = server.store.evals_by_job("default", job.id)[0]
+    assert wait_until(lambda: server.store.eval_by_id(ev.id).status
+                      == structs.EVAL_STATUS_COMPLETE, timeout=5)
+
+
+def test_batch_job_completes(cluster):
+    server, clients = cluster
+    job = mock.batch_job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].tasks[0].config = {"mock_outcome": "complete",
+                                          "mock_runtime_s": 0.05}
+    server.register_job(job)
+    assert wait_until(lambda: len([
+        a for a in server.store.allocs_by_job("default", job.id)
+        if a.client_status == structs.ALLOC_CLIENT_COMPLETE]) == 3,
+        timeout=10)
+    # completed batch allocs are not replaced
+    import time
+    time.sleep(0.3)
+    assert len(server.store.allocs_by_job("default", job.id)) == 3
+
+
+def test_failed_alloc_rescheduled(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy = structs.ReschedulePolicy(
+        unlimited=True, delay_s=0, delay_function="constant")
+    job.task_groups[0].tasks[0].config = {"mock_outcome": "fail",
+                                          "mock_runtime_s": 0.05}
+    server.register_job(job)
+    # the failed alloc gets a replacement chained to it
+    assert wait_until(lambda: any(
+        a.previous_allocation
+        for a in server.store.allocs_by_job("default", job.id)), timeout=10)
+
+
+def test_node_down_triggers_replacement(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].reschedule_policy = structs.ReschedulePolicy(
+        unlimited=True, delay_s=0, delay_function="constant")
+    server.register_job(job)
+    assert wait_until(lambda: len(live_allocs(
+        server, job.id, structs.ALLOC_CLIENT_RUNNING)) == 4, timeout=10)
+
+    victim_alloc = live_allocs(server, job.id)[0]
+    victim_node = victim_alloc.node_id
+    for c in clients:
+        if c.node.id == victim_node:
+            c.stop()
+    server.update_node_status(victim_node, structs.NODE_STATUS_DOWN)
+
+    def replaced():
+        live = live_allocs(server, job.id)
+        return (len([a for a in live
+                     if a.node_id != victim_node
+                     and not a.client_terminal_status()]) == 4)
+    assert wait_until(replaced, timeout=10)
+
+
+def test_job_update_rolls(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].update = structs.UpdateStrategy(max_parallel=4)
+    server.register_job(job)
+    assert wait_until(lambda: len(live_allocs(
+        server, job.id, structs.ALLOC_CLIENT_RUNNING)) == 4, timeout=10)
+
+    job2 = mock.job(id=job.id)
+    job2.task_groups[0].count = 4
+    job2.task_groups[0].update = structs.UpdateStrategy(max_parallel=4)
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/v2"}
+    server.register_job(job2)
+
+    def updated():
+        live = [a for a in live_allocs(server, job.id,
+                                       structs.ALLOC_CLIENT_RUNNING)
+                if a.job and a.job.task_groups[0].tasks[0].config
+                == {"command": "/bin/v2"}]
+        return len(live) == 4
+    assert wait_until(updated, timeout=10)
+    # a deployment tracked the rollout
+    assert server.store.deployments_by_job("default", job.id)
+
+
+def test_system_job_covers_new_node(cluster):
+    server, clients = cluster
+    job = mock.system_job()
+    server.register_job(job)
+    assert wait_until(lambda: len(live_allocs(
+        server, job.id, structs.ALLOC_CLIENT_RUNNING)) == 4, timeout=10)
+
+    extra = SimClient(server, mock.node())
+    extra.start()
+    try:
+        assert wait_until(lambda: len(live_allocs(
+            server, job.id, structs.ALLOC_CLIENT_RUNNING)) == 5, timeout=10)
+    finally:
+        extra.stop()
+
+
+def test_blocked_eval_unblocks_on_capacity(cluster):
+    server, clients = cluster
+    job = mock.job()
+    job.task_groups[0].count = 30     # exceeds 4-node capacity
+    for t in job.task_groups[0].tasks:
+        t.resources.networks = []
+        t.resources.cpu = 600
+    server.register_job(job)
+    assert wait_until(
+        lambda: server.blocked_evals.stats()["total_blocked"]
+        + server.blocked_evals.stats()["total_escaped"] > 0, timeout=10)
+    placed_before = len(live_allocs(server, job.id))
+    assert placed_before < 30
+
+    # add capacity: the blocked eval should fire and place more
+    extra = SimClient(server, mock.node())
+    extra.start()
+    try:
+        assert wait_until(lambda: len(live_allocs(server, job.id))
+                          > placed_before, timeout=10)
+    finally:
+        extra.stop()

@@ -1,0 +1,294 @@
+"""Eval broker, blocked evals, plan queue, plan applier tests
+(reference: nomad/{eval_broker,blocked_evals,plan_apply}_test.go)."""
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.server.plan_apply import PlanApplier, evaluate_plan
+from nomad_tpu.server.plan_queue import PlanQueue
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import Plan, PlanResult
+
+
+def make_broker(**kw):
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+def test_broker_priority_order():
+    b = make_broker()
+    lo = mock.eval_(priority=10)
+    hi = mock.eval_(priority=90)
+    b.enqueue(lo)
+    b.enqueue(hi)
+    ev1, t1 = b.dequeue(["service"], 1.0)
+    assert ev1.id == hi.id
+    ev2, t2 = b.dequeue(["service"], 1.0)
+    assert ev2.id == lo.id
+    assert b.ack(ev1.id, t1) is None
+    assert b.ack(ev2.id, t2) is None
+
+
+def test_broker_per_job_serialization():
+    b = make_broker()
+    e1 = mock.eval_(job_id="job-1")
+    e2 = mock.eval_(job_id="job-1")
+    b.enqueue(e1)
+    b.enqueue(e2)
+    ev, token = b.dequeue(["service"], 1.0)
+    assert ev.id == e1.id
+    # second eval for the same job is held back
+    none, _ = b.dequeue(["service"], 0.05)
+    assert none is None
+    b.ack(e1.id, token)
+    ev2, t2 = b.dequeue(["service"], 1.0)
+    assert ev2.id == e2.id
+    b.ack(e2.id, t2)
+
+
+def test_broker_type_routing():
+    b = make_broker()
+    svc = mock.eval_(type="service")
+    batch = mock.eval_(type="batch")
+    b.enqueue(svc)
+    b.enqueue(batch)
+    ev, t = b.dequeue(["batch"], 1.0)
+    assert ev.id == batch.id
+    b.ack(ev.id, t)
+    ev2, t2 = b.dequeue(["service", "batch"], 1.0)
+    assert ev2.id == svc.id
+    b.ack(ev2.id, t2)
+
+
+def test_broker_nack_redelivers():
+    b = make_broker(initial_nack_delay_s=0.05)
+    e = mock.eval_()
+    b.enqueue(e)
+    ev, token = b.dequeue(["service"], 1.0)
+    b.nack(ev.id, token)
+    ev2, t2 = b.dequeue(["service"], 2.0)
+    assert ev2.id == e.id
+    b.ack(ev2.id, t2)
+
+
+def test_broker_delivery_limit_to_failed_queue():
+    b = make_broker(initial_nack_delay_s=0.01, delivery_limit=2)
+    e = mock.eval_()
+    b.enqueue(e)
+    for _ in range(2):
+        ev, token = b.dequeue(["service"], 2.0)
+        assert ev is not None
+        b.nack(ev.id, token)
+    ev, token = b.dequeue([FAILED_QUEUE], 2.0)
+    assert ev is not None and ev.id == e.id
+    b.ack(ev.id, token)
+
+
+def test_broker_delayed_eval():
+    b = make_broker()
+    e = mock.eval_()
+    e.wait_until = time.time() + 0.2
+    b.enqueue(e)
+    none, _ = b.dequeue(["service"], 0.05)
+    assert none is None
+    ev, t = b.dequeue(["service"], 2.0)
+    assert ev is not None and ev.id == e.id
+    b.ack(ev.id, t)
+
+
+def test_broker_dequeue_batch_many_jobs():
+    b = make_broker()
+    evals = [mock.eval_(job_id=f"job-{i}") for i in range(6)]
+    for e in evals:
+        b.enqueue(e)
+    batch = b.dequeue_batch(["service"], 4, 1.0)
+    assert len(batch) == 4
+    jobs = {ev.job_id for ev, _t in batch}
+    assert len(jobs) == 4
+    for ev, t in batch:
+        b.ack(ev.id, t)
+
+
+def test_broker_nack_timer_auto_redelivers():
+    b = make_broker(nack_delay_s=0.1, initial_nack_delay_s=0.01)
+    e = mock.eval_()
+    b.enqueue(e)
+    ev, _token = b.dequeue(["service"], 1.0)
+    # never ack: the nack timer should fire and redeliver
+    ev2, t2 = b.dequeue(["service"], 3.0)
+    assert ev2 is not None and ev2.id == e.id
+    b.ack(ev2.id, t2)
+
+
+def test_blocked_unblock_by_class():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    e = mock.eval_(status=structs.EVAL_STATUS_BLOCKED)
+    e.class_eligibility = {"class-a": True, "class-b": False}
+    e.snapshot_index = 100
+    blocked.block(e)
+    assert blocked.stats()["total_blocked"] == 1
+
+    # unblocking an ineligible class does nothing
+    blocked.unblock("class-b", 110)
+    assert blocked.stats()["total_blocked"] == 1
+    # eligible class re-enqueues
+    blocked.unblock("class-a", 120)
+    assert blocked.stats()["total_blocked"] == 0
+    ev, t = b.dequeue(["service"], 1.0)
+    assert ev.id == e.id
+    assert ev.status == structs.EVAL_STATUS_PENDING
+    b.ack(ev.id, t)
+
+
+def test_blocked_escaped_unblocked_by_any_class():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    e = mock.eval_(status=structs.EVAL_STATUS_BLOCKED)
+    e.escaped_computed_class = True
+    e.snapshot_index = 100
+    blocked.block(e)
+    blocked.unblock("whatever-class", 150)
+    ev, t = b.dequeue(["service"], 1.0)
+    assert ev.id == e.id
+    b.ack(ev.id, t)
+
+
+def test_blocked_missed_unblock():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    # capacity changed at index 200; eval snapshotted at 100 missed it
+    blocked.unblock("class-a", 200)
+    e = mock.eval_(status=structs.EVAL_STATUS_BLOCKED)
+    e.class_eligibility = {"class-a": True}
+    e.snapshot_index = 100
+    blocked.block(e)
+    ev, t = b.dequeue(["service"], 1.0)
+    assert ev is not None and ev.id == e.id
+    b.ack(ev.id, t)
+
+
+def test_blocked_duplicate_jobs():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    e1 = mock.eval_(job_id="j1", status=structs.EVAL_STATUS_BLOCKED)
+    e2 = mock.eval_(job_id="j1", status=structs.EVAL_STATUS_BLOCKED)
+    for e in (e1, e2):
+        e.class_eligibility = {"c": False}
+        blocked.block(e)
+    dups = blocked.get_duplicates()
+    assert [d.id for d in dups] == [e1.id]
+    assert blocked.stats()["total_blocked"] == 1
+
+
+def test_plan_queue_priority_and_future():
+    q = PlanQueue()
+    q.set_enabled(True)
+    lo = q.enqueue(Plan(priority=10))
+    hi = q.enqueue(Plan(priority=90))
+    first = q.dequeue(1.0)
+    assert first is hi
+    second = q.dequeue(1.0)
+    assert second is lo
+    second.future.respond(PlanResult(), None)
+    res, err = second.future.wait(1.0)
+    assert err is None and res is not None
+
+
+def make_store_with_node(cpu=4000, mem=8192):
+    store = StateStore()
+    n = mock.node()
+    n.node_resources.cpu = cpu
+    n.node_resources.memory_mb = mem
+    n.reserved_resources.cpu = 0
+    n.reserved_resources.memory_mb = 0
+    store.upsert_node(1, n)
+    return store, n
+
+
+def plan_with_alloc(node, cpu=500, mem=256):
+    job = mock.job()
+    a = mock.alloc(job=job, node_id=node.id)
+    a.allocated_resources.tasks["web"].cpu = cpu
+    a.allocated_resources.tasks["web"].memory_mb = mem
+    a.allocated_resources.tasks["web"].networks = []
+    p = Plan(job=job)
+    p.append_alloc(a)
+    return p, a
+
+
+def test_evaluate_plan_accepts_fitting():
+    store, node = make_store_with_node()
+    plan, alloc = plan_with_alloc(node)
+    result = evaluate_plan(store.snapshot(), plan)
+    assert result.node_allocation
+    assert result.refresh_index == 0
+
+
+def test_evaluate_plan_rejects_overcommit():
+    store, node = make_store_with_node(cpu=600, mem=300)
+    # existing alloc uses most of the node
+    occupant = mock.alloc(node_id=node.id)
+    occupant.allocated_resources.tasks["web"].cpu = 400
+    occupant.allocated_resources.tasks["web"].networks = []
+    occupant.client_status = structs.ALLOC_CLIENT_RUNNING
+    store.upsert_allocs(2, [occupant])
+    plan, alloc = plan_with_alloc(node, cpu=500)
+    result = evaluate_plan(store.snapshot(), plan)
+    assert not result.node_allocation
+    assert result.refresh_index > 0
+
+
+def test_evaluate_plan_rejects_down_node():
+    store, node = make_store_with_node()
+    store.update_node_status(5, node.id, structs.NODE_STATUS_DOWN)
+    plan, alloc = plan_with_alloc(node)
+    result = evaluate_plan(store.snapshot(), plan)
+    assert not result.node_allocation
+
+
+def test_plan_applier_loop_applies():
+    store, node = make_store_with_node()
+    q = PlanQueue()
+    q.set_enabled(True)
+    index_holder = {"i": 100}
+
+    def apply_fn(plan, result):
+        index_holder["i"] += 1
+        store.upsert_plan_results(index_holder["i"], result, plan.job)
+        return index_holder["i"]
+
+    applier = PlanApplier(q, store, apply_fn)
+    applier.start()
+    try:
+        plan, alloc = plan_with_alloc(node)
+        pending = q.enqueue(plan)
+        result, err = pending.future.wait(5.0)
+        assert err is None
+        assert result.full_commit(plan)[0]
+        assert store.alloc_by_id(alloc.id) is not None
+    finally:
+        applier.stop()
+        q.set_enabled(False)
+
+
+def test_broker_failed_holder_promotes_backlog():
+    """When an eval exhausts its delivery limit, the job's next blocked
+    eval must be promoted (review regression)."""
+    b = make_broker(initial_nack_delay_s=0.01, delivery_limit=1)
+    e1 = mock.eval_(job_id="j1")
+    e2 = mock.eval_(job_id="j1")
+    b.enqueue(e1)
+    b.enqueue(e2)
+    ev, token = b.dequeue(["service"], 1.0)
+    assert ev.id == e1.id
+    b.nack(ev.id, token)   # hits delivery limit -> failed queue
+    ev2, t2 = b.dequeue(["service"], 2.0)
+    assert ev2 is not None and ev2.id == e2.id
+    b.ack(ev2.id, t2)
