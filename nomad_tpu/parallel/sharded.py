@@ -1,0 +1,112 @@
+"""Multi-chip solve: shard the node axis over a TPU mesh.
+
+The scaling-book recipe (SURVEY §2.6): pick a mesh, annotate input
+shardings, and let XLA/GSPMD insert the collectives. The node axis is our
+"long sequence" (SURVEY §5.7) — feasibility masking and scoring partition
+cleanly along it; the per-step masked top-k and the winner-commit scatter
+become cross-shard collectives (reduce over ICI) that XLA derives from
+the shardings, replacing hand-written NCCL/MPI in the reference's world.
+
+Two levels:
+  * `sharded_solve_args`  — one region's solve, node axis sharded.
+  * `federated_solve_args` — BASELINE config 5: a leading region axis
+    (independent solves, the federation analog of nomad/serf.go regions)
+    vmapped and sharded over the mesh's "region" axis; node axis sharded
+    within each region's device row.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solver.kernel import solve_kernel
+from ..solver.tensorize import PackedBatch
+
+# PartitionSpec per solve_kernel positional arg (node axis = "nodes").
+_ARG_SPECS: List[P] = [
+    P("nodes", None),        # avail [Np, R]
+    P("nodes", None),        # reserved
+    P("nodes", None),        # used0
+    P("nodes"),              # valid [Np]
+    P("nodes"),              # node_dc [Np]
+    P("nodes", None),        # attr_rank [Np, A]
+    P(),                     # ask_res [Gp, R]
+    P(),                     # ask_desired [Gp]
+    P(),                     # distinct [Gp]
+    P(),                     # dc_ok [Gp, NDC]
+    P(None, "nodes"),        # host_ok [Gp, Np]
+    P(None, "nodes"),        # coll0 [Gp, Np]
+    P(None, "nodes"),        # penalty [Gp, Np]
+    P(), P(), P(),           # c_op / c_col / c_rank [Gp, C]
+    P(), P(), P(), P(),      # a_op / a_col / a_rank / a_weight [Gp, CA]
+    P(None, "nodes"),        # a_host [Gp, Np]
+    P(), P(), P(),           # sp_col / sp_weight / sp_targeted [Gp, S]
+    P(), P(), P(),           # sp_desired / sp_implicit / sp_used0
+    P("nodes", None),        # dev_cap [Np, D]
+    P("nodes", None),        # dev_used0 [Np, D]
+    P(),                     # dev_ask [Gp, D]
+    P(),                     # p_ask [K]
+    P(),                     # n_place (scalar)
+]
+
+
+def kernel_args(pb: PackedBatch) -> Tuple:
+    """PackedBatch -> solve_kernel positional args."""
+    return (pb.avail, pb.reserved, pb.used0, pb.valid, pb.node_dc,
+            pb.attr_rank, pb.ask_res, pb.ask_desired, pb.distinct, pb.dc_ok,
+            pb.host_ok, pb.coll0, pb.penalty, pb.c_op, pb.c_col, pb.c_rank,
+            pb.a_op, pb.a_col, pb.a_rank, pb.a_weight, pb.a_host, pb.sp_col,
+            pb.sp_weight, pb.sp_targeted, pb.sp_desired, pb.sp_implicit,
+            pb.sp_used0, pb.dev_cap, pb.dev_used0, pb.dev_ask, pb.p_ask,
+            np.int32(pb.n_place))
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              n_regions: int = 1) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    assert n % n_regions == 0, "devices must divide evenly into regions"
+    grid = np.array(devices).reshape(n_regions, n // n_regions)
+    return Mesh(grid, ("region", "nodes"))
+
+
+def _shard_args(args: Tuple, mesh: Mesh, region_axis: bool) -> Tuple:
+    out = []
+    for arg, spec in zip(args, _ARG_SPECS):
+        if region_axis:
+            spec = P("region", *spec)
+        out.append(jax.device_put(arg, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
+def sharded_solve_args(args: Tuple, mesh: Mesh):
+    """Run one solve with the node axis sharded over mesh axis "nodes".
+    XLA partitions the kernel and inserts the cross-shard reductions for
+    the masked top-k and commit scatter."""
+    return solve_kernel(*_shard_args(args, mesh, region_axis=False))
+
+
+def sharded_solve(pb: PackedBatch, mesh: Mesh):
+    return sharded_solve_args(kernel_args(pb), mesh)
+
+
+# vmap over a leading region axis: each region is an independent solve
+# (regions don't share nodes), mapping onto disjoint device rows.
+_federated_kernel = jax.jit(jax.vmap(solve_kernel))
+
+
+def federated_solve(pbs: Sequence[PackedBatch], mesh: Mesh):
+    """Solve R regions at once: inputs stacked on a leading region axis,
+    sharded over the mesh "region" axis (all batches must share shapes —
+    use one Tensorizer per region with identical padding)."""
+    per_region = [kernel_args(pb) for pb in pbs]
+    shapes = {tuple(np.shape(a) for a in args) for args in per_region}
+    assert len(shapes) == 1, "region batches must be shape-aligned"
+    stacked = tuple(np.stack([args[i] for args in per_region])
+                    for i in range(len(per_region[0])))
+    return _federated_kernel(*_shard_args(stacked, mesh, region_axis=True))

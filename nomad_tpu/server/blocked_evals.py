@@ -7,10 +7,9 @@ with duplicate surfacing :642.
 from __future__ import annotations
 
 import threading
-import time as _time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
-from ..structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
+from ..structs import EVAL_STATUS_PENDING, Evaluation
 
 
 class BlockedEvals:
@@ -28,8 +27,6 @@ class BlockedEvals:
         # class -> latest state index at which capacity changed; an eval
         # blocked with an older snapshot may have missed that unblock
         self._unblock_indexes: Dict[str, int] = {}
-        self._stats_blocked = 0
-        self._stats_escaped = 0
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -75,10 +72,8 @@ class BlockedEvals:
 
             if ev.escaped_computed_class or not ev.class_eligibility:
                 self._escaped[ev.id] = ev
-                self._stats_escaped += 1
             else:
                 self._captured[ev.id] = ev
-                self._stats_blocked += 1
             if ev.node_id:
                 self._by_node.setdefault(ev.node_id, []).append(ev.id)
                 self._node_of[ev.id] = ev.node_id

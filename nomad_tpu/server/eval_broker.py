@@ -6,10 +6,10 @@ Semantics mirror nomad/eval_broker.go — per-scheduler-type priority heaps
 and a delivery limit that shunts flapping evals to a `_failed` queue
 (:23, :531, :595), and delayed evals via a wait-until heap (:89, :751).
 
-This is also the TPU batching point (SURVEY §2.5): `dequeue_batch` drains
-up to K ready evals of one scheduler type — each for a different job, by
-construction of the per-job serialization — so a worker can coalesce them
-into a single batched device solve.
+`dequeue_batch` drains up to K ready evals — each for a different job, by
+construction of the per-job serialization — and is the coalescing point
+for the fused multi-eval device solve (SURVEY §2.5); the stock worker
+loop dequeues singly, matching the reference.
 """
 from __future__ import annotations
 
@@ -120,11 +120,11 @@ class EvalBroker:
         with self._lock:
             self._enqueue_locked(ev, ev.type)
 
-    def enqueue_all(self, evals: Dict[Evaluation, str]) -> None:
-        """Enqueue evals, re-enqueueing those we hold unacked (token map
-        eval -> token proves ownership)."""
+    def enqueue_all(self, evals: List[Tuple[Evaluation, str]]) -> None:
+        """Enqueue (eval, token) pairs; a matching token for an unacked
+        eval defers the re-enqueue until that eval is acked."""
         with self._lock:
-            for ev, token in evals.items():
+            for ev, token in evals:
                 if token:
                     self._process_waiting_enqueue_locked(ev, token)
                 else:

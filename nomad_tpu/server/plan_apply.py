@@ -64,9 +64,10 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str
 def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     """Re-check the whole plan against `snapshot`, keeping only nodes that
     still fit; partial results carry a refresh index."""
+    # stops always commit; placements and the preemptions that make room
+    # for them are gated per node on the fit re-check
     result = PlanResult(
         node_update=dict(plan.node_update),
-        node_preemptions=dict(plan.node_preemptions),
         deployment=plan.deployment,
         deployment_updates=list(plan.deployment_updates))
 
@@ -82,6 +83,7 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
                     if hasattr(snapshot, "latest_index") else snapshot.index
                 return result
         result.node_allocation = dict(plan.node_allocation)
+        result.node_preemptions = dict(plan.node_preemptions)
         return result
 
     partial = False
@@ -89,6 +91,9 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
         ok, _why = evaluate_node_plan(snapshot, plan, node_id)
         if ok:
             result.node_allocation[node_id] = plan.node_allocation[node_id]
+            if node_id in plan.node_preemptions:
+                result.node_preemptions[node_id] = \
+                    plan.node_preemptions[node_id]
         else:
             partial = True
     if partial:

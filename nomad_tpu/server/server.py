@@ -13,7 +13,6 @@ import threading
 import time as _time
 from typing import Dict, Iterable, List, Optional
 
-from ..scheduler.util import tainted_nodes
 from ..state.store import StateStore
 from ..structs import (ALLOC_CLIENT_FAILED, EVAL_STATUS_PENDING,
                        EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_JOB_REGISTER,
@@ -47,6 +46,8 @@ class Server:
         self.workers = [Worker(self, self.enabled_schedulers)
                         for _ in range(num_workers)]
         self._started = False
+        self._stop_reapers = threading.Event()
+        self._dup_reaper: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -58,10 +59,15 @@ class Server:
         self.planner.start()
         for w in self.workers:
             w.start()
+        self._stop_reapers.clear()
+        self._dup_reaper = threading.Thread(
+            target=self._reap_dup_blocked_evals, daemon=True)
+        self._dup_reaper.start()
         self._started = True
         self._restore_evals()
 
     def stop(self) -> None:
+        self._stop_reapers.set()
         for w in self.workers:
             w.shutdown()
         self.planner.stop()
@@ -69,6 +75,24 @@ class Server:
         self.broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self._started = False
+
+    def _reap_dup_blocked_evals(self) -> None:
+        """Cancel blocked evals displaced by a newer eval for the same job
+        (reference: leader.go:625 reapDupBlockedEvaluations)."""
+        import copy
+        from ..structs import EVAL_STATUS_CANCELLED
+        while not self._stop_reapers.is_set():
+            dups = self.blocked_evals.get_duplicates(timeout=0.2)
+            if not dups:
+                continue
+            cancelled = []
+            for ev in dups:
+                e2 = copy.copy(ev)
+                e2.status = EVAL_STATUS_CANCELLED
+                e2.status_description = \
+                    "cancelled due to duplicate blocked evaluation"
+                cancelled.append(e2)
+            self.upsert_evals(cancelled)
 
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals from state (leader.go:245)."""

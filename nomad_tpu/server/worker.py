@@ -52,8 +52,15 @@ class Worker(threading.Thread):
             sched = new_scheduler(ev.type, server.store, self)
             err = sched.process(ev)
         except Exception as e:
+            # record the failure on the eval so a parked (delivery-limited)
+            # eval isn't restored as pending after a leader restart
+            import copy
+            from ..structs import EVAL_STATUS_FAILED
+            failed = copy.copy(ev)
+            failed.status = EVAL_STATUS_FAILED
+            failed.status_description = f"scheduler error: {e}"
+            server.upsert_evals([failed])
             server.broker.nack(ev.id, token)
-            err = str(e)
             return
         if err is not None:
             server.broker.nack(ev.id, token)

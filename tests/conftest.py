@@ -1,14 +1,23 @@
 """Test env: force JAX onto a virtual 8-device CPU platform.
 
-Must run before any jax import (SURVEY: test sharding on a virtual 8-device
-CPU mesh; real TPU only in the bench tier).
+The container's sitecustomize pre-imports jax with JAX_PLATFORMS=axon
+(the real TPU tunnel), so env vars set here are too late — the platform
+choice must go through jax.config. XLA_FLAGS still works via env because
+no CPU client exists yet at conftest import time.
+(SURVEY: test sharding on a virtual 8-device CPU mesh; real TPU only in
+the bench tier.)
 """
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
