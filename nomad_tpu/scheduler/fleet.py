@@ -1,0 +1,149 @@
+"""Fleet solve: fuse a batch of evals into ONE device solve.
+
+This is the TPU recast of the reference's optimistic worker concurrency
+(SURVEY §2.5): where the reference runs N goroutines each solving one
+eval against its own snapshot — conflicts surfacing only at the plan
+applier — this path drains up to K ready evals (one per job, by broker
+construction), reconciles each on the host, and solves ALL their
+placements in a single kernel invocation. Placements from different evals
+see each other inside the solve (the scan's shared `used` carry), so
+intra-batch plan conflicts largely vanish instead of being retried.
+
+Shared world note: the packed usage comes from the common snapshot;
+capacity freed by an eval's own stops becomes visible only after its plan
+commits. An eval that fails a placement or partially commits falls back
+to the single-eval path, which sees its stops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, Allocation,
+                       Evaluation, JOB_TYPE_BATCH, JOB_TYPE_SERVICE)
+from .generic import GenericScheduler, _VALID_TRIGGERS
+
+
+class _Entry:
+    def __init__(self, ev: Evaluation, token: str,
+                 sched: GenericScheduler):
+        self.ev = ev
+        self.token = token
+        self.sched = sched
+        self.prep = None
+        self.ask_base = 0
+        self.err: Optional[str] = None
+
+
+class _SolveView:
+    """Per-eval slice of the fused SolveOutput with rebased ask indexes."""
+
+    def __init__(self, placements, class_eligibility):
+        self.placements = placements
+        self.class_eligibility = class_eligibility
+
+
+def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
+                  ) -> None:
+    """Process a dequeued eval batch with one fused solve. `worker` is the
+    Planner handed to each scheduler and the fallback single-eval
+    processor for anything the fused path can't finish."""
+    # the fused pass can outlive the nack timeout for tail-of-batch evals;
+    # hold the timers while we own the batch (explicit ack/nack follows)
+    for ev, token in batch:
+        server.broker.pause_nack_timeout(ev.id, token)
+
+    fused: List[_Entry] = []
+    for ev, token in batch:
+        if ev.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH) \
+                or ev.triggered_by not in _VALID_TRIGGERS:
+            worker._process(ev, token)
+            continue
+        fused.append(_Entry(ev, token, GenericScheduler(
+            server.store, worker, batch=(ev.type == JOB_TYPE_BATCH),
+            solver=worker.fleet_solver())))
+    if not fused:
+        return
+
+    wait_index = max(max(e.ev.modify_index, e.ev.snapshot_index)
+                     for e in fused)
+    server.store.wait_for_index(wait_index, timeout=5.0)
+    snapshot = server.store.snapshot()
+
+    # one shared world for the whole batch
+    nodes = [n for n in snapshot.nodes() if n.ready()]
+    by_dc: Dict[str, int] = {}
+    for n in nodes:
+        by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
+    allocs_by_node: Dict[str, List[Allocation]] = {}
+    for n in nodes:
+        live = [a for a in snapshot.allocs_by_node(n.id)
+                if not a.terminal_status()]
+        if live:
+            allocs_by_node[n.id] = live
+
+    all_asks = []
+    all_ask_missing = []
+    solvable: List[_Entry] = []
+    for e in fused:
+        try:
+            missing, err = e.sched._begin(e.ev, snapshot)
+        except Exception as exc:
+            e.err = f"scheduler error: {exc}"
+            continue
+        if err is not None:
+            e.err = err
+            continue
+        if missing:
+            # restrict to this job's datacenters via the ask's dc mask —
+            # the shared node list spans all DCs
+            prep = e.sched._prepare_placements(
+                snapshot, missing, nodes=nodes, by_dc=by_dc,
+                allocs_by_node=allocs_by_node)
+            if prep is not None:
+                _nodes, _by_dc, _abn, asks, ask_missing = prep
+                e.prep = (missing, ask_missing)
+                e.ask_base = len(all_asks)
+                all_asks.extend(asks)
+                all_ask_missing.extend(ask_missing)
+                solvable.append(e)
+
+    out = None
+    if all_asks:
+        out = worker.fleet_solver().solve(nodes, all_asks, allocs_by_node,
+                                          by_dc)
+
+    for e in solvable:
+        missing, ask_missing = e.prep
+        n_local = len(ask_missing)
+        local_placements = []
+        for p in out.placements:
+            if e.ask_base <= p.ask_index < e.ask_base + n_local:
+                import copy
+                p2 = copy.copy(p)
+                p2.ask_index = p.ask_index - e.ask_base
+                local_placements.append(p2)
+        view = _SolveView(
+            local_placements,
+            out.class_eligibility[e.ask_base:e.ask_base + n_local])
+        e.sched._consume_solve(snapshot, view, nodes, allocs_by_node,
+                               missing, ask_missing)
+
+    # finalize each eval; anything incomplete replays on the single path
+    for e in fused:
+        if e.err is not None:
+            e.sched._set_status(EVAL_STATUS_FAILED, str(e.err))
+            server.broker.nack(e.ev.id, e.token)
+            continue
+        try:
+            done, err = e.sched._finalize({"made": False})
+        except Exception as exc:
+            done, err = False, f"finalize error: {exc}"
+        if err is not None:
+            e.sched._set_status(EVAL_STATUS_FAILED, str(err))
+            server.broker.nack(e.ev.id, e.token)
+        elif done:
+            e.sched._set_status(EVAL_STATUS_COMPLETE, "")
+            server.broker.ack(e.ev.id, e.token)
+        else:
+            # partial commit / refresh: the single-eval retry loop owns it
+            worker._process(e.ev, e.token)

@@ -131,8 +131,21 @@ class GenericScheduler:
     def _process(self, progress) -> Tuple[bool, Optional[str]]:
         snapshot = (self.state.snapshot()
                     if hasattr(self.state, "snapshot") else self.state)
+        missing, err = self._begin(self.eval, snapshot)
+        if err is not None:
+            return False, err
+        if missing:
+            err = self._compute_placements(snapshot, missing)
+            if err is not None:
+                return False, err
+        return self._finalize(progress)
+
+    def _begin(self, ev: Evaluation, snapshot
+               ) -> Tuple[List["_Missing"], Optional[str]]:
+        """Everything before the device solve: reconcile and assemble the
+        plan skeleton. Returns the pending placements."""
+        self.eval = ev
         self.snapshot = snapshot
-        ev = self.eval
         self.job = snapshot.job_by_id(ev.namespace, ev.job_id)
         self.failed_tg_allocs = {}
         self.queued_allocs = {}
@@ -146,11 +159,12 @@ class GenericScheduler:
                 self.deployment = None
         else:
             self.deployment = None
+        return self._compute_job_allocs(snapshot)
 
-        err = self._compute_job_allocs(snapshot)
-        if err is not None:
-            return False, err
-
+    def _finalize(self, progress) -> Tuple[bool, Optional[str]]:
+        """Everything after the solve: blocked/follow-up evals and plan
+        submission. Returns (done, err); not-done means retry."""
+        ev = self.eval
         # blocked eval for any failed placements
         if (ev.status != EVAL_STATUS_BLOCKED and self.failed_tg_allocs
                 and self.blocked is None):
@@ -183,7 +197,8 @@ class GenericScheduler:
             return False, None
         return True, None
 
-    def _compute_job_allocs(self, snapshot) -> Optional[str]:
+    def _compute_job_allocs(self, snapshot
+                            ) -> Tuple[List["_Missing"], Optional[str]]:
         ev = self.eval
         allocs = snapshot.allocs_by_job(ev.namespace, ev.job_id)
         tainted = tainted_nodes(snapshot, allocs)
@@ -224,7 +239,7 @@ class GenericScheduler:
             if self.job is not None:
                 for tg in self.job.task_groups:
                     self.queued_allocs[tg.name] = 0
-            return None
+            return [], None
 
         for p in results.place:
             self.queued_allocs[p.task_group.name] = \
@@ -245,14 +260,31 @@ class GenericScheduler:
             missing.append(_Missing(
                 name=p.name, tg=p.task_group, previous=p.previous_alloc,
                 reschedule=p.reschedule, canary=p.canary))
-        return self._compute_placements(snapshot, missing)
+        return missing, None
 
     # ----------------------------------------------------- placement solve
     def _compute_placements(self, snapshot, missing: List[_Missing]
                             ) -> Optional[str]:
+        prep = self._prepare_placements(snapshot, missing)
+        if prep is None:
+            return None
+        nodes, by_dc, allocs_by_node, asks, ask_missing = prep
+        out = self.solver.solve(nodes, asks, allocs_by_node, by_dc)
+        self._consume_solve(snapshot, out, nodes, allocs_by_node, missing,
+                            ask_missing)
+        return None
+
+    def _prepare_placements(self, snapshot, missing: List[_Missing],
+                            nodes=None, by_dc=None, allocs_by_node=None):
+        """Pre-solve work: eager destructive stops, sticky placements and
+        per-tg ask assembly. Returns (nodes, by_dc, allocs_by_node, asks,
+        ask_missing), or None when nothing remains for the solver.
+        The fleet path passes shared nodes/allocs_by_node so evals in one
+        batch see the same world."""
         if self.job is None:
             return None
-        nodes, by_dc = snapshot.ready_nodes_in_dcs(self.job.datacenters)
+        if nodes is None:
+            nodes, by_dc = snapshot.ready_nodes_in_dcs(self.job.datacenters)
         if not nodes:
             for m in missing:
                 self._record_failure(m, None)
@@ -266,14 +298,16 @@ class GenericScheduler:
                 self.plan.append_stopped_alloc(m.previous, m.stop_desc, "")
 
         # proposed live allocs by node: state minus plan stops
-        stopped_ids = {a.id for allocs in self.plan.node_update.values()
-                       for a in allocs}
-        allocs_by_node: Dict[str, List[Allocation]] = {}
-        for n in nodes:
-            live = [a for a in snapshot.allocs_by_node(n.id)
-                    if not a.terminal_status() and a.id not in stopped_ids]
-            if live:
-                allocs_by_node[n.id] = live
+        if allocs_by_node is None:
+            stopped_ids = {a.id for allocs in self.plan.node_update.values()
+                           for a in allocs}
+            allocs_by_node = {}
+            for n in nodes:
+                live = [a for a in snapshot.allocs_by_node(n.id)
+                        if not a.terminal_status()
+                        and a.id not in stopped_ids]
+                if live:
+                    allocs_by_node[n.id] = live
 
         # sticky-disk placements prefer their previous node (reference:
         # generic_sched.go:628 findPreferredNode)
@@ -324,27 +358,36 @@ class GenericScheduler:
                 distinct_hosts_blocked=blocked, spread_seed=spread_seed,
                 property_limits=prop_limits))
             ask_missing.append(ms)
+        return nodes, by_dc, allocs_by_node, asks, ask_missing
 
-        out = self.solver.solve(nodes, asks, allocs_by_node, by_dc)
-
+    def _consume_solve(self, snapshot, out, nodes, allocs_by_node,
+                       missing: List[_Missing],
+                       ask_missing: List[List[_Missing]]) -> None:
+        """Post-solve work: emit allocs, preempt or record failures, and
+        retract eager stops for failed destructive replacements. `out`
+        placements must use ask indexes local to `ask_missing`."""
         # map solver placements (contiguous per ask) back to missing
+        from .preemption import preemption_enabled
+        preempt_ok = preemption_enabled(
+            snapshot.scheduler_config(), "batch" if self.batch else "service")
         queues = {g: list(ms) for g, ms in enumerate(ask_missing)}
         failed: set = set()
         for placement in out.placements:
             m = queues[placement.ask_index].pop(0)
             if placement.node is None:
-                self._record_failure(m, placement)
-                failed.add(id(m))
+                if not (preempt_ok and self._try_preemption(
+                        nodes, m, allocs_by_node)):
+                    self._record_failure(m, placement)
+                    failed.add(id(m))
                 continue
             self._emit_alloc(m, placement.node, placement.resources,
                              placement.score, placement.metrics)
 
         if self.failed_tg_allocs:
             # remember per-class eligibility for the blocked eval
-            for g, elig in enumerate(out.class_eligibility):
+            for elig in out.class_eligibility:
                 self._class_eligibility.update(elig)
         self._stop_destructive_for_failed(missing, failed)
-        return None
 
     def _stop_destructive_for_failed(self, missing: List[_Missing],
                                      failed: set) -> None:
@@ -359,6 +402,43 @@ class GenericScheduler:
                     a for a in lst if a.id != m.previous.id]
                 if not self.plan.node_update[m.previous.node_id]:
                     del self.plan.node_update[m.previous.node_id]
+
+    def _try_preemption(self, nodes, m: _Missing, allocs_by_node) -> bool:
+        """Second pass for an exhausted placement: find a feasible node
+        where evicting lower-priority allocs makes room (reference:
+        preemption.go PreemptForTaskGroup as a post-solve pass)."""
+        from ..solver.tensorize import group_resource_vector
+        from .preemption import pick_victims
+
+        vec = group_resource_vector(m.tg)
+        for node in nodes:
+            ok, _why = hostfeas.group_feasible(node, self.job, m.tg)
+            if not ok:
+                continue
+            proposed = allocs_by_node.get(node.id, [])
+            victims = pick_victims(node, proposed, self.job.priority,
+                                   float(vec[0]), float(vec[1]),
+                                   float(vec[2]), float(vec[3]))
+            if not victims:
+                continue
+            victim_ids = {v.id for v in victims}
+            remaining = [a for a in proposed if a.id not in victim_ids]
+            trial = dict(allocs_by_node)
+            trial[node.id] = remaining
+            resources = self.solver._host_commit(
+                node, 0, PlacementAsk(job=self.job, tg=m.tg, count=1),
+                {}, {}, trial)
+            if resources is None:
+                continue
+            alloc = self._emit_alloc(m, node, resources, 0.0, None)
+            alloc.preempted_allocations = sorted(victim_ids)
+            # later placements must see both the evictions and the new
+            # alloc's usage
+            allocs_by_node[node.id] = remaining + [alloc]
+            for v in victims:
+                self.plan.append_preempted_alloc(v, alloc.id)
+            return True
+        return False
 
     def _preferred_node(self, m: _Missing, node_by_id):
         if m.previous is None or not m.tg.ephemeral_disk.sticky:
@@ -377,7 +457,9 @@ class GenericScheduler:
             return None
         from ..structs.funcs import allocs_fit
         live = list(allocs_by_node.get(node.id, []))
-        probe = Allocation(id="probe", allocated_resources=resources,
+        probe = Allocation(id=generate_uuid(), job=self.job,
+                           job_id=self.job.id, node_id=node.id,
+                           allocated_resources=resources,
                            task_group=m.tg.name)
         fit, _dim, _used = allocs_fit(node, live + [probe])
         if not fit:
@@ -465,7 +547,7 @@ class GenericScheduler:
 
     # ------------------------------------------------------------- results
     def _emit_alloc(self, m: _Missing, node, resources, score: float,
-                    metrics) -> None:
+                    metrics) -> Allocation:
         from ..structs import AllocMetric
         now = _time.time()
         alloc = Allocation(
@@ -488,6 +570,7 @@ class GenericScheduler:
         if m.canary and self.deployment is not None:
             alloc.deployment_status = AllocDeploymentStatus(canary=True)
         self.plan.append_alloc(alloc)
+        return alloc
 
     def _record_failure(self, m: _Missing, placement) -> None:
         from ..structs import AllocMetric

@@ -1,0 +1,131 @@
+"""Preemption: evict lower-priority allocs to make room.
+
+Reference semantics: scheduler/preemption.go — Preemptor :96,
+PreemptForTaskGroup :198, resource-distance scoring
+`basicResourceDistance` :608, priority grouping with delta >= 10
+`filterAndGroupPreemptibleAllocs` :663, redundant-victim filtering :702.
+
+Host-side second pass: the device solve surfaces which placements
+exhausted resources on otherwise-feasible nodes; this module picks the
+minimum-distance victim set per candidate node.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..structs import Allocation, ComparableResources, Node
+
+PRIORITY_DELTA = 10
+
+
+def resource_distance(delta_cpu: float, delta_mem: float, delta_disk: float,
+                      delta_net: float) -> float:
+    """Normalized euclidean distance between a victim's resources and the
+    still-needed resources (reference: basicResourceDistance :608)."""
+    return (delta_cpu ** 2 + delta_mem ** 2 + delta_disk ** 2
+            + delta_net ** 2) ** 0.5
+
+
+def _usage(alloc: Allocation) -> Tuple[float, float, float, float]:
+    c = alloc.comparable_resources()
+    return (float(c.cpu), float(c.memory_mb), float(c.disk_mb),
+            float(sum(n.mbits for n in c.networks)))
+
+
+def preemptible_allocs(job_priority: int, allocs: Sequence[Allocation]
+                       ) -> List[Allocation]:
+    """Victim candidates: non-terminal allocs at least PRIORITY_DELTA
+    lower priority, lowest priority first."""
+    out = []
+    for a in allocs:
+        if a.terminal_status():
+            continue
+        if a.job is None:
+            # placeholder/probe allocs without a job snapshot have no
+            # knowable priority — never victims
+            continue
+        prio = a.job.priority
+        if job_priority - prio >= PRIORITY_DELTA:
+            out.append((prio, a))
+    out.sort(key=lambda t: (t[0], t[1].create_index))
+    return [a for _p, a in out]
+
+
+def pick_victims(node: Node, proposed: Sequence[Allocation],
+                 job_priority: int, need_cpu: float, need_mem: float,
+                 need_disk: float, need_net: float
+                 ) -> Optional[List[Allocation]]:
+    """Greedy minimum-distance victim selection on one node: repeatedly
+    take the candidate closest to the remaining shortfall until the ask
+    fits, then drop victims made redundant by later picks (reference:
+    PreemptForTaskGroup :198 + :702)."""
+    res = node.comparable_resources()
+    reserved = node.comparable_reserved_resources()
+    used_cpu = float(reserved.cpu)
+    used_mem = float(reserved.memory_mb)
+    used_disk = float(reserved.disk_mb)
+    used_net = 0.0
+    for a in proposed:
+        c, m, d, nw = _usage(a)
+        used_cpu += c
+        used_mem += m
+        used_disk += d
+        used_net += nw
+    cap_cpu = float(res.cpu)
+    cap_mem = float(res.memory_mb)
+    cap_disk = float(res.disk_mb)
+    cap_net = float(sum(n.mbits for n in res.networks))
+
+    def shortfall(freed):
+        fc, fm, fd, fn = freed
+        return (max(0.0, used_cpu - fc + need_cpu - cap_cpu),
+                max(0.0, used_mem - fm + need_mem - cap_mem),
+                max(0.0, used_disk - fd + need_disk - cap_disk),
+                max(0.0, used_net - fn + need_net - cap_net))
+
+    candidates = preemptible_allocs(job_priority, proposed)
+    if not candidates:
+        return None
+    freed = (0.0, 0.0, 0.0, 0.0)
+    victims: List[Allocation] = []
+    remaining = list(candidates)
+    while any(s > 0 for s in shortfall(freed)):
+        if not remaining:
+            return None
+        sc, sm, sd, sn = shortfall(freed)
+        norm = (max(sc, 1.0), max(sm, 1.0), max(sd, 1.0), max(sn, 1.0))
+
+        def dist(a: Allocation) -> float:
+            c, m, d, nw = _usage(a)
+            return resource_distance((sc - c) / norm[0], (sm - m) / norm[1],
+                                     (sd - d) / norm[2], (sn - nw) / norm[3])
+        remaining.sort(key=dist)
+        pick = remaining.pop(0)
+        victims.append(pick)
+        c, m, d, nw = _usage(pick)
+        freed = (freed[0] + c, freed[1] + m, freed[2] + d, freed[3] + nw)
+
+    # redundancy filter: drop any victim whose resources are not needed
+    # once the rest are evicted (check highest-priority victims first so
+    # the cheapest evictions survive)
+    pruned = list(victims)
+    for a in sorted(victims,
+                    key=lambda v: -(v.job.priority if v.job else 50)):
+        trial = [v for v in pruned if v.id != a.id]
+        fc = sum(_usage(v)[0] for v in trial)
+        fm = sum(_usage(v)[1] for v in trial)
+        fd = sum(_usage(v)[2] for v in trial)
+        fn = sum(_usage(v)[3] for v in trial)
+        if not any(s > 0 for s in shortfall((fc, fm, fd, fn))):
+            pruned = trial
+    return pruned or None
+
+
+def preemption_enabled(config, sched_type: str) -> bool:
+    if config is None:
+        return sched_type == "system"
+    return {
+        "system": config.preemption_system_enabled,
+        "service": config.preemption_service_enabled,
+        "batch": config.preemption_batch_enabled,
+    }.get(sched_type, False)

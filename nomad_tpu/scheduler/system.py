@@ -241,6 +241,9 @@ class SystemScheduler:
                            if not a.terminal_status()
                            and a.id not in stopped]
 
+        from .preemption import pick_victims, preemption_enabled
+        preempt_ok = preemption_enabled(snapshot.scheduler_config(), "system")
+
         now = _time.time()
         for node, tg, name, prev in place:
             g = ask_ix[tg.name]
@@ -264,6 +267,25 @@ class SystemScheduler:
             probe = Allocation(id="probe", task_group=tg.name,
                                allocated_resources=resources)
             fit, dim, used = allocs_fit(node, usage[node.id] + [probe])
+            victims = None
+            if not fit and preempt_ok:
+                from ..solver.tensorize import group_resource_vector
+                vec = group_resource_vector(tg)
+                victims = pick_victims(node, usage[node.id],
+                                       self.job.priority, float(vec[0]),
+                                       float(vec[1]), float(vec[2]),
+                                       float(vec[3]))
+                if victims:
+                    victim_ids = {v.id for v in victims}
+                    trial = [a for a in usage[node.id]
+                             if a.id not in victim_ids]
+                    refit, rdim, rused = allocs_fit(node, trial + [probe])
+                    if refit:
+                        usage[node.id] = trial
+                        fit, dim, used = refit, rdim, rused
+                    else:
+                        # evictions wouldn't help: keep usage untouched
+                        victims = None
             if not fit:
                 metric.exhausted_node(node.id, node.computed_class,
                                       dim or "resources")
@@ -280,6 +302,10 @@ class SystemScheduler:
                 metrics=metric, desired_status=ALLOC_DESIRED_RUN,
                 client_status=ALLOC_CLIENT_PENDING,
                 create_time=now, modify_time=now)
+            if victims:
+                alloc.preempted_allocations = sorted(v.id for v in victims)
+                for v in victims:
+                    self.plan.append_preempted_alloc(v, alloc.id)
             usage[node.id].append(alloc)
             self.plan.append_alloc(alloc)
         return None

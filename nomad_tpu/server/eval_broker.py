@@ -223,6 +223,27 @@ class EvalBroker:
                 return
         self.nack(eval_id, token)
 
+    def pause_nack_timeout(self, eval_id: str, token: str) -> Optional[str]:
+        """Stop the redelivery timer while the holder does long work
+        (reference: eval_broker PauseNackTimeout, used while waiting on
+        raft / the fused solve). The holder must still ack or nack."""
+        with self._lock:
+            u = self._unack.get(eval_id)
+            if u is None or u.token != token:
+                return "token mismatch"
+            if u.nack_timer:
+                u.nack_timer.cancel()
+                u.nack_timer = None
+            return None
+
+    def resume_nack_timeout(self, eval_id: str, token: str) -> Optional[str]:
+        with self._lock:
+            u = self._unack.get(eval_id)
+            if u is None or u.token != token:
+                return "token mismatch"
+            self._start_nack_timer(u)
+            return None
+
     # ------------------------------------------------------------ ack/nack
     def ack(self, eval_id: str, token: str) -> Optional[str]:
         with self._lock:

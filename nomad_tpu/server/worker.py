@@ -23,6 +23,15 @@ class Worker(threading.Thread):
         self.sched_types = list(sched_types)
         self._shutdown = threading.Event()
         self.paused = threading.Event()
+        self._solver = None
+
+    def fleet_solver(self):
+        """One Solver per worker: its tensorizer's computed-class memo is
+        shared across the fused batch."""
+        if self._solver is None:
+            from ..solver.solve import Solver
+            self._solver = Solver()
+        return self._solver
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -32,19 +41,27 @@ class Worker(threading.Thread):
             if self.paused.is_set():
                 self._shutdown.wait(0.1)
                 continue
-            ev, token = self.server.broker.dequeue(self.sched_types,
-                                                   DEQUEUE_TIMEOUT_S)
-            if ev is None:
+            batch = self.server.broker.dequeue_batch(
+                self.sched_types, self.server.batch_size, DEQUEUE_TIMEOUT_S)
+            if not batch:
                 continue
             try:
-                self._process(ev, token)
+                if len(batch) == 1:
+                    self._process(*batch[0])
+                else:
+                    from ..scheduler.fleet import process_fleet
+                    process_fleet(self.server, self, batch)
             except Exception:
                 # a poisoned eval must not kill the worker; the nack path
                 # redelivers it until the delivery limit parks it
-                pass
+                for ev, token in batch:
+                    self.server.broker.nack(ev.id, token)
 
     def _process(self, ev: Evaluation, token: str) -> None:
         server = self.server
+        # the raft catch-up + solve + plan wait can exceed the nack
+        # timeout; hold the timer while we own the eval
+        server.broker.pause_nack_timeout(ev.id, token)
         # wait for local state to reach the eval's creation point
         wait_index = max(ev.modify_index, ev.snapshot_index)
         server.store.wait_for_index(wait_index, timeout=5.0)

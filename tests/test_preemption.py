@@ -1,0 +1,140 @@
+"""Preemption tests (reference: scheduler/preemption_test.go key cases)."""
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.scheduler.preemption import pick_victims, preemptible_allocs
+from nomad_tpu.state.store import SchedulerConfiguration
+
+
+def small_node():
+    n = mock.node()
+    n.node_resources.cpu = 1200
+    n.node_resources.memory_mb = 1024
+    n.reserved_resources.cpu = 0
+    n.reserved_resources.memory_mb = 0
+    return n
+
+
+def occupant(node, priority, cpu=800, mem=512):
+    job = mock.job(priority=priority)
+    a = mock.alloc(job=job, node_id=node.id)
+    a.client_status = structs.ALLOC_CLIENT_RUNNING
+    a.allocated_resources.tasks["web"].cpu = cpu
+    a.allocated_resources.tasks["web"].memory_mb = mem
+    a.allocated_resources.tasks["web"].networks = []
+    return a
+
+
+def test_priority_delta_gate():
+    node = small_node()
+    low = occupant(node, priority=40)
+    close = occupant(node, priority=45)
+    # job at priority 50: only allocs <= 40 are preemptible
+    assert [a.id for a in preemptible_allocs(50, [low, close])] == [low.id]
+
+
+def test_pick_victims_minimal_set():
+    node = small_node()
+    big = occupant(node, priority=10, cpu=800, mem=512)
+    small = occupant(node, priority=10, cpu=200, mem=128)
+    # need 300 cpu: evicting `small`+`big` both works, but the greedy
+    # distance pick should need only one victim
+    victims = pick_victims(node, [big, small], 70, 300, 128, 0, 0)
+    assert victims is not None
+    assert len(victims) == 1
+
+
+def test_pick_victims_none_when_impossible():
+    node = small_node()
+    high = occupant(node, priority=60, cpu=800)
+    victims = pick_victims(node, [high], 65, 600, 256, 0, 0)
+    assert victims is None  # delta < 10
+
+
+def test_service_preemption_via_scheduler():
+    h = Harness()
+    h.store.set_scheduler_config(
+        h.next_index(), SchedulerConfiguration(preemption_service=True))
+    node = small_node()
+    h.store.upsert_node(h.next_index(), node)
+
+    lowjob = mock.job(priority=20)
+    lowjob.task_groups[0].count = 1
+    lowjob.task_groups[0].tasks[0].resources.cpu = 800
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    ev = mock.eval_(job_id=lowjob.id,
+                    triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER)
+    h.process("service", ev)
+    low_alloc = h.store.allocs_by_job("default", lowjob.id)[0]
+    low_alloc.client_status = structs.ALLOC_CLIENT_RUNNING
+    h.store.upsert_allocs(h.next_index(), [low_alloc])
+
+    hijob = mock.job(priority=70)
+    hijob.task_groups[0].count = 1
+    hijob.task_groups[0].tasks[0].resources.cpu = 800
+    hijob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), hijob)
+    ev2 = mock.eval_(job_id=hijob.id, priority=70,
+                     triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER)
+    h.process("service", ev2)
+
+    hi_allocs = h.store.allocs_by_job("default", hijob.id)
+    assert len(hi_allocs) == 1
+    assert hi_allocs[0].preempted_allocations == [low_alloc.id]
+    evicted = h.store.alloc_by_id(low_alloc.id)
+    assert evicted.desired_status == structs.ALLOC_DESIRED_EVICT
+    assert evicted.preempted_by_allocation == hi_allocs[0].id
+
+
+def test_service_preemption_disabled_by_default():
+    h = Harness()
+    node = small_node()
+    h.store.upsert_node(h.next_index(), node)
+    lowjob = mock.job(priority=20)
+    lowjob.task_groups[0].count = 1
+    lowjob.task_groups[0].tasks[0].resources.cpu = 800
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    h.process("service", mock.eval_(
+        job_id=lowjob.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    low_alloc = h.store.allocs_by_job("default", lowjob.id)[0]
+    low_alloc.client_status = structs.ALLOC_CLIENT_RUNNING
+    h.store.upsert_allocs(h.next_index(), [low_alloc])
+
+    hijob = mock.job(priority=70)
+    hijob.task_groups[0].count = 1
+    hijob.task_groups[0].tasks[0].resources.cpu = 800
+    hijob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), hijob)
+    h.process("service", mock.eval_(
+        job_id=hijob.id, priority=70,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    assert not h.store.allocs_by_job("default", hijob.id)
+    assert h.store.alloc_by_id(low_alloc.id).desired_status == \
+        structs.ALLOC_DESIRED_RUN
+
+
+def test_system_preemption_default_on():
+    h = Harness()
+    node = small_node()
+    h.store.upsert_node(h.next_index(), node)
+    lowjob = mock.job(priority=20)
+    lowjob.task_groups[0].count = 1
+    lowjob.task_groups[0].tasks[0].resources.cpu = 800
+    lowjob.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), lowjob)
+    h.process("service", mock.eval_(
+        job_id=lowjob.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    low_alloc = h.store.allocs_by_job("default", lowjob.id)[0]
+    low_alloc.client_status = structs.ALLOC_CLIENT_RUNNING
+    h.store.upsert_allocs(h.next_index(), [low_alloc])
+
+    sysjob = mock.system_job(priority=70)
+    sysjob.task_groups[0].tasks[0].resources.cpu = 800
+    h.store.upsert_job(h.next_index(), sysjob)
+    h.process("system", mock.eval_(
+        job_id=sysjob.id, type="system", priority=70,
+        triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    placed = h.store.allocs_by_job("default", sysjob.id)
+    assert len(placed) == 1
+    assert placed[0].preempted_allocations == [low_alloc.id]
