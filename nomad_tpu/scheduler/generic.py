@@ -509,7 +509,12 @@ class GenericScheduler:
                 val, ok = resolve_node_target(node, c.ltarget)
                 if ok:
                     counts[str(val)] = counts.get(str(val), 0) + n_cnt
-            key = ("job" if job_scope else f"tg:{tg.name}", c.ltarget)
+            # include the job id: the fused fleet solve mixes asks from
+            # multiple jobs in one Solver.solve() with a shared prop_used
+            # map, so scope keys must not collide across jobs
+            ns = self.job.namespace
+            key = (f"job:{ns}:{self.job.id}" if job_scope
+                   else f"tg:{ns}:{self.job.id}:{tg.name}", c.ltarget)
             prop_limits[key] = (limit, counts)
 
         for c in self.job.constraints:

@@ -289,8 +289,12 @@ class EvalBroker:
             self._requeue.pop(eval_id, None)
             self._nacks += 1
             ev = u.eval
-            self._release_job_slot_locked(ev, eval_id)
+            # keep the per-job serialization slot held by the nacked eval
+            # until it is acked (reference Nack semantics) so a newer eval
+            # for the job can't jump ahead of the redelivery; the slot is
+            # only freed when the eval is parked for the failed-eval reaper
             if self._deliveries.get(eval_id, 0) >= self.delivery_limit:
+                self._release_job_slot_locked(ev, eval_id)
                 # too many failed deliveries: park it for the leader reaper
                 self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
                 self._lock.notify_all()

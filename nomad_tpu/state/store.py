@@ -490,10 +490,56 @@ class StateStore(StateSnapshot):
         else:
             a.create_index = index
         a.modify_index = index
+        self._update_deployment_with_alloc_locked(index, a, existing)
         self._t["allocs"][a.id] = a
         self._t["_allocs_by_node"].setdefault(a.node_id, set()).add(a.id)
         self._t["_allocs_by_job"].setdefault(
             (a.namespace, a.job_id), set()).add(a.id)
+
+    def _update_deployment_with_alloc_locked(self, index: int, a: Allocation,
+                                             existing) -> None:
+        """Track per-task-group deployment progress as allocs are written
+        (reference: state_store.go:4317 updateDeploymentWithAlloc) —
+        placements bump placed_allocs/placed_canaries; health transitions
+        move healthy/unhealthy counters."""
+        if not a.deployment_id:
+            return
+        dep = self._t["deployments"].get(a.deployment_id)
+        if dep is None or a.task_group not in dep.task_groups:
+            return
+        placed = healthy = unhealthy = 0
+        ex_set = (existing is not None and existing.deployment_status is not None
+                  and existing.deployment_status.healthy is not None)
+        new_set = (a.deployment_status is not None
+                   and a.deployment_status.healthy is not None)
+        if existing is None or existing.deployment_id != a.deployment_id:
+            placed += 1
+        elif not ex_set and new_set:
+            if a.deployment_status.healthy:
+                healthy += 1
+            else:
+                unhealthy += 1
+        elif ex_set and new_set:
+            if (existing.deployment_status.healthy
+                    and not a.deployment_status.healthy):
+                healthy -= 1
+                unhealthy += 1
+        is_canary = (a.deployment_status is not None
+                     and a.deployment_status.canary)
+        if placed == 0 and healthy == 0 and unhealthy == 0 and not is_canary:
+            return
+        if a.deployment_status is not None and (healthy != 0
+                                                or unhealthy != 0):
+            a.deployment_status.modify_index = index
+        d2 = dep.copy()
+        d2.modify_index = index
+        state = d2.task_groups[a.task_group]
+        state.placed_allocs += placed
+        state.healthy_allocs += healthy
+        state.unhealthy_allocs += unhealthy
+        if is_canary and a.id not in state.placed_canaries:
+            state.placed_canaries.append(a.id)
+        self._t["deployments"][d2.id] = d2
 
     def _remove_alloc(self, alloc_id: str) -> None:
         a = self._t["allocs"].pop(alloc_id, None)
@@ -523,6 +569,7 @@ class StateStore(StateSnapshot):
                 a.deployment_status = upd.deployment_status
                 a.modify_index = index
                 a.modify_time = upd.modify_time or a.modify_time
+                self._update_deployment_with_alloc_locked(index, a, existing)
                 self._t["allocs"][a.id] = a
             for key in {(u.namespace, u.job_id) for u in updates}:
                 self._refresh_job_status(index, *key)
@@ -546,6 +593,13 @@ class StateStore(StateSnapshot):
     def upsert_plan_results(self, index: int, result: PlanResult,
                             job: Optional[Job] = None) -> None:
         with self._lock:
+            # deployment first so _update_deployment_with_alloc_locked sees
+            # it when the plan's own placements land (reference order,
+            # state_store.go:253-263)
+            if result.deployment is not None:
+                self._upsert_deployment_locked(index, result.deployment)
+            for du in result.deployment_updates:
+                self._apply_deployment_update_locked(index, du)
             for allocs in result.node_update.values():
                 for a in allocs:
                     existing = self._t["allocs"].get(a.id)
@@ -563,10 +617,6 @@ class StateStore(StateSnapshot):
                     if existing is not None and a.job is None:
                         a.job = existing.job
                     self._upsert_alloc_locked(index, a)
-            if result.deployment is not None:
-                self._upsert_deployment_locked(index, result.deployment)
-            for du in result.deployment_updates:
-                self._apply_deployment_update_locked(index, du)
             touched = set()
             for m in (result.node_update, result.node_allocation,
                       result.node_preemptions):

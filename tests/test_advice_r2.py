@@ -1,0 +1,150 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs import (AllocDeploymentStatus, Deployment,
+                               DeploymentState, PlanResult)
+
+
+def _store():
+    s = StateStore()
+    return s
+
+
+def test_plan_results_track_deployment_placements_and_canaries():
+    """upsert_plan_results must bump placed_allocs / placed_canaries
+    (reference: state_store.go:4317 updateDeploymentWithAlloc)."""
+    s = _store()
+    job = mock.job()
+    s.upsert_job(1, job)
+    dep = Deployment(job_id=job.id, job_version=job.version,
+                     task_groups={"web": DeploymentState(
+                         desired_total=3, desired_canaries=1)})
+    a_canary = mock.alloc(job=job)
+    a_canary.deployment_id = dep.id
+    a_canary.deployment_status = AllocDeploymentStatus(canary=True)
+    a_plain = mock.alloc(job=job)
+    a_plain.deployment_id = dep.id
+    pr = PlanResult(node_allocation={a_canary.node_id: [a_canary, a_plain]},
+                    deployment=dep)
+    s.upsert_plan_results(2, pr, job=job)
+    d = s.deployment_by_id(dep.id)
+    state = d.task_groups["web"]
+    assert state.placed_allocs == 2
+    assert state.placed_canaries == [a_canary.id]
+    assert state.healthy_allocs == 0
+
+
+def test_client_health_updates_move_deployment_counters():
+    """Healthy / unhealthy transitions from client updates must be
+    reflected in DeploymentState (healthy_allocs / unhealthy_allocs)."""
+    s = _store()
+    job = mock.job()
+    s.upsert_job(1, job)
+    dep = Deployment(job_id=job.id,
+                     task_groups={"web": DeploymentState(desired_total=2)})
+    a1 = mock.alloc(job=job)
+    a1.deployment_id = dep.id
+    a2 = mock.alloc(job=job)
+    a2.deployment_id = dep.id
+    pr = PlanResult(node_allocation={a1.node_id: [a1, a2]}, deployment=dep)
+    s.upsert_plan_results(2, pr, job=job)
+
+    u1 = mock.alloc(job=job)
+    u1.id = a1.id
+    u1.client_status = structs.ALLOC_CLIENT_RUNNING
+    u1.deployment_id = dep.id
+    u1.deployment_status = AllocDeploymentStatus(healthy=True)
+    s.update_allocs_from_client(3, [u1])
+    d = s.deployment_by_id(dep.id)
+    assert d.task_groups["web"].healthy_allocs == 1
+    assert d.task_groups["web"].unhealthy_allocs == 0
+
+    # healthy -> unhealthy moves the counter over
+    u2 = mock.alloc(job=job)
+    u2.id = a1.id
+    u2.client_status = structs.ALLOC_CLIENT_FAILED
+    u2.deployment_id = dep.id
+    u2.deployment_status = AllocDeploymentStatus(healthy=False)
+    s.update_allocs_from_client(4, [u2])
+    d = s.deployment_by_id(dep.id)
+    assert d.task_groups["web"].healthy_allocs == 0
+    assert d.task_groups["web"].unhealthy_allocs == 1
+
+    # second alloc reporting unhealthy from scratch
+    u3 = mock.alloc(job=job)
+    u3.id = a2.id
+    u3.client_status = structs.ALLOC_CLIENT_FAILED
+    u3.deployment_id = dep.id
+    u3.deployment_status = AllocDeploymentStatus(healthy=False)
+    s.update_allocs_from_client(5, [u3])
+    d = s.deployment_by_id(dep.id)
+    assert d.task_groups["web"].unhealthy_allocs == 2
+
+
+def test_distinct_property_isolated_between_jobs_in_fused_solve():
+    """Two jobs sharing a tg name and constraining the same attribute must
+    not share distinct_property charges in one fused fleet batch."""
+    from nomad_tpu.scheduler.fleet import process_fleet
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.server.worker import Worker
+
+    server = Server(num_workers=0)
+    server.start()
+    try:
+        for i in range(2):
+            n = mock.node()
+            n.meta["rack"] = "r1"   # one shared property value
+            server.register_node(n)
+        jobs = []
+        for i in range(2):
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            for t in tg.tasks:
+                t.resources.networks = []
+            tg.constraints = list(tg.constraints) + [structs.Constraint(
+                ltarget="${meta.rack}",
+                operand=structs.CONSTRAINT_DISTINCT_PROPERTY)]
+            jobs.append(job)
+            server.register_job(job)
+        batch = server.broker.dequeue_batch(["service"], 8, 1.0)
+        assert len(batch) == 2
+        w = Worker(server, ["service"])
+        process_fleet(server, w, batch)
+        # each job gets its own limit-1 charge on rack=r1: both place
+        for job in jobs:
+            allocs = server.store.allocs_by_job("default", job.id)
+            assert len(allocs) == 1, \
+                f"{job.id}: cross-job property charge leaked"
+    finally:
+        server.stop()
+
+
+def test_nacked_eval_keeps_job_slot_until_ack():
+    """A nacked eval must be redelivered before any newer eval for the
+    same job (reference Nack keeps jobEvals held)."""
+    b = EvalBroker(initial_nack_delay_s=0.05)
+    b.set_enabled(True)
+    e1 = mock.eval_(job_id="job-x")
+    e2 = mock.eval_(job_id="job-x")
+    b.enqueue(e1)
+    b.enqueue(e2)
+    ev, token = b.dequeue(["service"], 1.0)
+    assert ev.id == e1.id
+    b.nack(ev.id, token)
+    # e2 must NOT be deliverable while e1 awaits redelivery
+    got, token = b.dequeue(["service"], 0.02)
+    assert got is None or got.id == e1.id
+    if got is None:
+        deadline = time.time() + 2.0
+        while got is None and time.time() < deadline:
+            got, token = b.dequeue(["service"], 0.1)
+        assert got is not None
+    assert got.id == e1.id, "newer eval jumped ahead of nacked redelivery"
+    b.ack(e1.id, token)
+    ev2, t2 = b.dequeue(["service"], 1.0)
+    assert ev2.id == e2.id
+    b.ack(ev2.id, t2)
