@@ -14,17 +14,19 @@ import time as _time
 from typing import Dict, Iterable, List, Optional
 
 from ..state.store import StateStore
-from ..structs import (ALLOC_CLIENT_FAILED, EVAL_STATUS_PENDING,
-                       EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_JOB_REGISTER,
-                       EVAL_TRIGGER_NODE_UPDATE,
+from ..structs import (ALLOC_CLIENT_FAILED, CORE_JOB_PRIORITY,
+                       EVAL_STATUS_PENDING, EVAL_TRIGGER_JOB_DEREGISTER,
+                       EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
                        EVAL_TRIGGER_RETRY_FAILED_ALLOC, JOB_TYPE_CORE,
                        JOB_TYPE_SERVICE, NODE_STATUS_DOWN, NODE_STATUS_READY,
                        SCHEDULERS, Allocation, Evaluation, Job, Node, Plan,
                        PlanResult)
 from ..utils.ids import generate_uuid
+from ..utils.timetable import TimeTable
 from .blocked_evals import BlockedEvals
 from .eval_broker import EvalBroker
 from .heartbeat import NodeHeartbeater
+from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
@@ -36,7 +38,12 @@ class Server:
                  batch_size: int = 8,
                  min_heartbeat_ttl_s: float = 10.0,
                  heartbeat_grace_s: float = 10.0,
-                 failover_heartbeat_ttl_s: float = 300.0):
+                 failover_heartbeat_ttl_s: float = 300.0,
+                 gc_interval_s: float = 300.0,
+                 job_gc_threshold_s: float = 4 * 3600.0,
+                 eval_gc_threshold_s: float = 3600.0,
+                 node_gc_threshold_s: float = 24 * 3600.0,
+                 deployment_gc_threshold_s: float = 3600.0):
         self.store = StateStore()
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
@@ -54,6 +61,14 @@ class Server:
             min_heartbeat_ttl_s=min_heartbeat_ttl_s,
             heartbeat_grace_s=heartbeat_grace_s,
             failover_heartbeat_ttl_s=failover_heartbeat_ttl_s)
+        self.periodic = PeriodicDispatcher(self)
+        self.time_table = TimeTable()
+        self.gc_interval_s = gc_interval_s
+        self.job_gc_threshold_s = job_gc_threshold_s
+        self.eval_gc_threshold_s = eval_gc_threshold_s
+        self.node_gc_threshold_s = node_gc_threshold_s
+        self.deployment_gc_threshold_s = deployment_gc_threshold_s
+        self._gc_timer: Optional[threading.Thread] = None
         self._started = False
         self._stop_reapers = threading.Event()
         self._dup_reaper: Optional[threading.Thread] = None
@@ -77,11 +92,20 @@ class Server:
         self.heartbeater.set_enabled(True)
         self.heartbeater.initialize(
             n.id for n in self.store.nodes() if not n.terminal_status())
+        # periodic jobs resume their schedules (leader.go restorePeriodicDispatcher)
+        self.periodic.set_enabled(True)
+        for job in self.store.jobs():
+            if job.is_periodic():
+                self.periodic.add(job)
+        self._gc_timer = threading.Thread(target=self._schedule_periodic_gc,
+                                          daemon=True)
+        self._gc_timer.start()
         self._started = True
         self._restore_evals()
 
     def stop(self) -> None:
         self.heartbeater.set_enabled(False)
+        self.periodic.set_enabled(False)
         self._stop_reapers.set()
         for w in self.workers:
             w.shutdown()
@@ -117,9 +141,37 @@ class Server:
             elif ev.should_block():
                 self.blocked_evals.block(ev)
 
+    def _schedule_periodic_gc(self) -> None:
+        """Leader timer enqueueing core GC evals (leader.go:513
+        schedulePeriodic; the evals are broker-only, not persisted, to
+        avoid duplication across restarts)."""
+        from ..scheduler.core import (CORE_JOB_DEPLOYMENT_GC,
+                                      CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC,
+                                      CORE_JOB_NODE_GC)
+        while not self._stop_reapers.wait(self.gc_interval_s):
+            for kind in (CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC,
+                         CORE_JOB_JOB_GC, CORE_JOB_DEPLOYMENT_GC):
+                self.broker.enqueue(self._core_job_eval(kind))
+
+    def _core_job_eval(self, kind: str) -> Evaluation:
+        index = self.store.latest_index()
+        return Evaluation(
+            namespace="-", type=JOB_TYPE_CORE, job_id=f"{kind}:{index}",
+            priority=CORE_JOB_PRIORITY, status=EVAL_STATUS_PENDING,
+            triggered_by="scheduled")
+
+    def force_gc(self) -> Evaluation:
+        """Run every GC pass with the threshold maxed (core_sched.go:67)."""
+        from ..scheduler.core import CORE_JOB_FORCE_GC
+        ev = self._core_job_eval(CORE_JOB_FORCE_GC)
+        self.broker.enqueue(ev)
+        return ev
+
     # -------------------------------------------------------- write paths
     def _next_index(self) -> int:
-        return self.store.latest_index() + 1
+        index = self.store.latest_index() + 1
+        self.time_table.witness(index)
+        return index
 
     def register_node(self, node: Node) -> int:
         with self._apply_lock:
@@ -182,11 +234,18 @@ class Server:
             self._create_node_evals(node, index)
         return index
 
-    def register_job(self, job: Job) -> Evaluation:
+    def register_job(self, job: Job) -> Optional[Evaluation]:
         job.canonicalize()
         with self._apply_lock:
             index = self._next_index()
             self.store.upsert_job(index, job)
+        # periodic parents and parameterized jobs are templates: tracked by
+        # their dispatchers, never evaluated directly (job_endpoint.go:308)
+        if job.is_periodic():
+            self.periodic.add(job)
+            return None
+        if job.is_parameterized():
+            return None
         ev = Evaluation(
             namespace=job.namespace, priority=job.priority, type=job.type,
             triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
@@ -209,6 +268,9 @@ class Server:
                 j2.stop = True
                 self.store.upsert_job(index, j2)
         self.blocked_evals.untrack(namespace, job_id)
+        self.periodic.remove(namespace, job_id)
+        if job.is_periodic() or job.is_parameterized():
+            return None
         ev = Evaluation(
             namespace=namespace, priority=job.priority, type=job.type,
             triggered_by=EVAL_TRIGGER_JOB_DEREGISTER, job_id=job_id,
@@ -302,6 +364,45 @@ class Server:
                     triggered_by=EVAL_TRIGGER_NODE_UPDATE, node_id=node.id,
                     status=EVAL_STATUS_PENDING))
         self._create_evals(evals)
+
+    # ----------------------------------------------------------- GC reaps
+    def reap_evals(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
+        """Eval.Reap analog: delete evals + allocs in one apply."""
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.delete_eval(index, eval_ids, alloc_ids)
+        return index
+
+    def reap_jobs(self, keys: List) -> int:
+        """Job.BatchDeregister(purge) analog; keys = (namespace, id)."""
+        with self._apply_lock:
+            index = self._next_index()
+            for namespace, job_id in keys:
+                self.store.delete_job(index, namespace, job_id)
+        return index
+
+    def reap_nodes(self, node_ids: List[str]) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            for nid in node_ids:
+                self.store.delete_node(index, nid)
+        for nid in node_ids:
+            self.heartbeater.clear(nid)
+        return index
+
+    def reap_deployments(self, dep_ids: List[str]) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.delete_deployment(index, dep_ids)
+        return index
+
+    def record_periodic_launch(self, namespace: str, job_id: str,
+                               launch: float) -> int:
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.upsert_periodic_launch(index, namespace, job_id,
+                                              launch)
+        return index
 
     # ------------------------------------------------------- plan applier
     def _apply_plan(self, plan: Plan, result: PlanResult) -> int:

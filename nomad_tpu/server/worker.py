@@ -66,8 +66,16 @@ class Worker(threading.Thread):
         wait_index = max(ev.modify_index, ev.snapshot_index)
         server.store.wait_for_index(wait_index, timeout=5.0)
         try:
-            sched = new_scheduler(ev.type, server.store, self)
-            err = sched.process(ev)
+            from ..structs import JOB_TYPE_CORE
+            if ev.type == JOB_TYPE_CORE:
+                # administrative GC runs against a snapshot and reaps
+                # through the server (worker.go:258, core_sched.go:46)
+                from ..scheduler.core import CoreScheduler
+                CoreScheduler(server, server.store.snapshot()).process(ev)
+                err = None
+            else:
+                sched = new_scheduler(ev.type, server.store, self)
+                err = sched.process(ev)
         except Exception as e:
             # record the failure on the eval so a parked (delivery-limited)
             # eval isn't restored as pending after a leader restart

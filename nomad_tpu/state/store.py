@@ -163,9 +163,16 @@ class StateSnapshot:
     def allocs(self) -> Iterable[Allocation]:
         return self._t["allocs"].values()
 
+    def allocs_by_deployment(self, dep_id: str) -> List[Allocation]:
+        return [a for a in self._t["allocs"].values()
+                if a.deployment_id == dep_id]
+
     # -- deployments --
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._t["deployments"].get(dep_id)
+
+    def deployments(self) -> Iterable[Deployment]:
+        return self._t["deployments"].values()
 
     def deployments_by_job(self, namespace: str, job_id: str) -> List[Deployment]:
         return [d for d in self._t["deployments"].values()
