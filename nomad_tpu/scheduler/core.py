@@ -164,13 +164,14 @@ class CoreScheduler:
 
     @staticmethod
     def _job_gc_eligible(job: Job) -> bool:
-        """GC-eligible jobs: dead and not parameterized; periodic jobs are
-        GC'd only once stopped (reference: state JobsByGC semantics)."""
-        if job.is_parameterized():
-            return False
-        if job.is_periodic():
-            return job.stopped() and job.status == JOB_STATUS_DEAD
-        return job.status == JOB_STATUS_DEAD
+        """reference: state/schema.go:244 jobIsGCable — periodic and
+        parameterized templates are GC'd on stop alone; other jobs must be
+        dead AND either explicitly stopped or batch-typed (a dead-but-not-
+        stopped service job keeps its definition)."""
+        if job.is_parameterized() or job.is_periodic():
+            return job.stop
+        return (job.status == JOB_STATUS_DEAD
+                and (job.stop or job.type == JOB_TYPE_BATCH))
 
     def node_gc(self, ev: Evaluation) -> None:
         threshold = self._threshold(ev, self.server.node_gc_threshold_s)

@@ -14,10 +14,13 @@ node's authoritative deadline, and stale heap entries are skipped.
 from __future__ import annotations
 
 import heapq
+import logging
 import random
 import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
 
 
 def rate_scaled_interval(rate: float, min_s: float, n: int) -> float:
@@ -139,4 +142,11 @@ class NodeHeartbeater:
                     self._cv.wait(wait)
                     continue
             for nid in expired:
-                self._on_expire(nid)
+                # the callback races node deletion (reap_nodes); an exception
+                # here must not kill the watcher and silently disable failure
+                # detection for the whole cluster
+                try:
+                    self._on_expire(nid)
+                except Exception:
+                    _log.exception(
+                        "heartbeat expiry callback failed for node %s", nid)

@@ -11,15 +11,34 @@ from __future__ import annotations
 
 import copy
 import heapq
+import logging
 import threading
 import time as _time
-from datetime import datetime
+from datetime import datetime, timezone
 from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
 
 from ..structs import Job
 from ..utils.cron import Cron, CronParseError
 
 PERIODIC_LAUNCH_SUFFIX = "/periodic-"
+
+
+def _job_tz(job: Job):
+    """Periodic specs evaluate in UTC unless the job names a time_zone
+    (reference: structs.go PeriodicConfig.GetLocation) — never the
+    server-local zone, which would shift launches with host TZ."""
+    name = getattr(job.periodic, "timezone", "") or "UTC"
+    if name.upper() in ("UTC", "LOCAL", ""):
+        return timezone.utc
+    try:
+        from zoneinfo import ZoneInfo
+        return ZoneInfo(name)
+    except Exception:
+        _log.warning("periodic job %s/%s: unknown time_zone %r, "
+                     "falling back to UTC", job.namespace, job.id, name)
+        return timezone.utc
 
 
 def next_launch(job: Job, after: float) -> Optional[float]:
@@ -30,7 +49,7 @@ def next_launch(job: Job, after: float) -> Optional[float]:
         cron = Cron(job.periodic.spec)
     except CronParseError:
         return None
-    nxt = cron.next(datetime.fromtimestamp(after))
+    nxt = cron.next(datetime.fromtimestamp(after, tz=_job_tz(job)))
     return None if nxt is None else nxt.timestamp()
 
 

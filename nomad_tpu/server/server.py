@@ -54,7 +54,13 @@ class Server:
                                    self._apply_plan, self._create_evals)
         self.enabled_schedulers = enabled_schedulers or [
             s for s in SCHEDULERS if s != JOB_TYPE_CORE]
-        self.workers = [Worker(self, self.enabled_schedulers)
+        # every worker must also drain the core queue or GC evals pile up
+        # forever (reference: server.go setupWorkers forces JobTypeCore into
+        # each worker's enabled set)
+        worker_types = list(self.enabled_schedulers)
+        if JOB_TYPE_CORE not in worker_types:
+            worker_types.append(JOB_TYPE_CORE)
+        self.workers = [Worker(self, worker_types)
                         for _ in range(num_workers)]
         self.heartbeater = NodeHeartbeater(
             self._on_heartbeat_expired,

@@ -27,7 +27,8 @@ def _parse_field(spec: str, lo: int, hi: int) -> Set[int]:
     out: Set[int] = set()
     for part in spec.split(","):
         step = 1
-        if "/" in part:
+        stepped = "/" in part
+        if stepped:
             part, step_s = part.split("/", 1)
             try:
                 step = int(step_s)
@@ -48,6 +49,10 @@ def _parse_field(spec: str, lo: int, hi: int) -> Set[int]:
                 lo2 = hi2 = int(part)
             except ValueError:
                 raise CronParseError(f"bad value {part!r}")
+            if stepped:
+                # cronexpr semantics: "a/n" means the range a..max stepped
+                # by n, not the single value a
+                hi2 = hi
         if lo2 < lo or hi2 > hi or lo2 > hi2:
             raise CronParseError(f"value out of range: {part!r}")
         out.update(range(lo2, hi2 + 1, step))

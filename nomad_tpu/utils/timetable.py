@@ -22,10 +22,11 @@ class TimeTable:
         when = _time.time() if when is None else when
         with self._lock:
             if (self._witnesses
-                    and when - self._witnesses[-1][1] < self.granularity
-                    and index != self._witnesses[-1][0]):
-                # too soon for a new row: keep the latest index for the slot
-                self._witnesses[-1] = (index, self._witnesses[-1][1])
+                    and when - self._witnesses[-1][1] < self.granularity):
+                # too soon for a new row: conservatively keep the older
+                # index for this slot so nearest_index never attributes an
+                # index to a time before it happened (reference:
+                # nomad/timetable.go Witness skips within granularity)
                 return
             self._witnesses.append((index, when))
             if len(self._witnesses) > self.limit:

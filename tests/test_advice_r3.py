@@ -1,0 +1,133 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md r2)."""
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.core import CoreScheduler
+from nomad_tpu.server.heartbeat import NodeHeartbeater
+from nomad_tpu.server.periodic import next_launch
+from nomad_tpu.server.server import Server
+from nomad_tpu.utils.cron import Cron
+from nomad_tpu.utils.timetable import TimeTable
+
+
+def test_workers_always_dequeue_core_evals():
+    """high: GC evals must be drained even though JOB_TYPE_CORE is not in
+    enabled_schedulers (reference: server.go setupWorkers)."""
+    srv = Server(num_workers=1)
+    assert structs.JOB_TYPE_CORE not in srv.enabled_schedulers
+    for w in srv.workers:
+        assert structs.JOB_TYPE_CORE in w.sched_types
+
+
+def test_force_gc_reaps_end_to_end():
+    """high: force_gc() must actually reap through a running worker."""
+    srv = Server(num_workers=1)
+    # a stopped, dead job with a terminal eval: GC-eligible
+    job = mock.job(stop=True, status=structs.JOB_STATUS_DEAD)
+    srv.store.upsert_job(srv.store.latest_index() + 1, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_COMPLETE)
+    srv.store.upsert_evals(srv.store.latest_index() + 1, [ev])
+    srv.start()
+    try:
+        srv.force_gc()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if (srv.store.job_by_id(job.namespace, job.id) is None
+                    and srv.store.eval_by_id(ev.id) is None):
+                break
+            time.sleep(0.05)
+        assert srv.store.job_by_id(job.namespace, job.id) is None
+        assert srv.store.eval_by_id(ev.id) is None
+    finally:
+        srv.stop()
+
+
+def test_job_gc_spares_dead_unstopped_service_job():
+    """medium: a dead-but-not-stopped service job keeps its definition
+    (reference: state/schema.go:244 jobIsGCable)."""
+    j = mock.job(status=structs.JOB_STATUS_DEAD, stop=False)
+    assert not CoreScheduler._job_gc_eligible(j)
+    j2 = mock.job(status=structs.JOB_STATUS_DEAD, stop=True)
+    assert CoreScheduler._job_gc_eligible(j2)
+
+
+def test_job_gc_dead_batch_job_eligible_without_stop():
+    j = mock.batch_job(status=structs.JOB_STATUS_DEAD, stop=False)
+    assert CoreScheduler._job_gc_eligible(j)
+
+
+def test_job_gc_stopped_periodic_eligible_without_dead():
+    """Periodic/parameterized templates GC on stop alone."""
+    j = mock.job(stop=True, status=structs.JOB_STATUS_PENDING)
+    j.periodic = structs.PeriodicConfig(spec="* * * * *")
+    assert CoreScheduler._job_gc_eligible(j)
+    j.stop = False
+    assert not CoreScheduler._job_gc_eligible(j)
+
+
+def test_heartbeat_watcher_survives_on_expire_exception():
+    """medium: an exception in on_expire must not kill the watcher."""
+    fired = []
+
+    def boom(node_id):
+        fired.append(node_id)
+        if len(fired) == 1:
+            raise KeyError("node deleted concurrently")
+
+    hb = NodeHeartbeater(boom, min_heartbeat_ttl_s=0.05,
+                         heartbeat_grace_s=0.0)
+    hb.max_rate = 0.0
+    hb.set_enabled(True)
+    try:
+        hb.reset("n1")
+        deadline = time.time() + 2.0
+        while time.time() < deadline and len(fired) < 1:
+            time.sleep(0.02)
+        assert fired == ["n1"]
+        # the watcher must still be alive to expire a second node
+        hb.reset("n2")
+        deadline = time.time() + 2.0
+        while time.time() < deadline and len(fired) < 2:
+            time.sleep(0.02)
+        assert fired == ["n1", "n2"]
+    finally:
+        hb.set_enabled(False)
+
+
+def test_timetable_witness_conservative_within_granularity():
+    """low: a newer index inside the granularity window must NOT replace
+    the slot's index, or GC can reap objects newer than the cutoff."""
+    tt = TimeTable(granularity_s=1.0)
+    tt.witness(10, when=100.0)
+    tt.witness(20, when=100.5)   # within granularity: skipped
+    assert tt.nearest_index(100.4) == 10
+    assert tt.nearest_index(101.0) == 10   # index 20 never attributed early
+    tt.witness(20, when=101.5)
+    assert tt.nearest_index(101.6) == 20
+
+
+def test_cron_single_value_step_extends_to_field_max():
+    """low: 'a/n' means the range a..max stepped by n (cronexpr), not {a}."""
+    c = Cron("10/15 * * * *")
+    assert c.minutes == {10, 25, 40, 55}
+
+
+def test_periodic_next_launch_is_timezone_stable(monkeypatch):
+    """low: launch times must not shift with the server's local TZ."""
+    import os
+    import time as _t
+    job = mock.job()
+    job.periodic = structs.PeriodicConfig(spec="0 12 * * *")  # daily noon
+    after = 1_700_000_000.0
+    base = next_launch(job, after)
+    old_tz = os.environ.get("TZ")
+    try:
+        os.environ["TZ"] = "Pacific/Kiritimati"   # UTC+14
+        _t.tzset()
+        assert next_launch(job, after) == base
+    finally:
+        if old_tz is None:
+            os.environ.pop("TZ", None)
+        else:
+            os.environ["TZ"] = old_tz
+        _t.tzset()

@@ -1,0 +1,227 @@
+"""CoreScheduler GC tests (reference: nomad/core_sched_test.go)."""
+import time
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.core import (CORE_JOB_EVAL_GC, CORE_JOB_FORCE_GC,
+                                      CoreScheduler, alloc_gc_eligible)
+from nomad_tpu.server.server import Server
+from nomad_tpu.structs import (RescheduleEvent, ReschedulePolicy,
+                               RescheduleTracker)
+
+
+def _server():
+    srv = Server(num_workers=0)
+    return srv
+
+
+def _core_eval(kind):
+    return mock.eval_(namespace="-", type=structs.JOB_TYPE_CORE,
+                      job_id=f"{kind}:0")
+
+
+def _put_job(srv, job):
+    srv.store.upsert_job(srv.store.latest_index() + 1, job)
+
+
+def _put_eval(srv, ev):
+    srv.store.upsert_evals(srv.store.latest_index() + 1, [ev])
+
+
+def _put_alloc(srv, a):
+    srv.store.upsert_allocs(srv.store.latest_index() + 1, [a])
+
+
+def _run(srv, kind):
+    CoreScheduler(srv, srv.store.snapshot()).process(_core_eval(kind))
+
+
+def test_eval_gc_reaps_terminal_eval_and_allocs():
+    """core_sched_test.go TestCoreScheduler_EvalGC."""
+    srv = _server()
+    job = mock.job(stop=True, status=structs.JOB_STATUS_DEAD)
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_COMPLETE)
+    _put_eval(srv, ev)
+    a = mock.alloc(job=job, eval_id=ev.id,
+                   desired_status=structs.ALLOC_DESIRED_STOP,
+                   client_status=structs.ALLOC_CLIENT_COMPLETE)
+    _put_alloc(srv, a)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.eval_by_id(ev.id) is None
+    assert srv.store.alloc_by_id(a.id) is None
+
+
+def test_eval_gc_spares_non_terminal_eval():
+    srv = _server()
+    ev = mock.eval_(status=structs.EVAL_STATUS_PENDING)
+    _put_eval(srv, ev)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.eval_by_id(ev.id) is not None
+
+
+def test_eval_gc_spares_eval_with_running_alloc():
+    srv = _server()
+    job = mock.job()
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_COMPLETE)
+    _put_eval(srv, ev)
+    a = mock.alloc(job=job, eval_id=ev.id,
+                   client_status=structs.ALLOC_CLIENT_RUNNING)
+    _put_alloc(srv, a)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.eval_by_id(ev.id) is not None
+    assert srv.store.alloc_by_id(a.id) is not None
+
+
+def test_eval_gc_batch_job_allocs_survive():
+    """A running batch job's terminal allocs must survive eval GC or the
+    scheduler would re-run them (core_sched.go:305)."""
+    srv = _server()
+    job = mock.batch_job()    # running, not stopped
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, type=structs.JOB_TYPE_BATCH,
+                    status=structs.EVAL_STATUS_COMPLETE)
+    _put_eval(srv, ev)
+    a = mock.alloc(job=job, eval_id=ev.id,
+                   desired_status=structs.ALLOC_DESIRED_RUN,
+                   client_status=structs.ALLOC_CLIENT_COMPLETE)
+    _put_alloc(srv, a)
+    _run(srv, CORE_JOB_EVAL_GC)
+    assert srv.store.eval_by_id(ev.id) is not None
+    assert srv.store.alloc_by_id(a.id) is not None
+
+
+def test_eval_gc_respects_threshold_index():
+    """Without force, only objects at-or-under the timetable cutoff go."""
+    srv = _server()
+    job = mock.job(stop=True, status=structs.JOB_STATUS_DEAD)
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_COMPLETE)
+    _put_eval(srv, ev)
+    # no timetable witnesses -> cutoff index 0 -> nothing is old enough
+    _run(srv, CORE_JOB_EVAL_GC)
+    assert srv.store.eval_by_id(ev.id) is not None
+    # witness far in the past at an index beyond the eval's
+    srv.time_table.witness(srv.store.latest_index(),
+                           when=time.time() - 7200.0)
+    _run(srv, CORE_JOB_EVAL_GC)
+    assert srv.store.eval_by_id(ev.id) is None
+
+
+def test_node_gc_reaps_down_node_without_allocs():
+    srv = _server()
+    n_down = mock.node(status=structs.NODE_STATUS_DOWN)
+    n_ready = mock.node()
+    srv.store.upsert_node(srv.store.latest_index() + 1, n_down)
+    srv.store.upsert_node(srv.store.latest_index() + 1, n_ready)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.node_by_id(n_down.id) is None
+    assert srv.store.node_by_id(n_ready.id) is not None
+
+
+def test_node_gc_spares_node_with_non_terminal_allocs():
+    srv = _server()
+    n = mock.node(status=structs.NODE_STATUS_DOWN)
+    srv.store.upsert_node(srv.store.latest_index() + 1, n)
+    job = mock.job()
+    _put_job(srv, job)
+    a = mock.alloc(job=job, node_id=n.id,
+                   client_status=structs.ALLOC_CLIENT_RUNNING)
+    _put_alloc(srv, a)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.node_by_id(n.id) is not None
+
+
+def test_deployment_gc_reaps_only_inactive():
+    srv = _server()
+    job = mock.job()
+    _put_job(srv, job)
+    d_done = structs.Deployment(job_id=job.id,
+                                status=structs.DEPLOYMENT_STATUS_SUCCESSFUL)
+    d_live = structs.Deployment(job_id=job.id,
+                                status=structs.DEPLOYMENT_STATUS_RUNNING)
+    srv.store.upsert_deployment(srv.store.latest_index() + 1, d_done)
+    srv.store.upsert_deployment(srv.store.latest_index() + 1, d_live)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.deployment_by_id(d_done.id) is None
+    assert srv.store.deployment_by_id(d_live.id) is not None
+
+
+def test_job_gc_reaps_stopped_dead_job_with_evals():
+    srv = _server()
+    job = mock.job(stop=True, status=structs.JOB_STATUS_DEAD)
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_COMPLETE)
+    _put_eval(srv, ev)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.job_by_id(job.namespace, job.id) is None
+    assert srv.store.eval_by_id(ev.id) is None
+
+
+def test_job_gc_blocked_by_non_terminal_eval():
+    srv = _server()
+    job = mock.job(stop=True, status=structs.JOB_STATUS_DEAD)
+    _put_job(srv, job)
+    ev = mock.eval_(job_id=job.id, status=structs.EVAL_STATUS_PENDING)
+    _put_eval(srv, ev)
+    _run(srv, CORE_JOB_FORCE_GC)
+    assert srv.store.job_by_id(job.namespace, job.id) is not None
+
+
+# --------------------------------------------------- allocGCEligible table
+def _failed_alloc(job, **kw):
+    return mock.alloc(job=job, client_status=structs.ALLOC_CLIENT_FAILED,
+                      desired_status=structs.ALLOC_DESIRED_RUN, **kw)
+
+
+def test_alloc_gc_failed_alloc_within_reschedule_interval_survives():
+    """core_sched.go:648 — a failed alloc whose latest reschedule attempt
+    is inside the policy interval must not be GC'd."""
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.reschedule_policy = ReschedulePolicy(
+        attempts=3, interval_s=3600.0, unlimited=False)
+    now = time.time()
+    a = _failed_alloc(job)
+    a.task_group = tg.name
+    a.reschedule_tracker = RescheduleTracker(
+        events=[RescheduleEvent(reschedule_time=now - 60.0)])
+    assert not alloc_gc_eligible(a, job, now, threshold_index=2**61)
+    # outside the interval it becomes eligible
+    a.reschedule_tracker.events[0].reschedule_time = now - 7200.0
+    assert alloc_gc_eligible(a, job, now, threshold_index=2**61)
+
+
+def test_alloc_gc_failed_alloc_with_next_allocation_eligible():
+    job = mock.job()
+    a = _failed_alloc(job)
+    a.reschedule_tracker = RescheduleTracker(
+        events=[RescheduleEvent(reschedule_time=time.time())])
+    a.next_allocation = "someone-else"
+    assert alloc_gc_eligible(a, job, time.time(), threshold_index=2**61)
+
+
+def test_alloc_gc_unlimited_policy_without_next_alloc_survives():
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.reschedule_policy = ReschedulePolicy(unlimited=True)
+    a = _failed_alloc(job)
+    a.task_group = tg.name
+    assert not alloc_gc_eligible(a, job, time.time(), threshold_index=2**61)
+    a.next_allocation = "replacement"
+    assert alloc_gc_eligible(a, job, time.time(), threshold_index=2**61)
+
+
+def test_alloc_gc_no_reschedule_policy_eligible():
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.reschedule_policy = ReschedulePolicy(attempts=0, unlimited=False)
+    a = _failed_alloc(job)
+    a.task_group = tg.name
+    assert alloc_gc_eligible(a, job, time.time(), threshold_index=2**61)
+
+
+def test_alloc_gc_non_terminal_never_eligible():
+    job = mock.job()
+    a = mock.alloc(job=job, client_status=structs.ALLOC_CLIENT_RUNNING)
+    assert not alloc_gc_eligible(a, job, time.time(), threshold_index=2**61)
