@@ -1,0 +1,24 @@
+"""Builtin task drivers (reference: drivers/ — rawexec, exec, mock, …).
+
+The compute path of this framework is JAX/XLA on TPU; drivers are the
+host-side task runtime that the client's task runners drive through the
+plugin boundary (nomad_tpu/plugins/drivers.py). Builtins:
+
+- rawexec: real subprocesses under a detached per-task executor
+  (reference: drivers/rawexec + drivers/shared/executor)
+- exec: rawexec semantics plus best-effort isolation knobs
+  (reference: drivers/exec; chroot/libcontainer isolation is replaced
+  by setsid + rlimits — containers are out of scope for this build)
+- mock: scriptable lifecycle for tests (reference: drivers/mock)
+"""
+from .mock import MockDriver
+from .rawexec import RawExecDriver
+
+
+def register_builtins(registry) -> None:
+    """reference: helper/pluginutils/catalog/register.go:15-19."""
+    registry.register(RawExecDriver())
+    registry.register(MockDriver())
+
+
+__all__ = ["RawExecDriver", "MockDriver", "register_builtins"]

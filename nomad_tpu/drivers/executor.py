@@ -1,0 +1,102 @@
+"""Per-task executor process (reference: drivers/shared/executor).
+
+The reference launches every task under an out-of-process executor so the
+workload survives agent restarts/upgrades, and the restarted agent
+re-attaches to the executor to recover the exit code. This is the same
+design: `python -m nomad_tpu.drivers.executor <spec.json>` detaches into
+its own session, spawns the task, records {pid, start_ticks} to the state
+file (start_ticks defeats pid reuse on re-attach), waits, and writes the
+exit result file that a (possibly different) agent process polls.
+
+Spec file (JSON): argv, env, cwd, stdout_path, stderr_path,
+state_file, exit_file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def proc_start_ticks(pid: int) -> int:
+    """Kernel start time of `pid` in clock ticks (field 22 of
+    /proc/<pid>/stat, after the comm field which may contain spaces)."""
+    with open(f"/proc/{pid}/stat", "rb") as f:
+        data = f.read().decode("ascii", "replace")
+    rest = data[data.rfind(")") + 2:].split()
+    return int(rest[19])           # field 22 overall; 20th after state
+
+
+def pid_alive(pid: int, start_ticks: int = 0) -> bool:
+    """Liveness with pid-reuse protection."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    if start_ticks:
+        try:
+            return proc_start_ticks(pid) == start_ticks
+        except (OSError, ValueError):
+            return False
+    return True
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+
+
+def main(spec_path: str) -> int:
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    stdout = open(spec["stdout_path"], "ab", buffering=0)
+    stderr = open(spec["stderr_path"], "ab", buffering=0)
+    try:
+        child = subprocess.Popen(
+            spec["argv"],
+            env=spec.get("env") or None,
+            cwd=spec.get("cwd") or None,
+            stdout=stdout, stderr=stderr,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,   # own pgid: killpg targets the task tree
+        )
+    except OSError as e:
+        _atomic_write_json(spec["exit_file"], {
+            "exit_code": 127, "signal": 0, "err": str(e),
+            "finished_at": time.time()})
+        return 1
+
+    _atomic_write_json(spec["state_file"], {
+        "executor_pid": os.getpid(),
+        "pid": child.pid,
+        "start_ticks": proc_start_ticks(child.pid),
+        "started_at": time.time(),
+    })
+
+    # the driver signals the task's process group directly; the executor
+    # itself ignores SIGINT/SIGTERM so an agent shutdown can't take the
+    # workload's supervisor down with it
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+
+    code = child.wait()
+    result = {"exit_code": code if code >= 0 else 0,
+              "signal": -code if code < 0 else 0,
+              "err": "",
+              "finished_at": time.time()}
+    _atomic_write_json(spec["exit_file"], result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

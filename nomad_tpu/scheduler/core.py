@@ -168,7 +168,8 @@ class CoreScheduler:
         parameterized templates are GC'd on stop alone; other jobs must be
         dead AND either explicitly stopped or batch-typed (a dead-but-not-
         stopped service job keeps its definition)."""
-        if job.is_parameterized() or job.is_periodic():
+        periodic_enabled = job.periodic is not None and job.periodic.enabled
+        if job.is_parameterized() or periodic_enabled:
             return job.stop
         return (job.status == JOB_STATUS_DEAD
                 and (job.stop or job.type == JOB_TYPE_BATCH))

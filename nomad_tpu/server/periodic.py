@@ -49,8 +49,19 @@ def next_launch(job: Job, after: float) -> Optional[float]:
         cron = Cron(job.periodic.spec)
     except CronParseError:
         return None
-    nxt = cron.next(datetime.fromtimestamp(after, tz=_job_tz(job)))
-    return None if nxt is None else nxt.timestamp()
+    dt = datetime.fromtimestamp(after, tz=_job_tz(job))
+    # DST fall-back can make a "later" wall-clock time an EARLIER instant
+    # (the repeated hour, fold=0); keep advancing until the launch is
+    # strictly in the future so the dispatcher never fires a burst of
+    # stale launches (≤62 steps covers the repeated hour at minute grain)
+    for _ in range(62):
+        nxt = cron.next(dt)
+        if nxt is None:
+            return None
+        if nxt.timestamp() > after:
+            return nxt.timestamp()
+        dt = nxt
+    return None
 
 
 def derive_job(job: Job, launch: float) -> Job:

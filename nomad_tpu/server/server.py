@@ -284,6 +284,27 @@ class Server:
         self._create_evals([ev])
         return ev
 
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          timeout: float):
+        """Blocking query for a node's allocations (reference:
+        node_endpoint.go:924 Node.GetClientAllocs — index-filtered pull
+        the client long-polls). Returns (allocs, index)."""
+        deadline = _time.monotonic() + timeout
+        while True:
+            # capture the store head BEFORE the table check: a write landing
+            # between the two reads then wakes wait_for_change immediately
+            head = self.store.latest_index()
+            index = self.store.table_index("allocs")
+            if index > min_index:
+                return self.store.allocs_by_node(node_id), index
+            remain = deadline - _time.monotonic()
+            if remain <= 0:
+                return self.store.allocs_by_node(node_id), max(index,
+                                                               min_index)
+            # wait for any write past the head, then recheck the allocs
+            # table index (other tables' writes wake us early)
+            self.store.wait_for_change(head, remain)
+
     def update_allocs_from_client(self, updates: List[Allocation]) -> int:
         """Client status sync (reference: node_endpoint.go:1063
         Node.UpdateAlloc -> fsm.go:749)."""

@@ -1,0 +1,232 @@
+"""Real client agent + task runtime tests (reference:
+client/client_test.go, allocrunner/taskrunner tests, e2e/clientstate/).
+
+The headline scenario: a real subprocess runs under raw_exec, the agent
+is killed and restarted, and the task is RE-ATTACHED, not re-run.
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.drivers.executor import pid_alive
+from nomad_tpu.server.server import Server
+
+
+@pytest.fixture
+def server():
+    srv = Server(num_workers=2)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def rawexec_job(command="/bin/sh", args=None, count=1, **kw):
+    j = mock.job(**kw)
+    j.task_groups[0].count = count
+    task = j.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": command, "args": args or []}
+    task.resources.networks = []        # keep placement trivial
+    return j
+
+
+def running_allocs(server, job_id):
+    return [a for a in server.store.allocs_by_job("default", job_id)
+            if a.client_status == structs.ALLOC_CLIENT_RUNNING]
+
+
+def task_pid(client, alloc_id, task="web"):
+    runner = client.get_alloc_runner(alloc_id)
+    assert runner is not None
+    tr = runner.task_runners[0]
+    assert tr.handle is not None
+    return tr.handle.driver_state["pid"]
+
+
+def test_rawexec_end_to_end_real_subprocess(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        job = rawexec_job(args=["-c", "sleep 30"])
+        server.register_job(job)
+        assert wait_until(lambda: len(running_allocs(server, job.id)) == 1,
+                          timeout=15)
+        alloc = running_allocs(server, job.id)[0]
+        pid = task_pid(client, alloc.id)
+        assert pid_alive(pid)
+        # stopping the job kills the real process
+        server.deregister_job("default", job.id)
+        assert wait_until(lambda: not pid_alive(pid), timeout=15)
+        assert wait_until(
+            lambda: all(a.client_terminal_status() for a in
+                        server.store.allocs_by_job("default", job.id)),
+            timeout=10)
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_agent_restart_reattaches_task(server, tmp_path):
+    """THE credibility test: kill the agent, restart it, and the task is
+    re-attached (same pid), not re-run."""
+    data_dir = str(tmp_path)
+    client = Client(server, data_dir=data_dir)
+    client.start()
+    node = client.node
+    job = rawexec_job(args=["-c", "sleep 60"])
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 1,
+                      timeout=15)
+    alloc = running_allocs(server, job.id)[0]
+    pid = task_pid(client, alloc.id)
+    started_at = client.get_alloc_runner(alloc.id) \
+        .task_runners[0].task_state().started_at
+    # hard-stop the agent WITHOUT touching the workload
+    client.shutdown(halt_tasks=False)
+    assert pid_alive(pid), "workload must survive agent death"
+
+    client2 = Client(server, data_dir=data_dir, node=node)
+    client2.start()
+    try:
+        assert wait_until(lambda: client2.get_alloc_runner(alloc.id)
+                          is not None, timeout=5)
+        runner = client2.get_alloc_runner(alloc.id)
+        tr = runner.task_runners[0]
+        assert wait_until(lambda: tr.handle is not None, timeout=5)
+        assert tr.handle.driver_state["pid"] == pid, "must re-attach"
+        assert pid_alive(pid)
+        assert tr.task_state().started_at == started_at, \
+            "restored state must keep the original start time"
+        assert tr.task_state().restarts == 0, "must not re-run"
+        # and the re-attached task can still be stopped normally
+        server.deregister_job("default", job.id)
+        assert wait_until(lambda: not pid_alive(pid), timeout=15)
+    finally:
+        client2.shutdown(halt_tasks=True)
+
+
+def test_batch_job_completes_with_exit_zero(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        job = rawexec_job(command="/bin/true")
+        job.type = structs.JOB_TYPE_BATCH
+        for tg in job.task_groups:
+            tg.reschedule_policy = structs.ReschedulePolicy(
+                attempts=0, unlimited=False)
+        server.register_job(job)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_COMPLETE
+            for a in server.store.allocs_by_job("default", job.id)),
+            timeout=15)
+        alloc = [a for a in server.store.allocs_by_job("default", job.id)][0]
+        ts = server.store.alloc_by_id(alloc.id).task_states["web"]
+        assert ts.state == structs.TASK_STATE_DEAD and not ts.failed
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_failing_batch_task_restarts_then_fails(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        job = rawexec_job(command="/bin/false")
+        job.type = structs.JOB_TYPE_BATCH
+        for tg in job.task_groups:
+            tg.restart_policy = structs.RestartPolicy(
+                attempts=1, interval_s=300.0, delay_s=0.05, mode="fail")
+            tg.reschedule_policy = structs.ReschedulePolicy(
+                attempts=0, unlimited=False)
+        server.register_job(job)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_FAILED
+            for a in server.store.allocs_by_job("default", job.id)),
+            timeout=20)
+        alloc = server.store.allocs_by_job("default", job.id)[0]
+        ts = server.store.alloc_by_id(alloc.id).task_states["web"]
+        assert ts.failed
+        assert ts.restarts == 1, "one restart attempt before failing"
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_task_env_and_stdout_capture(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        job = rawexec_job(
+            args=["-c", 'echo "alloc=$NOMAD_ALLOC_ID task=$NOMAD_TASK_NAME '
+                        'job=$NOMAD_JOB_ID custom=$FOO"'])
+        job.type = structs.JOB_TYPE_BATCH
+        for tg in job.task_groups:
+            tg.reschedule_policy = structs.ReschedulePolicy(
+                attempts=0, unlimited=False)
+        server.register_job(job)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_COMPLETE
+            for a in server.store.allocs_by_job("default", job.id)),
+            timeout=15)
+        alloc = server.store.allocs_by_job("default", job.id)[0]
+        runner = client.get_alloc_runner(alloc.id)
+        out_path = runner.alloc_dir.stdout_path("web")
+        assert wait_until(lambda: os.path.exists(out_path)
+                          and os.path.getsize(out_path) > 0, timeout=5)
+        out = open(out_path).read()
+        assert f"alloc={alloc.id}" in out
+        assert "task=web" in out
+        assert f"job={job.id}" in out
+        assert "custom=bar" in out     # mock job env FOO=bar, interpolated
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_deployment_health_reported(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        job = rawexec_job(args=["-c", "sleep 30"])
+        job.task_groups[0].update = structs.UpdateStrategy(
+            max_parallel=1, min_healthy_time_s=0.2,
+            healthy_deadline_s=30.0)
+        server.register_job(job)
+        assert wait_until(lambda: len(running_allocs(server, job.id)) == 1,
+                          timeout=15)
+        alloc = running_allocs(server, job.id)[0]
+        assert alloc.deployment_id, "service update should open a deployment"
+        assert wait_until(
+            lambda: (server.store.alloc_by_id(alloc.id).deployment_status
+                     is not None
+                     and server.store.alloc_by_id(alloc.id)
+                     .deployment_status.is_healthy()),
+            timeout=10), "health watcher must report healthy"
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_node_fingerprint_registers_drivers(server, tmp_path):
+    client = Client(server, data_dir=str(tmp_path))
+    client.start()
+    try:
+        node = server.store.node_by_id(client.node.id)
+        assert node is not None and node.ready()
+        assert node.attributes.get("driver.raw_exec") == "1"
+        assert node.attributes.get("driver.mock_driver") == "1"
+        assert node.attributes.get("cpu.numcores")
+        assert node.computed_class
+    finally:
+        client.shutdown(halt_tasks=True)
+
+
+def test_node_identity_persisted_across_restarts(server, tmp_path):
+    c1 = Client(server, data_dir=str(tmp_path))
+    node_id = c1.node.id
+    c1.start()
+    c1.shutdown()
+    c2 = Client(server, data_dir=str(tmp_path))
+    try:
+        assert c2.node.id == node_id
+    finally:
+        c2.state_db.close()

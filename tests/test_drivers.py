@@ -1,0 +1,205 @@
+"""Driver plugin boundary unit tests (reference: drivers/rawexec and
+drivers/mock driver tests) plus codec/state-DB round-trips."""
+import json
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.state import MemDB, StateDB
+from nomad_tpu.drivers.executor import pid_alive, proc_start_ticks
+from nomad_tpu.drivers.mock import MockDriver
+from nomad_tpu.drivers.rawexec import RawExecDriver
+from nomad_tpu.plugins.drivers import (DriverError, TaskConfig, TaskHandle,
+                                       TaskNotFoundError, default_registry)
+from nomad_tpu.utils.codec import from_wire, to_wire
+
+
+def task_cfg(tmp_path, name="t1", command="/bin/sh", args=None, env=None):
+    task_dir = str(tmp_path / name)
+    logs = str(tmp_path / "logs")
+    os.makedirs(task_dir, exist_ok=True)
+    os.makedirs(logs, exist_ok=True)
+    return TaskConfig(
+        id=f"alloc1/{name}", name=name, alloc_id="alloc1",
+        env=env or {}, config={"command": command, "args": args or []},
+        task_dir=task_dir, alloc_dir=str(tmp_path),
+        stdout_path=os.path.join(logs, f"{name}.stdout.0"),
+        stderr_path=os.path.join(logs, f"{name}.stderr.0"))
+
+
+# ----------------------------------------------------------------- rawexec
+def test_rawexec_runs_and_exits_zero(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path, command="/bin/sh",
+                   args=["-c", "echo hello; exit 0"])
+    handle = drv.start_task(cfg)
+    assert handle.driver_state["pid"] > 0
+    result = drv.wait_task(cfg.id, timeout=10.0)
+    assert result is not None and result.exit_code == 0
+    assert "hello" in open(cfg.stdout_path).read()
+    drv.destroy_task(cfg.id)
+
+
+def test_rawexec_nonzero_exit(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path, args=["-c", "exit 3"])
+    drv.start_task(cfg)
+    result = drv.wait_task(cfg.id, timeout=10.0)
+    assert result.exit_code == 3 and not result.successful()
+
+
+def test_rawexec_stop_kills_process_group(tmp_path):
+    drv = RawExecDriver()
+    # the child spawns a grandchild; killpg must take both down
+    cfg = task_cfg(tmp_path, args=["-c", "sleep 60 & wait"])
+    h = drv.start_task(cfg)
+    pid = h.driver_state["pid"]
+    assert pid_alive(pid)
+    t0 = time.monotonic()
+    drv.stop_task(cfg.id, timeout_s=2.0)
+    assert not pid_alive(pid)
+    result = drv.wait_task(cfg.id, timeout=5.0)
+    assert result is not None and result.signal != 0
+
+
+def test_rawexec_recover_live_task(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path, args=["-c", "sleep 30"])
+    handle = drv.start_task(cfg)
+    # simulate a fresh driver instance (agent restart)
+    wire = to_wire(handle)
+    drv2 = RawExecDriver()
+    h2 = from_wire(TaskHandle, json.loads(json.dumps(wire)))
+    drv2.recover_task(h2)
+    status = drv2.inspect_task(cfg.id)
+    assert status.state == "running"
+    drv2.stop_task(cfg.id, timeout_s=2.0)
+    res = drv2.wait_task(cfg.id, timeout=5.0)
+    assert res is not None
+
+
+def test_rawexec_recover_finished_task_reads_exit_file(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path, args=["-c", "exit 7"])
+    handle = drv.start_task(cfg)
+    drv.wait_task(cfg.id, timeout=10.0)
+    drv2 = RawExecDriver()
+    drv2.recover_task(from_wire(TaskHandle, to_wire(handle)))
+    res = drv2.wait_task(cfg.id, timeout=5.0)
+    assert res.exit_code == 7
+
+
+def test_rawexec_bad_command_fails_start(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path, command="/no/such/binary")
+    with pytest.raises(DriverError):
+        drv.start_task(cfg)
+
+
+def test_rawexec_rejects_unknown_config_key(tmp_path):
+    drv = RawExecDriver()
+    cfg = task_cfg(tmp_path)
+    cfg.config["image"] = "nope"
+    with pytest.raises(DriverError):
+        drv.start_task(cfg)
+
+
+def test_pid_reuse_protection():
+    ticks = proc_start_ticks(os.getpid())
+    assert pid_alive(os.getpid(), ticks)
+    assert not pid_alive(os.getpid(), ticks + 12345)
+
+
+# -------------------------------------------------------------------- mock
+def test_mock_driver_run_for_and_exit_code():
+    drv = MockDriver()
+    cfg = TaskConfig(id="a/m", name="m",
+                     config={"run_for": 0.05, "exit_code": 2})
+    drv.start_task(cfg)
+    res = drv.wait_task("a/m", timeout=5.0)
+    assert res.exit_code == 2
+
+
+def test_mock_driver_start_error():
+    drv = MockDriver()
+    with pytest.raises(DriverError):
+        drv.start_task(TaskConfig(id="a/m", name="m",
+                                  config={"start_error": "boom"}))
+
+
+def test_mock_driver_recover_always_lost():
+    drv = MockDriver()
+    with pytest.raises(TaskNotFoundError):
+        drv.recover_task(TaskHandle(driver="mock_driver", task_id="gone"))
+
+
+def test_registry_fingerprints():
+    reg = default_registry()
+    assert set(reg.names()) == {"mock_driver", "raw_exec"}
+    fps = reg.fingerprints()
+    assert fps["raw_exec"].attributes["driver.raw_exec"] == "1"
+
+
+# ------------------------------------------------------------------- codec
+def test_codec_roundtrips_allocation():
+    a = mock.alloc()
+    a.job.payload = b"\x00\x01binary"
+    wire = json.loads(json.dumps(to_wire(a)))
+    back = from_wire(structs.Allocation, wire)
+    assert back.id == a.id
+    assert back.job.payload == b"\x00\x01binary"
+    assert back.job.task_groups[0].tasks[0].resources.cpu == \
+        a.job.task_groups[0].tasks[0].resources.cpu
+    assert back.allocated_resources.tasks["web"].networks[0].ip == \
+        a.allocated_resources.tasks["web"].networks[0].ip
+
+
+def test_codec_roundtrips_node():
+    n = mock.gpu_node()
+    back = from_wire(structs.Node, json.loads(json.dumps(to_wire(n))))
+    assert back.id == n.id
+    assert back.node_resources.devices[0].instances[0].id == \
+        n.node_resources.devices[0].instances[0].id
+    assert back.attributes == n.attributes
+
+
+# ---------------------------------------------------------------- state DB
+@pytest.mark.parametrize("make_db", [
+    lambda p: StateDB(os.path.join(p, "state.db")),
+    lambda p: MemDB(),
+])
+def test_state_db_roundtrip(tmp_path, make_db):
+    db = make_db(str(tmp_path))
+    a = mock.alloc()
+    db.put_allocation(a)
+    assert [x.id for x in db.get_all_allocations()] == [a.id]
+    handle = TaskHandle(driver="raw_exec", task_id=f"{a.id}/web",
+                        driver_state={"pid": 42})
+    ts = structs.TaskState(state="running", started_at=1.0)
+    db.put_task_runner_state(a.id, "web", handle, ts)
+    h2, s2 = db.get_task_runner_state(a.id, "web")
+    assert h2.driver_state["pid"] == 42
+    assert s2.state == "running"
+    # partial update: state only must not clobber the handle
+    db.put_task_runner_state(a.id, "web", None,
+                             structs.TaskState(state="dead"))
+    h3, s3 = db.get_task_runner_state(a.id, "web")
+    assert h3 is not None and h3.driver_state["pid"] == 42
+    assert s3.state == "dead"
+    db.delete_allocation(a.id)
+    assert db.get_all_allocations() == []
+    assert db.get_task_runner_state(a.id, "web") == (None, None)
+    db.close()
+
+
+def test_state_db_persists_across_reopen(tmp_path):
+    path = os.path.join(str(tmp_path), "state.db")
+    db = StateDB(path)
+    a = mock.alloc()
+    db.put_allocation(a)
+    db.close()
+    db2 = StateDB(path)
+    assert [x.id for x in db2.get_all_allocations()] == [a.id]
+    db2.close()
