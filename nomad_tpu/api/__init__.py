@@ -1,0 +1,6 @@
+"""HTTP API layer + client SDK (reference: command/agent/http.go and
+the api/ Go SDK)."""
+from .client import ApiClient, APIError
+from .http_server import HTTPAgentServer, HTTPError
+
+__all__ = ["ApiClient", "APIError", "HTTPAgentServer", "HTTPError"]

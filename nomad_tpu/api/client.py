@@ -1,0 +1,210 @@
+"""API client SDK (reference: api/ — api.NewClient api.go:400, per-
+resource files jobs.go, nodes.go, allocations.go, evaluations.go,
+deployments.go, operator.go).
+
+Talks to the agent's HTTP /v1 surface; no imports from the server
+packages — this is the external-consumer boundary the CLI uses.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class APIError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(f"HTTP {code}: {msg}")
+        self.code = code
+        self.msg = msg
+
+
+class ApiClient:
+    def __init__(self, address: Optional[str] = None,
+                 timeout: float = 330.0):
+        self.address = (address or os.environ.get("NOMAD_ADDR")
+                        or "http://127.0.0.1:4646").rstrip("/")
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.deployments = Deployments(self)
+        self.system = System(self)
+        self.agent = Agent(self)
+        self.operator = Operator(self)
+
+    # ------------------------------------------------------------ plumbing
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, Any]] = None,
+                body: Any = None) -> Tuple[Any, int]:
+        url = f"{self.address}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v not in (None, "")})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read() or b"null")
+                index = int(resp.headers.get("X-Nomad-Index") or 0)
+                return payload, index
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise APIError(e.code, msg)
+
+    def get(self, path, **params):
+        return self.request("GET", path, params=params)
+
+    def post(self, path, body=None, **params):
+        return self.request("POST", path, params=params, body=body)
+
+    def delete(self, path, **params):
+        return self.request("DELETE", path, params=params)
+
+
+class _Sub:
+    def __init__(self, client: ApiClient):
+        self.c = client
+
+
+class Jobs(_Sub):
+    def list(self, prefix: str = "", index: int = 0, wait: str = ""):
+        return self.c.get("/v1/jobs", prefix=prefix, index=index or None,
+                          wait=wait)
+
+    def register(self, job_wire: dict) -> dict:
+        return self.c.post("/v1/jobs", {"job": job_wire})[0]
+
+    def register_with_check(self, job_wire: dict,
+                            check_index: int) -> dict:
+        return self.c.post("/v1/jobs", {
+            "job": job_wire, "enforce_index": True,
+            "job_modify_index": check_index})[0]
+
+    def parse(self, hcl: str) -> dict:
+        return self.c.post("/v1/jobs/parse", {"job_hcl": hcl})[0]
+
+    def info(self, job_id: str, index: int = 0, wait: str = ""):
+        return self.c.get(f"/v1/job/{job_id}", index=index or None,
+                          wait=wait)
+
+    def deregister(self, job_id: str, purge: bool = False) -> dict:
+        return self.c.delete(f"/v1/job/{job_id}",
+                             purge="true" if purge else None)[0]
+
+    def allocations(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/allocations")[0]
+
+    def evaluations(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/evaluations")[0]
+
+    def deployments(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/deployments")[0]
+
+    def summary(self, job_id: str) -> dict:
+        return self.c.get(f"/v1/job/{job_id}/summary")[0]
+
+    def versions(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/versions")[0]
+
+    def plan(self, job_id: str, job_wire: dict) -> dict:
+        return self.c.post(f"/v1/job/{job_id}/plan",
+                           {"job": job_wire})[0]
+
+    def periodic_force(self, job_id: str) -> dict:
+        return self.c.post(f"/v1/job/{job_id}/periodic/force")[0]
+
+
+class Nodes(_Sub):
+    def list(self, prefix: str = "", index: int = 0, wait: str = ""):
+        return self.c.get("/v1/nodes", prefix=prefix, index=index or None,
+                          wait=wait)
+
+    def info(self, node_id: str) -> dict:
+        return self.c.get(f"/v1/node/{node_id}")[0]
+
+    def allocations(self, node_id: str) -> List[dict]:
+        return self.c.get(f"/v1/node/{node_id}/allocations")[0]
+
+    def drain(self, node_id: str, deadline_s: float = 3600.0,
+              ignore_system_jobs: bool = False,
+              disable: bool = False) -> dict:
+        body = {"drain_spec": None if disable else
+                {"deadline_s": deadline_s,
+                 "ignore_system_jobs": ignore_system_jobs},
+                "mark_eligible": disable}
+        return self.c.post(f"/v1/node/{node_id}/drain", body)[0]
+
+    def eligibility(self, node_id: str, eligible: bool) -> dict:
+        return self.c.post(
+            f"/v1/node/{node_id}/eligibility",
+            {"eligibility": "eligible" if eligible else "ineligible"})[0]
+
+
+class Allocations(_Sub):
+    def list(self, prefix: str = "", index: int = 0, wait: str = ""):
+        return self.c.get("/v1/allocations", prefix=prefix,
+                          index=index or None, wait=wait)
+
+    def info(self, alloc_id: str) -> dict:
+        return self.c.get(f"/v1/allocation/{alloc_id}")[0]
+
+    def stop(self, alloc_id: str) -> dict:
+        return self.c.post(f"/v1/allocation/{alloc_id}/stop")[0]
+
+
+class Evaluations(_Sub):
+    def list(self) -> List[dict]:
+        return self.c.get("/v1/evaluations")[0]
+
+    def info(self, eval_id: str) -> dict:
+        return self.c.get(f"/v1/evaluation/{eval_id}")[0]
+
+    def allocations(self, eval_id: str) -> List[dict]:
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations")[0]
+
+
+class Deployments(_Sub):
+    def list(self, index: int = 0, wait: str = ""):
+        return self.c.get("/v1/deployments", index=index or None, wait=wait)
+
+    def info(self, dep_id: str) -> dict:
+        return self.c.get(f"/v1/deployment/{dep_id}")[0]
+
+    def promote(self, dep_id: str) -> dict:
+        return self.c.post(f"/v1/deployment/promote/{dep_id}")[0]
+
+    def fail(self, dep_id: str) -> dict:
+        return self.c.post(f"/v1/deployment/fail/{dep_id}")[0]
+
+    def allocations(self, dep_id: str) -> List[dict]:
+        return self.c.get(f"/v1/deployment/allocations/{dep_id}")[0]
+
+
+class System(_Sub):
+    def gc(self) -> None:
+        self.c.post("/v1/system/gc")
+
+
+class Agent(_Sub):
+    def self_(self) -> dict:
+        return self.c.get("/v1/agent/self")[0]
+
+    def members(self) -> dict:
+        return self.c.get("/v1/agent/members")[0]
+
+    def metrics(self) -> dict:
+        return self.c.get("/v1/metrics")[0]
+
+
+class Operator(_Sub):
+    def scheduler_config(self) -> dict:
+        return self.c.get("/v1/operator/scheduler/configuration")[0]

@@ -1,0 +1,528 @@
+"""HTTP /v1 API (reference: command/agent/http.go:251-370 route table +
+the command/agent/*_endpoint.go adapters).
+
+Serves the server's verbs and the store's blocking queries over JSON.
+Wire format is the codec's snake_case encoding of the domain structs
+(this framework's own API; the shape parity with the reference is
+per-route, not per-field). Blocking queries take ?index=N&wait=5s and
+answer with the X-Nomad-Index header, exactly like the reference.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..jobspec import JobspecParseError, parse_duration_s, parse_job
+from ..structs import Evaluation, Job, Plan, PlanResult
+from ..utils.codec import from_wire, to_wire
+from ..utils.metrics import global_metrics
+
+MAX_BLOCK_S = 300.0     # reference: nomad/rpc.go:35 maxQueryTime
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+class _DryRunPlanner:
+    """Planner that records instead of committing (the Job.Plan path —
+    reference: nomad/job_endpoint.go Job.Plan runs the scheduler against
+    a snapshot with a no-op raft)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+
+    def submit_plan(self, plan: Plan):
+        self.plans.append(plan)
+        return PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            node_preemptions=plan.node_preemptions,
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+            alloc_index=self.store.latest_index()), None
+
+    def update_eval(self, ev): self.evals.append(ev)
+
+    def create_eval(self, ev): self.evals.append(ev)
+
+    def reblock_eval(self, ev): self.evals.append(ev)
+
+
+class HTTPAgentServer:
+    """The agent's HTTP listener. `server` is the in-proc control plane;
+    `client` (optional) the local node agent for agent-local routes."""
+
+    def __init__(self, server, client=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server = server
+        self.client = client
+        self._routes = _build_routes(self)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):   # quiet
+                pass
+
+            def _handle(self, method: str):
+                try:
+                    code, body, index = outer.dispatch(method, self.path,
+                                                       self._read_body())
+                except HTTPError as e:
+                    code, body, index = e.code, {"error": e.msg}, None
+                except Exception as e:
+                    import traceback
+                    traceback.print_exc()
+                    code, body, index = 500, {"error": str(e)}, None
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return None
+                raw = self.rfile.read(length)
+                if not raw:
+                    return None
+                return json.loads(raw)
+
+            def do_GET(self): self._handle("GET")
+
+            def do_POST(self): self._handle("POST")
+
+            def do_PUT(self): self._handle("PUT")
+
+            def do_DELETE(self): self._handle("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ control
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    # ----------------------------------------------------------- dispatch
+    def dispatch(self, method: str, path: str, body):
+        url = urlparse(path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        for pattern, methods in self._routes:
+            m = pattern.match(url.path)
+            if not m:
+                continue
+            fn = methods.get(method)
+            if fn is None:
+                raise HTTPError(405, f"method {method} not allowed")
+            return fn(q, body, *m.groups())
+        raise HTTPError(404, f"no handler for {url.path}")
+
+    # ------------------------------------------------------- blocking wait
+    def _block(self, q: Dict[str, str], table: str) -> int:
+        """Run the blocking-query wait; returns the index to report."""
+        store = self.server.store
+        min_index = int(q.get("index", 0) or 0)
+        if min_index <= 0:
+            return store.latest_index()
+        wait_s = min(parse_duration_s(q.get("wait", "5m")), MAX_BLOCK_S)
+        import time as _t
+        deadline = _t.monotonic() + wait_s
+        while True:
+            # capture the head BEFORE the table check so a write landing
+            # between the reads wakes the wait immediately (same pattern
+            # as Server.get_client_allocs)
+            head = store.latest_index()
+            if store.table_index(table) > min_index:
+                break
+            remain = deadline - _t.monotonic()
+            if remain <= 0:
+                break
+            store.wait_for_change(head, remain)
+        return max(store.table_index(table), min_index)
+
+    # -------------------------------------------------------------- jobs
+    def jobs_list(self, q, body):
+        index = self._block(q, "jobs")
+        prefix = q.get("prefix", "")
+        jobs = [j for j in self.server.store.jobs()
+                if j.id.startswith(prefix)]
+        out = []
+        for j in sorted(jobs, key=lambda j: j.id):
+            summary = self.server.store.job_summary(j.namespace, j.id)
+            out.append({
+                "id": j.id, "name": j.name, "namespace": j.namespace,
+                "type": j.type, "priority": j.priority, "status": j.status,
+                "stop": j.stop, "version": j.version,
+                "create_index": j.create_index,
+                "modify_index": j.modify_index,
+                "summary": to_wire(summary) if summary else None})
+        return 200, out, index
+
+    def jobs_register(self, q, body):
+        if not body or "job" not in body:
+            raise HTTPError(400, "body must carry a 'job' object")
+        job = from_wire(Job, body["job"])
+        errs = job.validate()
+        if errs:
+            raise HTTPError(400, "; ".join(errs))
+        try:
+            ev = self.server.register_job(
+                job, enforce_index=bool(body.get("enforce_index")),
+                check_index=int(body.get("job_modify_index", 0)))
+        except ValueError as e:
+            raise HTTPError(409, str(e))
+        return 200, {"eval_id": ev.id if ev else "",
+                     "job_modify_index": job.modify_index}, None
+
+    def jobs_parse(self, q, body):
+        if not body or "job_hcl" not in body:
+            raise HTTPError(400, "body must carry 'job_hcl'")
+        try:
+            job = parse_job(body["job_hcl"])
+        except JobspecParseError as e:
+            raise HTTPError(400, str(e))
+        return 200, to_wire(job), None
+
+    def _get_job(self, job_id: str) -> Job:
+        job = self.server.store.job_by_id("default", job_id)
+        if job is None:
+            raise HTTPError(404, f"job {job_id!r} not found")
+        return job
+
+    def job_get(self, q, body, job_id):
+        index = self._block(q, "jobs")
+        return 200, to_wire(self._get_job(job_id)), index
+
+    def job_update(self, q, body, job_id):
+        return self.jobs_register(q, body)
+
+    def job_delete(self, q, body, job_id):
+        purge = q.get("purge", "").lower() == "true"
+        ev = self.server.deregister_job("default", job_id, purge=purge)
+        return 200, {"eval_id": ev.id if ev else ""}, None
+
+    def job_allocations(self, q, body, job_id):
+        index = self._block(q, "allocs")
+        allocs = self.server.store.allocs_by_job("default", job_id)
+        return 200, [a.stub() for a in allocs], index
+
+    def job_evaluations(self, q, body, job_id):
+        index = self._block(q, "evals")
+        evals = self.server.store.evals_by_job("default", job_id)
+        return 200, [to_wire(e) for e in evals], index
+
+    def job_deployments(self, q, body, job_id):
+        index = self._block(q, "deployments")
+        deps = self.server.store.deployments_by_job("default", job_id)
+        return 200, [to_wire(d) for d in deps], index
+
+    def job_summary(self, q, body, job_id):
+        index = self._block(q, "jobs")
+        s = self.server.store.job_summary("default", job_id)
+        if s is None:
+            raise HTTPError(404, f"no summary for {job_id!r}")
+        return 200, to_wire(s), index
+
+    def job_versions(self, q, body, job_id):
+        versions = self.server.store.job_versions("default", job_id)
+        return 200, [to_wire(j) for j in versions], None
+
+    def job_periodic_force(self, q, body, job_id):
+        child = self.server.periodic.force_launch("default", job_id)
+        if child is None:
+            raise HTTPError(404,
+                            f"{job_id!r} is not a tracked periodic job")
+        return 200, {"child_job_id": child.id}, None
+
+    def job_plan(self, q, body, job_id):
+        """Dry-run the scheduler (reference: Job.Plan)."""
+        if not body or "job" not in body:
+            raise HTTPError(400, "body must carry a 'job' object")
+        from ..scheduler.base import new_scheduler
+        from ..structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_JOB_REGISTER
+        job = from_wire(Job, body["job"])
+        job.canonicalize()
+        planner = _DryRunPlanner(self.server.store)
+        ev = Evaluation(namespace=job.namespace, job_id=job.id,
+                        type=job.type, priority=job.priority,
+                        triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                        status=EVAL_STATUS_PENDING, annotate_plan=True)
+        # plan against a snapshot with the SUBMITTED job overlaid, so the
+        # dry-run sees the proposed version without writing state
+        snap = self.server.store.snapshot()
+        snap_index = snap.index
+        current = snap.job_by_id(job.namespace, job.id)
+        job.version = (current.version + 1) if current else 0
+        snap._t["jobs"] = dict(snap._t["jobs"])
+        snap._t["jobs"][(job.namespace, job.id)] = job
+        sched = new_scheduler(job.type, snap, planner)
+        planner_err = sched.process(ev)
+        ann = None
+        if planner.plans and planner.plans[-1].annotations is not None:
+            ann = to_wire(planner.plans[-1].annotations)
+        return 200, {
+            "annotations": ann,
+            "created_evals": [to_wire(e) for e in planner.evals],
+            "diff_seen_index": snap_index,
+            "error": str(planner_err) if planner_err else "",
+        }, None
+
+    # ------------------------------------------------------------- evals
+    def evals_list(self, q, body):
+        index = self._block(q, "evals")
+        evals = sorted(self.server.store.evals(), key=lambda e: e.id)
+        return 200, [to_wire(e) for e in evals], index
+
+    def eval_get(self, q, body, eval_id):
+        index = self._block(q, "evals")    # wait BEFORE reading
+        ev = self.server.store.eval_by_id(eval_id)
+        if ev is None:
+            raise HTTPError(404, f"eval {eval_id!r} not found")
+        return 200, to_wire(ev), index
+
+    def eval_allocations(self, q, body, eval_id):
+        allocs = self.server.store.allocs_by_eval(eval_id)
+        return 200, [a.stub() for a in allocs], None
+
+    # ------------------------------------------------------------ allocs
+    def allocs_list(self, q, body):
+        index = self._block(q, "allocs")
+        prefix = q.get("prefix", "")
+        allocs = [a for a in self.server.store.allocs()
+                  if a.id.startswith(prefix)]
+        return 200, [a.stub() for a in sorted(allocs, key=lambda a: a.id)], \
+            index
+
+    def alloc_get(self, q, body, alloc_id):
+        index = self._block(q, "allocs")
+        a = self.server.store.alloc_by_id(alloc_id)
+        if a is None:
+            raise HTTPError(404, f"alloc {alloc_id!r} not found")
+        return 200, to_wire(a), index
+
+    def alloc_stop(self, q, body, alloc_id):
+        ev = self.server.stop_alloc(alloc_id)
+        if ev is None:
+            raise HTTPError(404, f"alloc {alloc_id!r} not found")
+        return 200, {"eval_id": ev.id}, None
+
+    # ------------------------------------------------------------- nodes
+    def nodes_list(self, q, body):
+        index = self._block(q, "nodes")
+        prefix = q.get("prefix", "")
+        nodes = [n for n in self.server.store.nodes()
+                 if n.id.startswith(prefix)]
+        out = [{"id": n.id, "name": n.name, "datacenter": n.datacenter,
+                "node_class": n.node_class, "status": n.status,
+                "scheduling_eligibility": n.scheduling_eligibility,
+                "drain": n.drain_strategy is not None,
+                "modify_index": n.modify_index}
+               for n in sorted(nodes, key=lambda n: n.id)]
+        return 200, out, index
+
+    def _resolve_node(self, node_id: str) -> str:
+        node = self.server.store.node_by_id(node_id)
+        if node is not None:
+            return node.id
+        matches = [n.id for n in self.server.store.nodes()
+                   if n.id.startswith(node_id)]
+        if len(matches) == 1:
+            return matches[0]
+        raise HTTPError(404, f"node {node_id!r} not found")
+
+    def node_get(self, q, body, node_id):
+        index = self._block(q, "nodes")
+        node = self.server.store.node_by_id(self._resolve_node(node_id))
+        return 200, to_wire(node), index
+
+    def node_allocations(self, q, body, node_id):
+        index = self._block(q, "allocs")
+        allocs = self.server.store.allocs_by_node(
+            self._resolve_node(node_id))
+        return 200, [a.stub() for a in allocs], index
+
+    def node_drain(self, q, body, node_id):
+        from ..structs import DrainStrategy
+        node_id = self._resolve_node(node_id)
+        spec = (body or {}).get("drain_spec")
+        strategy = None
+        if spec is not None:
+            strategy = DrainStrategy(
+                deadline_s=float(spec.get("deadline_s", 3600.0)),
+                ignore_system_jobs=bool(spec.get("ignore_system_jobs",
+                                                 False)))
+        index = self.server.update_node_drain(
+            node_id, strategy,
+            mark_eligible=bool((body or {}).get("mark_eligible", False)))
+        return 200, {"node_modify_index": index}, None
+
+    def node_eligibility(self, q, body, node_id):
+        node_id = self._resolve_node(node_id)
+        elig = (body or {}).get("eligibility", "")
+        if elig not in ("eligible", "ineligible"):
+            raise HTTPError(400, "eligibility must be eligible|ineligible")
+        index = self.server.update_node_eligibility(node_id, elig)
+        return 200, {"node_modify_index": index}, None
+
+    def node_evaluate(self, q, body, node_id):
+        node = self.server.store.node_by_id(self._resolve_node(node_id))
+        self.server._create_node_evals(node, self.server.store.latest_index())
+        return 200, {}, None
+
+    # -------------------------------------------------------- deployments
+    def deployments_list(self, q, body):
+        index = self._block(q, "deployments")
+        deps = sorted(self.server.store.deployments(), key=lambda d: d.id)
+        return 200, [to_wire(d) for d in deps], index
+
+    def _resolve_deployment(self, dep_id: str):
+        d = self.server.store.deployment_by_id(dep_id)
+        if d is not None:
+            return d
+        matches = [d for d in self.server.store.deployments()
+                   if d.id.startswith(dep_id)]
+        if len(matches) == 1:
+            return matches[0]
+        raise HTTPError(404, f"deployment {dep_id!r} not found")
+
+    def deployment_get(self, q, body, dep_id):
+        index = self._block(q, "deployments")
+        return 200, to_wire(self._resolve_deployment(dep_id)), index
+
+    def deployment_promote(self, q, body, dep_id):
+        dep = self._resolve_deployment(dep_id)
+        fn = getattr(self.server, "promote_deployment", None)
+        if fn is None:
+            raise HTTPError(501, "deployment promotion not supported")
+        ev = fn(dep.id, all_groups=True)
+        return 200, {"eval_id": ev.id if ev else ""}, None
+
+    def deployment_fail(self, q, body, dep_id):
+        dep = self._resolve_deployment(dep_id)
+        fn = getattr(self.server, "fail_deployment", None)
+        if fn is None:
+            raise HTTPError(501, "deployment fail not supported")
+        ev = fn(dep.id)
+        return 200, {"eval_id": ev.id if ev else ""}, None
+
+    def deployment_allocations(self, q, body, dep_id):
+        dep = self._resolve_deployment(dep_id)
+        allocs = self.server.store.allocs_by_deployment(dep.id)
+        return 200, [a.stub() for a in allocs], None
+
+    # ------------------------------------------------------ agent / misc
+    def agent_self(self, q, body):
+        out = {"server": {"enabled": True,
+                          "workers": len(self.server.workers)},
+               "client": None, "version": "0.1.0"}
+        if self.client is not None:
+            out["client"] = {"enabled": True,
+                            "node_id": self.client.node.id,
+                            "allocs": self.client.num_allocs()}
+        return 200, out, None
+
+    def agent_members(self, q, body):
+        return 200, {"members": [{"name": "server-1", "status": "alive",
+                                  "leader": True}]}, None
+
+    def status_leader(self, q, body):
+        return 200, "127.0.0.1:4647", None
+
+    def status_peers(self, q, body):
+        return 200, ["127.0.0.1:4647"], None
+
+    def metrics(self, q, body):
+        return 200, global_metrics.dump(), None
+
+    def system_gc(self, q, body):
+        self.server.force_gc()
+        return 200, {}, None
+
+    def operator_scheduler_config(self, q, body):
+        cfg = self.server.store.scheduler_config()
+        return 200, to_wire(cfg), None
+
+
+def _build_routes(s: HTTPAgentServer):
+    R = re.compile
+    return [
+        (R(r"^/v1/jobs$"), {"GET": s.jobs_list, "POST": s.jobs_register,
+                            "PUT": s.jobs_register}),
+        (R(r"^/v1/jobs/parse$"), {"POST": s.jobs_parse,
+                                  "PUT": s.jobs_parse}),
+        (R(r"^/v1/job/([^/]+)$"), {"GET": s.job_get, "POST": s.job_update,
+                                   "PUT": s.job_update,
+                                   "DELETE": s.job_delete}),
+        (R(r"^/v1/job/([^/]+)/allocations$"), {"GET": s.job_allocations}),
+        (R(r"^/v1/job/([^/]+)/evaluations$"), {"GET": s.job_evaluations}),
+        (R(r"^/v1/job/([^/]+)/deployments$"), {"GET": s.job_deployments}),
+        (R(r"^/v1/job/([^/]+)/summary$"), {"GET": s.job_summary}),
+        (R(r"^/v1/job/([^/]+)/versions$"), {"GET": s.job_versions}),
+        (R(r"^/v1/job/([^/]+)/plan$"), {"POST": s.job_plan,
+                                        "PUT": s.job_plan}),
+        (R(r"^/v1/job/([^/]+)/periodic/force$"),
+         {"POST": s.job_periodic_force}),
+        (R(r"^/v1/evaluations$"), {"GET": s.evals_list}),
+        (R(r"^/v1/evaluation/([^/]+)$"), {"GET": s.eval_get}),
+        (R(r"^/v1/evaluation/([^/]+)/allocations$"),
+         {"GET": s.eval_allocations}),
+        (R(r"^/v1/allocations$"), {"GET": s.allocs_list}),
+        (R(r"^/v1/allocation/([^/]+)$"), {"GET": s.alloc_get}),
+        (R(r"^/v1/allocation/([^/]+)/stop$"), {"POST": s.alloc_stop,
+                                               "PUT": s.alloc_stop}),
+        (R(r"^/v1/nodes$"), {"GET": s.nodes_list}),
+        (R(r"^/v1/node/([^/]+)$"), {"GET": s.node_get}),
+        (R(r"^/v1/node/([^/]+)/allocations$"), {"GET": s.node_allocations}),
+        (R(r"^/v1/node/([^/]+)/drain$"), {"POST": s.node_drain,
+                                          "PUT": s.node_drain}),
+        (R(r"^/v1/node/([^/]+)/eligibility$"), {"POST": s.node_eligibility,
+                                                "PUT": s.node_eligibility}),
+        (R(r"^/v1/node/([^/]+)/evaluate$"), {"POST": s.node_evaluate,
+                                             "PUT": s.node_evaluate}),
+        (R(r"^/v1/deployments$"), {"GET": s.deployments_list}),
+        (R(r"^/v1/deployment/promote/([^/]+)$"),
+         {"POST": s.deployment_promote, "PUT": s.deployment_promote}),
+        (R(r"^/v1/deployment/fail/([^/]+)$"),
+         {"POST": s.deployment_fail, "PUT": s.deployment_fail}),
+        (R(r"^/v1/deployment/allocations/([^/]+)$"),
+         {"GET": s.deployment_allocations}),
+        (R(r"^/v1/deployment/([^/]+)$"), {"GET": s.deployment_get}),
+        (R(r"^/v1/agent/self$"), {"GET": s.agent_self}),
+        (R(r"^/v1/agent/members$"), {"GET": s.agent_members}),
+        (R(r"^/v1/status/leader$"), {"GET": s.status_leader}),
+        (R(r"^/v1/status/peers$"), {"GET": s.status_peers}),
+        (R(r"^/v1/metrics$"), {"GET": s.metrics}),
+        (R(r"^/v1/system/gc$"), {"PUT": s.system_gc,
+                                 "POST": s.system_gc}),
+        (R(r"^/v1/operator/scheduler/configuration$"),
+         {"GET": s.operator_scheduler_config}),
+    ]

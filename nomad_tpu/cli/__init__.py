@@ -1,0 +1,4 @@
+"""CLI (reference: command/ tree). Entry point: nomad_tpu.cli.main.main."""
+from .main import main
+
+__all__ = ["main"]

@@ -1,0 +1,423 @@
+"""CLI (reference: command/ — the mitchellh/cli command tree wired in
+command/commands.go; verbs: job run/status/plan/stop, node status/drain/
+eligibility, alloc status, eval status, deployment *, system gc, agent).
+
+All data flows through the HTTP API via the SDK (ApiClient) — the CLI
+never imports server internals, mirroring the reference's CLI->api->HTTP
+layering. `agent -dev` is the one exception: it BOOTS the in-process
+server+client+HTTP agent (reference: nomad agent -dev).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..api.client import ApiClient, APIError
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> str:
+    all_rows = [header] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in all_rows)
+              for i in range(len(header))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in all_rows]
+    return "\n".join(lines)
+
+
+def _short(id_: str) -> str:
+    return id_[:8] if len(id_) > 8 else id_
+
+
+def _client(args) -> ApiClient:
+    return ApiClient(address=args.address)
+
+
+# ---------------------------------------------------------------- agent
+def cmd_agent(args) -> int:
+    from ..api.http_server import HTTPAgentServer
+    from ..client.agent import Client
+    from ..server.server import Server
+
+    if not args.dev:
+        print("only -dev mode is supported", file=sys.stderr)
+        return 1
+    server = Server(num_workers=args.workers)
+    server.start()
+    client = None
+    if not args.server_only:
+        client = Client(server, data_dir=args.data_dir)
+        client.start()
+    http = HTTPAgentServer(server, client, host=args.bind, port=args.port)
+    http.start()
+    print(f"==> nomad-tpu agent started (dev mode)")
+    print(f"    HTTP: {http.address}")
+    if client is not None:
+        print(f"    Node: {client.node.id} ({client.node.name})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("==> shutting down")
+        http.stop()
+        if client is not None:
+            client.shutdown(halt_tasks=True)
+        server.stop()
+    return 0
+
+
+# ------------------------------------------------------------------ job
+def cmd_job_run(args) -> int:
+    api = _client(args)
+    with open(args.file) as f:
+        hcl = f.read()
+    job = api.jobs.parse(hcl)
+    if args.check_index is not None:
+        job["job_modify_index"] = args.check_index
+        resp = api.jobs.register_with_check(job, args.check_index)
+    else:
+        resp = api.jobs.register(job)
+    print(f"==> Job {job['id']!r} registered")
+    if resp.get("eval_id"):
+        print(f"    Evaluation ID: {resp['eval_id']}")
+        return _monitor_eval(api, resp["eval_id"], args.detach)
+    return 0
+
+
+def _monitor_eval(api: ApiClient, eval_id: str, detach: bool) -> int:
+    if detach:
+        return 0
+    for _ in range(100):
+        ev = api.evaluations.info(eval_id)
+        if ev["status"] in ("complete", "failed", "cancelled"):
+            print(f"    Evaluation {ev['status']}")
+            if ev["status"] == "complete":
+                return 0
+            if ev.get("blocked_eval"):
+                print(f"    Blocked eval: {ev['blocked_eval']}")
+            return 0 if ev["status"] == "complete" else 2
+        time.sleep(0.2)
+    print("    (still in progress; detaching)")
+    return 0
+
+
+def cmd_job_status(args) -> int:
+    api = _client(args)
+    if not args.job_id:
+        jobs, _ = api.jobs.list()
+        if not jobs:
+            print("No running jobs")
+            return 0
+        print(_fmt_table(
+            [[j["id"], j["type"], j["priority"], j["status"]]
+             for j in jobs],
+            ["ID", "Type", "Priority", "Status"]))
+        return 0
+    job, _ = api.jobs.info(args.job_id)
+    print(f"ID            = {job['id']}")
+    print(f"Name          = {job['name']}")
+    print(f"Type          = {job['type']}")
+    print(f"Priority      = {job['priority']}")
+    print(f"Status        = {job['status']}")
+    print(f"Version       = {job['version']}")
+    allocs = api.jobs.allocations(args.job_id)
+    if allocs:
+        print("\nAllocations")
+        print(_fmt_table(
+            [[_short(a["ID"]), _short(a["EvalID"]), a["TaskGroup"],
+              a["DesiredStatus"], a["ClientStatus"]] for a in allocs],
+            ["ID", "Eval ID", "Task Group", "Desired", "Status"]))
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    api = _client(args)
+    resp = api.jobs.deregister(args.job_id, purge=args.purge)
+    print(f"==> Job {args.job_id!r} stopped")
+    if resp.get("eval_id"):
+        return _monitor_eval(api, resp["eval_id"], args.detach)
+    return 0
+
+
+def cmd_job_plan(args) -> int:
+    api = _client(args)
+    with open(args.file) as f:
+        job = api.jobs.parse(f.read())
+    resp = api.jobs.plan(job["id"], job)
+    ann = resp.get("annotations") or {}
+    if ann.get("desired_tg_updates"):
+        for tg, upd in ann["desired_tg_updates"].items():
+            parts = [f"{k}: {v}" for k, v in sorted(upd.items()) if v]
+            print(f"Task Group {tg!r}: " + (", ".join(parts) or "no change"))
+    else:
+        print("(no annotations)")
+    if resp.get("error"):
+        print(f"Error: {resp['error']}")
+        return 1
+    return 0
+
+
+def cmd_job_periodic_force(args) -> int:
+    api = _client(args)
+    resp = api.jobs.periodic_force(args.job_id)
+    print(f"==> Forced launch: {resp['child_job_id']}")
+    return 0
+
+
+# ----------------------------------------------------------------- node
+def cmd_node_status(args) -> int:
+    api = _client(args)
+    if not args.node_id:
+        nodes, _ = api.nodes.list()
+        print(_fmt_table(
+            [[_short(n["id"]), n["name"], n["datacenter"],
+              "true" if n["drain"] else "false",
+              n["scheduling_eligibility"], n["status"]] for n in nodes],
+            ["ID", "Name", "DC", "Drain", "Eligibility", "Status"]))
+        return 0
+    n = api.nodes.info(args.node_id)
+    print(f"ID          = {n['id']}")
+    print(f"Name        = {n['name']}")
+    print(f"Datacenter  = {n['datacenter']}")
+    print(f"Class       = {n['node_class'] or '<none>'}")
+    print(f"Status      = {n['status']}")
+    print(f"Eligibility = {n['scheduling_eligibility']}")
+    allocs = api.nodes.allocations(n["id"])
+    if allocs:
+        print("\nAllocations")
+        print(_fmt_table(
+            [[_short(a["ID"]), a["JobID"], a["TaskGroup"],
+              a["DesiredStatus"], a["ClientStatus"]] for a in allocs],
+            ["ID", "Job ID", "Task Group", "Desired", "Status"]))
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    api = _client(args)
+    from ..jobspec import parse_duration_s
+    if args.enable:
+        api.nodes.drain(args.node_id,
+                        deadline_s=parse_duration_s(args.deadline),
+                        ignore_system_jobs=args.ignore_system)
+        print(f"==> Node {_short(args.node_id)} drain enabled")
+    else:
+        api.nodes.drain(args.node_id, disable=True)
+        print(f"==> Node {_short(args.node_id)} drain disabled")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    api = _client(args)
+    api.nodes.eligibility(args.node_id, args.enable)
+    state = "eligible" if args.enable else "ineligible"
+    print(f"==> Node {_short(args.node_id)} marked {state}")
+    return 0
+
+
+# ---------------------------------------------------------------- alloc
+def cmd_alloc_status(args) -> int:
+    api = _client(args)
+    a = api.allocations.info(args.alloc_id)
+    print(f"ID           = {a['id']}")
+    print(f"Name         = {a['name']}")
+    print(f"Node ID      = {_short(a['node_id'])}")
+    print(f"Job ID       = {a['job_id']}")
+    print(f"Client Status= {a['client_status']}")
+    print(f"Desired      = {a['desired_status']}")
+    for task, ts in (a.get("task_states") or {}).items():
+        print(f"\nTask {task!r} is {ts['state']}"
+              + (" (failed)" if ts["failed"] else ""))
+        for ev in ts.get("events", []):
+            stamp = time.strftime("%H:%M:%S", time.localtime(ev["time"]))
+            print(f"  {stamp}  {ev['type']:<16} {ev.get('message', '')}")
+    m = a.get("metrics") or {}
+    if m.get("nodes_evaluated"):
+        print(f"\nPlacement Metrics")
+        print(f"  Nodes evaluated: {m['nodes_evaluated']}; "
+              f"filtered: {m['nodes_filtered']}; "
+              f"exhausted: {m['nodes_exhausted']}")
+        for sm in m.get("score_meta", [])[:5]:
+            print(f"  {sm}")
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    api = _client(args)
+    resp = api.allocations.stop(args.alloc_id)
+    print(f"==> Alloc {_short(args.alloc_id)} stop requested "
+          f"(eval {_short(resp['eval_id'])})")
+    return 0
+
+
+# ----------------------------------------------------------------- misc
+def cmd_eval_status(args) -> int:
+    api = _client(args)
+    ev = api.evaluations.info(args.eval_id)
+    for k in ("id", "type", "job_id", "status", "triggered_by",
+              "priority", "status_description"):
+        print(f"{k:<20}= {ev.get(k, '')}")
+    return 0
+
+
+def cmd_deployment(args) -> int:
+    api = _client(args)
+    if args.dep_cmd == "list":
+        deps, _ = api.deployments.list()
+        print(_fmt_table(
+            [[_short(d["id"]), d["job_id"], d["status"]] for d in deps],
+            ["ID", "Job ID", "Status"]))
+    elif args.dep_cmd == "status":
+        d = api.deployments.info(args.dep_id)
+        print(json.dumps(d, indent=2))
+    elif args.dep_cmd == "promote":
+        resp = api.deployments.promote(args.dep_id)
+        print(f"==> Deployment promoted (eval {_short(resp['eval_id'])})")
+    elif args.dep_cmd == "fail":
+        resp = api.deployments.fail(args.dep_id)
+        print(f"==> Deployment failed (eval {_short(resp['eval_id'])})")
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    _client(args).system.gc()
+    print("==> GC forced")
+    return 0
+
+
+def cmd_status(args) -> int:
+    api = _client(args)
+    self_ = api.agent.self_()
+    print(f"Agent: server workers={self_['server']['workers']}"
+          + (f", client node={_short(self_['client']['node_id'])}"
+             if self_.get("client") else ""))
+    jobs, _ = api.jobs.list()
+    nodes, _ = api.nodes.list()
+    print(f"Jobs: {len(jobs)}  Nodes: {len(nodes)}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    print(json.dumps(_client(args).agent.metrics(), indent=2))
+    return 0
+
+
+# ----------------------------------------------------------------- main
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu",
+                                description="TPU-native cluster scheduler")
+    p.add_argument("-address", default=None,
+                   help="agent HTTP address (or NOMAD_ADDR)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run an agent")
+    ag.add_argument("-dev", action="store_true")
+    ag.add_argument("-bind", default="127.0.0.1")
+    ag.add_argument("-port", type=int, default=4646)
+    ag.add_argument("-data-dir", dest="data_dir",
+                    default="/tmp/nomad-tpu-dev")
+    ag.add_argument("-workers", type=int, default=2)
+    ag.add_argument("-server-only", dest="server_only",
+                    action="store_true")
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(
+        dest="job_cmd", required=True)
+    jr = job.add_parser("run")
+    jr.add_argument("file")
+    jr.add_argument("-detach", action="store_true")
+    jr.add_argument("-check-index", dest="check_index", type=int,
+                    default=None)
+    jr.set_defaults(fn=cmd_job_run)
+    js = job.add_parser("status")
+    js.add_argument("job_id", nargs="?")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-purge", action="store_true")
+    jst.add_argument("-detach", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    jp = job.add_parser("plan")
+    jp.add_argument("file")
+    jp.set_defaults(fn=cmd_job_plan)
+    jpf = job.add_parser("periodic-force")
+    jpf.add_argument("job_id")
+    jpf.set_defaults(fn=cmd_job_periodic_force)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(
+        dest="node_cmd", required=True)
+    ns = node.add_parser("status")
+    ns.add_argument("node_id", nargs="?")
+    ns.set_defaults(fn=cmd_node_status)
+    nd = node.add_parser("drain")
+    nd.add_argument("node_id")
+    grp = nd.add_mutually_exclusive_group(required=True)
+    grp.add_argument("-enable", action="store_true")
+    grp.add_argument("-disable", dest="enable", action="store_false")
+    nd.add_argument("-deadline", default="1h")
+    nd.add_argument("-ignore-system", dest="ignore_system",
+                    action="store_true")
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node.add_parser("eligibility")
+    ne.add_argument("node_id")
+    grp = ne.add_mutually_exclusive_group(required=True)
+    grp.add_argument("-enable", action="store_true")
+    grp.add_argument("-disable", dest="enable", action="store_false")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    alloc = sub.add_parser("alloc", help="alloc commands").add_subparsers(
+        dest="alloc_cmd", required=True)
+    as_ = alloc.add_parser("status")
+    as_.add_argument("alloc_id")
+    as_.set_defaults(fn=cmd_alloc_status)
+    ast = alloc.add_parser("stop")
+    ast.add_argument("alloc_id")
+    ast.set_defaults(fn=cmd_alloc_stop)
+
+    ev = sub.add_parser("eval", help="eval commands").add_subparsers(
+        dest="eval_cmd", required=True)
+    es = ev.add_parser("status")
+    es.add_argument("eval_id")
+    es.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment", help="deployment commands")
+    dep.add_argument("dep_cmd",
+                     choices=["list", "status", "promote", "fail"])
+    dep.add_argument("dep_id", nargs="?")
+    dep.set_defaults(fn=cmd_deployment)
+
+    sysgc = sub.add_parser("system")
+    sysgc.add_argument("system_cmd", choices=["gc"])
+    sysgc.set_defaults(fn=cmd_system_gc)
+
+    st = sub.add_parser("status", help="cluster overview")
+    st.set_defaults(fn=cmd_status)
+
+    mt = sub.add_parser("metrics", help="dump agent metrics")
+    mt.set_defaults(fn=cmd_metrics)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # stdout closed early (e.g. `| head`); exit quietly like the
+        # reference CLI
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

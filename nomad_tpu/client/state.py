@@ -76,26 +76,19 @@ class StateDB:
     def put_task_runner_state(self, alloc_id: str, task: str,
                               handle: Optional[TaskHandle],
                               task_state: Optional[TaskState]) -> None:
+        """Both columns are written unconditionally: a None handle MEANS
+        'no live driver task' and must clear any stale re-attach token
+        (otherwise a restarted agent would recover a task that already
+        exited and double-count its exit)."""
         local = json.dumps(to_wire(handle)) if handle else None
         state = json.dumps(to_wire(task_state)) if task_state else None
         with self._lock:
             if self._closed:
                 return
             with self._conn:
-                self._put_task_state_locked(alloc_id, task, local, state)
-
-    def _put_task_state_locked(self, alloc_id, task, local, state):
-        # None means "leave the stored column as-is" so handle-only and
-        # state-only writers don't clobber each other
-        row = self._conn.execute(
-            "SELECT local, state FROM task_state WHERE alloc_id=? "
-            "AND task=?", (alloc_id, task)).fetchone()
-        if row:
-            local = local if local is not None else row[0]
-            state = state if state is not None else row[1]
-        self._conn.execute(
-            "INSERT OR REPLACE INTO task_state VALUES (?, ?, ?, ?)",
-            (alloc_id, task, local, state))
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO task_state VALUES (?, ?, ?, ?)",
+                    (alloc_id, task, local, state))
 
     def get_task_runner_state(
             self, alloc_id: str, task: str
@@ -143,10 +136,7 @@ class MemDB:
 
     def put_task_runner_state(self, alloc_id, task, handle, task_state):
         with self._lock:
-            old_h, old_s = self._task.get((alloc_id, task), (None, None))
-            self._task[(alloc_id, task)] = (
-                handle if handle is not None else old_h,
-                task_state if task_state is not None else old_s)
+            self._task[(alloc_id, task)] = (handle, task_state)
 
     def get_task_runner_state(self, alloc_id, task):
         with self._lock:

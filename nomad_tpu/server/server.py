@@ -240,9 +240,51 @@ class Server:
             self._create_node_evals(node, index)
         return index
 
-    def register_job(self, job: Job) -> Optional[Evaluation]:
+    def update_node_eligibility(self, node_id: str,
+                                eligibility: str) -> int:
+        """Node.UpdateEligibility analog (node_endpoint.go)."""
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_node_eligibility(index, node_id, eligibility)
+        node = self.store.node_by_id(node_id)
+        if node is not None and node.ready():
+            self.blocked_evals.unblock(node.computed_class, index)
+        return index
+
+    def stop_alloc(self, alloc_id: str) -> Optional[Evaluation]:
+        """Alloc.Stop analog: mark the alloc for migration and evaluate
+        its job (alloc_endpoint.go AllocSpecificRequest stop)."""
+        from ..structs import DesiredTransition
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_alloc_desired_transition(
+                index, [alloc_id], DesiredTransition(migrate=True))
+        job = alloc.job or self.store.job_by_id(alloc.namespace,
+                                                alloc.job_id)
+        ev = Evaluation(
+            namespace=alloc.namespace, job_id=alloc.job_id,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            priority=job.priority if job else 50,
+            triggered_by="alloc-stop", status=EVAL_STATUS_PENDING)
+        self._create_evals([ev])
+        return ev
+
+    def register_job(self, job: Job, enforce_index: bool = False,
+                     check_index: int = 0) -> Optional[Evaluation]:
         job.canonicalize()
         with self._apply_lock:
+            if enforce_index:
+                # check-and-set registration (reference:
+                # job_endpoint.go Job.Register EnforceIndex)
+                existing = self.store.job_by_id(job.namespace, job.id)
+                current = existing.job_modify_index if existing else 0
+                if current != check_index:
+                    raise ValueError(
+                        f"job modify index mismatch: have {current}, "
+                        f"want {check_index}")
             index = self._next_index()
             self.store.upsert_job(index, job)
         # periodic parents and parameterized jobs are templates: tracked by

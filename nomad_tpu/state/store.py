@@ -498,10 +498,40 @@ class StateStore(StateSnapshot):
             a.create_index = index
         a.modify_index = index
         self._update_deployment_with_alloc_locked(index, a, existing)
+        self._update_summary_with_alloc_locked(index, a, existing)
         self._t["allocs"][a.id] = a
         self._t["_allocs_by_node"].setdefault(a.node_id, set()).add(a.id)
         self._t["_allocs_by_job"].setdefault(
             (a.namespace, a.job_id), set()).add(a.id)
+
+    _SUMMARY_BUCKETS = {"pending": "starting", "running": "running",
+                        "complete": "complete", "failed": "failed",
+                        "lost": "lost"}
+
+    def _update_summary_with_alloc_locked(self, index: int, a: Allocation,
+                                          existing) -> None:
+        """Move the alloc between its job summary's status buckets
+        (reference: state_store.go updateSummaryWithAlloc)."""
+        key = (a.namespace, a.job_id)
+        summary = self._t["job_summaries"].get(key)
+        if summary is None:
+            return
+        old = (self._SUMMARY_BUCKETS.get(existing.client_status)
+               if existing is not None else None)
+        new = self._SUMMARY_BUCKETS.get(a.client_status)
+        if old == new:
+            return
+        s2 = summary.copy()
+        tg = s2.summary.setdefault(a.task_group, {
+            "queued": 0, "complete": 0, "failed": 0, "running": 0,
+            "starting": 0, "lost": 0})
+        if old is not None and tg.get(old, 0) > 0:
+            tg[old] -= 1
+        if new is not None:
+            tg[new] = tg.get(new, 0) + 1
+        s2.modify_index = index
+        self._t["job_summaries"][key] = s2
+        self._bump("job_summaries", index)
 
     def _update_deployment_with_alloc_locked(self, index: int, a: Allocation,
                                              existing) -> None:
@@ -577,6 +607,7 @@ class StateStore(StateSnapshot):
                 a.modify_index = index
                 a.modify_time = upd.modify_time or a.modify_time
                 self._update_deployment_with_alloc_locked(index, a, existing)
+                self._update_summary_with_alloc_locked(index, a, existing)
                 self._t["allocs"][a.id] = a
             for key in {(u.namespace, u.job_id) for u in updates}:
                 self._refresh_job_status(index, *key)

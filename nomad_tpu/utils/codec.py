@@ -34,6 +34,10 @@ def to_wire(obj: Any) -> Any:
         return {"__b64__": base64.b64encode(obj).decode("ascii")}
     if isinstance(obj, set):
         return sorted(to_wire(v) for v in obj)
+    if hasattr(obj, "__dict__"):
+        # plain-class structs (JobSummary, SchedulerConfiguration)
+        return {k: to_wire(v) for k, v in vars(obj).items()
+                if not k.startswith("_")}
     raise TypeError(f"cannot encode {type(obj).__name__}")
 
 

@@ -1,0 +1,88 @@
+"""In-process metrics registry (reference: armon/go-metrics as wired in
+command/agent/command.go:985-1060; the timing points mirror
+nomad/worker.go:162,245,282 and nomad/plan_apply.go:185,369,400).
+
+Counters, gauges, and timing samples with an in-memory aggregate sink,
+surfaced at /v1/metrics. `measure_since(key, t0)` is the MeasureSince
+analog; `timed(key)` the context-manager sugar.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class _Summary:
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def snapshot(self) -> dict:
+        mean = self.sum / self.count if self.count else 0.0
+        return {"count": self.count, "sum": round(self.sum, 6),
+                "mean": round(mean, 6),
+                "min": round(self.min, 6) if self.count else 0.0,
+                "max": round(self.max, 6)}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._samples: Dict[str, _Summary] = {}
+
+    def incr_counter(self, key: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, key: str, value: float) -> None:
+        with self._lock:
+            self._gauges[key] = value
+
+    def add_sample(self, key: str, value_s: float) -> None:
+        with self._lock:
+            self._samples.setdefault(key, _Summary()).add(value_s)
+
+    def measure_since(self, key: str, t0: float) -> None:
+        """t0 from time.monotonic(); records seconds elapsed."""
+        self.add_sample(key, _time.monotonic() - t0)
+
+    @contextmanager
+    def timed(self, key: str):
+        t0 = _time.monotonic()
+        try:
+            yield
+        finally:
+            self.measure_since(key, t0)
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "samples": {k: s.snapshot()
+                            for k, s in self._samples.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._samples.clear()
+
+
+#: process-global registry (the go-metrics global sink analog)
+global_metrics = MetricsRegistry()

@@ -182,11 +182,12 @@ def test_state_db_roundtrip(tmp_path, make_db):
     h2, s2 = db.get_task_runner_state(a.id, "web")
     assert h2.driver_state["pid"] == 42
     assert s2.state == "running"
-    # partial update: state only must not clobber the handle
+    # a None handle clears the stored re-attach token (the task exited);
+    # a restarted agent must not recover a dead task
     db.put_task_runner_state(a.id, "web", None,
                              structs.TaskState(state="dead"))
     h3, s3 = db.get_task_runner_state(a.id, "web")
-    assert h3 is not None and h3.driver_state["pid"] == 42
+    assert h3 is None
     assert s3.state == "dead"
     db.delete_allocation(a.id)
     assert db.get_all_allocations() == []

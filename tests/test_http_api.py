@@ -1,0 +1,202 @@
+"""HTTP API + SDK + CLI tests (reference: command/agent/http_test.go,
+command/agent/*_endpoint_test.go, api/ tests)."""
+import io
+import json
+import threading
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.api.client import ApiClient, APIError
+from nomad_tpu.api.http_server import HTTPAgentServer
+from nomad_tpu.cli.main import main as cli_main
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.server.server import Server
+
+HCL = """
+job "httpd" {
+  datacenters = ["dc1"]
+  group "web" {
+    count = 2
+    task "sleep" {
+      driver = "raw_exec"
+      config {
+        command = "/bin/sh"
+        args    = ["-c", "sleep 60"]
+      }
+      resources { cpu = 100  memory = 64 }
+    }
+  }
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    server = Server(num_workers=2)
+    server.start()
+    client = Client(server,
+                    data_dir=str(tmp_path_factory.mktemp("agent")))
+    client.start()
+    http = HTTPAgentServer(server, client, port=0)
+    http.start()
+    api = ApiClient(address=http.address)
+    yield server, client, http, api
+    http.stop()
+    client.shutdown(halt_tasks=True)
+    server.stop()
+
+
+def test_parse_register_and_status_via_http(agent):
+    server, client, http, api = agent
+    job = api.jobs.parse(HCL)
+    assert job["id"] == "httpd" and job["task_groups"][0]["count"] == 2
+    resp = api.jobs.register(job)
+    assert resp["eval_id"]
+    assert wait_until(lambda: all(
+        a["ClientStatus"] == "running"
+        for a in api.jobs.allocations("httpd")) and
+        len(api.jobs.allocations("httpd")) == 2, timeout=20)
+    info, index = api.jobs.info("httpd")
+    assert info["status"] in ("running", "pending")
+    assert index > 0
+    evs = api.jobs.evaluations("httpd")
+    assert evs and evs[0]["job_id"] == "httpd"
+    ev = api.evaluations.info(resp["eval_id"])
+    assert ev["status"] == "complete"
+    summary = api.jobs.summary("httpd")
+    assert summary["summary"]["web"]["running"] == 2
+
+
+def test_blocking_query_fires_on_change(agent):
+    server, client, http, api = agent
+    _, index = api.jobs.list()
+    result = {}
+
+    def blocked():
+        jobs, new_index = api.jobs.list(index=index, wait="10s")
+        result["index"] = new_index
+        result["t"] = time.monotonic()
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.3)
+    assert "index" not in result, "must still be blocked"
+    server.register_job(mock.job())
+    th.join(timeout=5.0)
+    assert result["index"] > index
+    assert result["t"] - t0 < 5.0, "must wake on write, not timeout"
+
+
+def test_alloc_and_node_endpoints(agent):
+    server, client, http, api = agent
+    allocs, _ = api.allocations.list()
+    assert allocs
+    a = api.allocations.info(allocs[0]["ID"])
+    assert a["id"] == allocs[0]["ID"]
+    assert a["task_states"]
+    nodes, _ = api.nodes.list()
+    assert len(nodes) == 1
+    n = api.nodes.info(nodes[0]["id"][:8])     # prefix resolution
+    assert n["id"] == client.node.id
+    node_allocs = api.nodes.allocations(n["id"])
+    assert node_allocs
+
+
+def test_node_eligibility_and_drain_via_http(agent):
+    server, client, http, api = agent
+    node_id = client.node.id
+    api.nodes.eligibility(node_id, False)
+    assert server.store.node_by_id(node_id).scheduling_eligibility == \
+        "ineligible"
+    api.nodes.eligibility(node_id, True)
+    assert server.store.node_by_id(node_id).scheduling_eligibility == \
+        "eligible"
+
+
+def test_job_plan_dry_run_does_not_mutate(agent):
+    server, client, http, api = agent
+    job = api.jobs.parse(HCL.replace('"httpd"', '"planonly"'))
+    before = server.store.latest_index()
+    resp = api.jobs.plan("planonly", job)
+    ann = resp["annotations"]
+    assert ann["desired_tg_updates"]["web"]["place"] == 2
+    assert server.store.job_by_id("default", "planonly") is None
+    assert server.store.latest_index() == before
+
+
+def test_unknown_routes_and_errors(agent):
+    server, client, http, api = agent
+    with pytest.raises(APIError) as e:
+        api.c_get = api.get("/v1/nope")
+    assert e.value.code == 404
+    with pytest.raises(APIError) as e:
+        api.jobs.info("no-such-job")
+    assert e.value.code == 404
+    with pytest.raises(APIError) as e:
+        api.post("/v1/jobs", {"not_job": 1})
+    assert e.value.code == 400
+
+
+def test_metrics_and_agent_self(agent):
+    server, client, http, api = agent
+    self_ = api.agent.self_()
+    assert self_["server"]["workers"] == 2
+    assert self_["client"]["node_id"] == client.node.id
+    metrics = api.agent.metrics()
+    assert "counters" in metrics and "samples" in metrics
+
+
+def _run_cli(api, *argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["-address", api.address, *argv])
+    return rc, buf.getvalue()
+
+
+def test_cli_job_node_alloc_flow(agent, tmp_path):
+    server, client, http, api = agent
+    spec = tmp_path / "cli.hcl"
+    spec.write_text(HCL.replace('"httpd"', '"cli-job"'))
+    rc, out = _run_cli(api, "job", "run", str(spec))
+    assert rc == 0 and "registered" in out
+    assert wait_until(lambda: len(api.jobs.allocations("cli-job")) == 2,
+                      timeout=20)
+    rc, out = _run_cli(api, "job", "status", "cli-job")
+    assert rc == 0 and "cli-job" in out and "Allocations" in out
+    rc, out = _run_cli(api, "node", "status")
+    assert rc == 0 and "ready" in out
+    allocs = api.jobs.allocations("cli-job")
+    rc, out = _run_cli(api, "alloc", "status", allocs[0]["ID"])
+    assert rc == 0 and "Client Status" in out
+    rc, out = _run_cli(api, "status")
+    assert rc == 0 and "Jobs:" in out
+    rc, out = _run_cli(api, "job", "stop", "cli-job", "-detach")
+    assert rc == 0
+    assert wait_until(lambda: all(
+        a["ClientStatus"] in ("complete", "failed")
+        for a in api.jobs.allocations("cli-job")), timeout=20)
+
+
+def test_cli_job_plan(agent, tmp_path):
+    server, client, http, api = agent
+    spec = tmp_path / "plan.hcl"
+    spec.write_text(HCL.replace('"httpd"', '"plan-cli"'))
+    rc, out = _run_cli(api, "job", "plan", str(spec))
+    assert rc == 0 and "place: 2" in out
+
+
+def test_cli_drain_via_http(agent):
+    server, client, http, api = agent
+    node_id = client.node.id
+    rc, out = _run_cli(api, "node", "drain", node_id, "-enable",
+                       "-deadline", "30s")
+    assert rc == 0 and "drain enabled" in out
+    assert server.store.node_by_id(node_id).drain_strategy is not None
+    rc, out = _run_cli(api, "node", "drain", node_id, "-disable")
+    assert rc == 0
+    assert server.store.node_by_id(node_id).drain_strategy is None

@@ -1,0 +1,176 @@
+"""HCL jobspec parser tests (reference: jobspec/parse_test.go +
+jobspec/test-fixtures/)."""
+import pytest
+
+from nomad_tpu import structs
+from nomad_tpu.jobspec import (HCLParseError, JobspecParseError,
+                               parse_duration_s, parse_hcl, parse_job)
+
+
+def test_parse_duration():
+    assert parse_duration_s("30s") == 30
+    assert parse_duration_s("5m") == 300
+    assert parse_duration_s("1h30m") == 5400
+    assert parse_duration_s("500ms") == 0.5
+    assert parse_duration_s(45) == 45
+    with pytest.raises(JobspecParseError):
+        parse_duration_s("ten minutes")
+
+
+def test_hcl_basics():
+    b = parse_hcl('''
+      a = "x"          # comment
+      n = 3            // comment
+      f = 1.5
+      t = true
+      l = [1, "two", true]
+      m = { k = "v", n = 2 }
+      /* block
+         comment */
+      blk "label1" "label2" { inner = 1 }
+    ''')
+    assert b.attrs["a"] == "x" and b.attrs["n"] == 3
+    assert b.attrs["f"] == 1.5 and b.attrs["t"] is True
+    assert b.attrs["l"] == [1, "two", True]
+    assert b.attrs["m"] == {"k": "v", "n": 2}
+    (labels, body), = b.blocks_named("blk")
+    assert labels == ["label1", "label2"] and body.attrs["inner"] == 1
+
+
+def test_hcl_heredoc():
+    b = parse_hcl('x = <<EOF\nline1\n  line2\nEOF\ny = 1')
+    assert b.attrs["x"] == "line1\n  line2"
+    assert b.attrs["y"] == 1
+    b2 = parse_hcl('x = <<-EOF\n\tindented\n\tEOF\n')
+    assert b2.attrs["x"].strip() == "indented"
+
+
+def test_hcl_errors():
+    with pytest.raises(HCLParseError):
+        parse_hcl('a = ')
+    with pytest.raises(HCLParseError):
+        parse_hcl('a = "unterminated')
+    with pytest.raises(HCLParseError):
+        parse_hcl('a = 1\na = 2')          # duplicate key
+
+
+def test_minimal_job():
+    job = parse_job('''
+      job "min" {
+        group "g" {
+          task "t" {
+            driver = "mock_driver"
+          }
+        }
+      }
+    ''')
+    assert job.id == "min" and job.type == "service"
+    assert job.task_groups[0].tasks[0].driver == "mock_driver"
+    # canonicalize filled the service defaults
+    assert job.task_groups[0].reschedule_policy.unlimited
+
+
+def test_job_level_task_sugar():
+    job = parse_job('''
+      job "sugar" {
+        type = "batch"
+        task "solo" { driver = "mock_driver" }
+      }
+    ''')
+    assert job.task_groups[0].name == "solo"
+    assert job.task_groups[0].count == 1
+
+
+def test_constraint_sugar_forms():
+    job = parse_job('''
+      job "c" {
+        constraint { attribute = "${attr.arch}"  value = "x86" }
+        constraint { attribute = "${attr.kernel.version}"  version = ">= 3.0" }
+        constraint { attribute = "${attr.os.name}"  regexp = "ubu.*" }
+        constraint { distinct_hosts = true }
+        constraint { distinct_property = "${meta.rack}" }
+        group "g" { task "t" { driver = "mock_driver" } }
+      }
+    ''')
+    ops = [c.operand for c in job.constraints]
+    assert ops == ["=", "version", "regexp", "distinct_hosts",
+                   "distinct_property"]
+    assert job.constraints[4].ltarget == "${meta.rack}"
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(JobspecParseError, match="invalid key"):
+        parse_job('''
+          job "bad" {
+            bogus_key = true
+            group "g" { task "t" { driver = "x" } }
+          }
+        ''')
+    with pytest.raises(JobspecParseError, match="invalid key"):
+        parse_job('''
+          job "bad2" {
+            group "g" {
+              task "t" { driver = "x"  resources { cpus = 100 } }
+            }
+          }
+        ''')
+
+
+def test_periodic_and_parameterized():
+    job = parse_job('''
+      job "cron" {
+        type = "batch"
+        periodic {
+          cron = "*/15 * * * *"
+          prohibit_overlap = true
+          time_zone = "America/New_York"
+        }
+        group "g" { task "t" { driver = "mock_driver" } }
+      }
+    ''')
+    assert job.periodic.spec == "*/15 * * * *"
+    assert job.periodic.prohibit_overlap
+    assert job.periodic.timezone == "America/New_York"
+    job2 = parse_job('''
+      job "param" {
+        type = "batch"
+        parameterized {
+          payload = "required"
+          meta_required = ["input"]
+        }
+        group "g" { task "t" { driver = "mock_driver" } }
+      }
+    ''')
+    assert job2.parameterized.payload == "required"
+    assert job2.is_parameterized()
+
+
+def test_validation_errors_surface():
+    with pytest.raises(JobspecParseError, match="no tasks"):
+        parse_job('job "empty" { group "g" { } }')
+    with pytest.raises(JobspecParseError, match="exactly one"):
+        parse_job('x = 1')
+
+
+def test_system_job_and_devices():
+    job = parse_job('''
+      job "sys" {
+        type = "system"
+        group "g" {
+          task "t" {
+            driver = "mock_driver"
+            resources {
+              cpu = 200
+              device "nvidia/gpu/1080ti" {
+                count = 2
+                constraint { attribute = "${device.attr.memory_mib}"
+                             operator = ">"  value = "8000" }
+              }
+            }
+          }
+        }
+      }
+    ''')
+    dev = job.task_groups[0].tasks[0].resources.devices[0]
+    assert dev.name == "nvidia/gpu/1080ti" and dev.count == 2
+    assert dev.constraints[0].operand == ">"
