@@ -59,6 +59,9 @@ class ApiClient:
             except Exception:
                 msg = str(e)
             raise APIError(e.code, msg)
+        except urllib.error.URLError as e:
+            raise APIError(0, f"cannot reach agent at {self.address}: "
+                              f"{e.reason}")
 
     def get(self, path, **params):
         return self.request("GET", path, params=params)

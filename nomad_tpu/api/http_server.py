@@ -101,7 +101,10 @@ class HTTPAgentServer:
                 raw = self.rfile.read(length)
                 if not raw:
                     return None
-                return json.loads(raw)
+                try:
+                    return json.loads(raw)
+                except json.JSONDecodeError as e:
+                    raise HTTPError(400, f"invalid JSON body: {e}")
 
             def do_GET(self): self._handle("GET")
 
@@ -150,10 +153,14 @@ class HTTPAgentServer:
     def _block(self, q: Dict[str, str], table: str) -> int:
         """Run the blocking-query wait; returns the index to report."""
         store = self.server.store
-        min_index = int(q.get("index", 0) or 0)
+        try:
+            min_index = int(q.get("index", 0) or 0)
+            wait_s = min(parse_duration_s(q.get("wait", "5m")),
+                         MAX_BLOCK_S)
+        except (ValueError, JobspecParseError) as e:
+            raise HTTPError(400, f"invalid blocking-query params: {e}")
         if min_index <= 0:
             return store.latest_index()
-        wait_s = min(parse_duration_s(q.get("wait", "5m")), MAX_BLOCK_S)
         import time as _t
         deadline = _t.monotonic() + wait_s
         while True:
@@ -420,18 +427,15 @@ class HTTPAgentServer:
 
     def deployment_promote(self, q, body, dep_id):
         dep = self._resolve_deployment(dep_id)
-        fn = getattr(self.server, "promote_deployment", None)
-        if fn is None:
-            raise HTTPError(501, "deployment promotion not supported")
-        ev = fn(dep.id, all_groups=True)
+        try:
+            ev = self.server.promote_deployment(dep.id, all_groups=True)
+        except ValueError as e:
+            raise HTTPError(409, str(e))
         return 200, {"eval_id": ev.id if ev else ""}, None
 
     def deployment_fail(self, q, body, dep_id):
         dep = self._resolve_deployment(dep_id)
-        fn = getattr(self.server, "fail_deployment", None)
-        if fn is None:
-            raise HTTPError(501, "deployment fail not supported")
-        ev = fn(dep.id)
+        ev = self.server.fail_deployment(dep.id)
         return 200, {"eval_id": ev.id if ev else ""}, None
 
     def deployment_allocations(self, q, body, dep_id):

@@ -91,13 +91,14 @@ def _monitor_eval(api: ApiClient, eval_id: str, detach: bool) -> int:
         return 0
     for _ in range(100):
         ev = api.evaluations.info(eval_id)
-        if ev["status"] in ("complete", "failed", "cancelled"):
+        if ev["status"] == "complete":
+            print("    Evaluation complete")
+            return 0
+        if ev["status"] in ("failed", "cancelled", "canceled"):
             print(f"    Evaluation {ev['status']}")
-            if ev["status"] == "complete":
-                return 0
             if ev.get("blocked_eval"):
                 print(f"    Blocked eval: {ev['blocked_eval']}")
-            return 0 if ev["status"] == "complete" else 2
+            return 2
         time.sleep(0.2)
     print("    (still in progress; detaching)")
     return 0

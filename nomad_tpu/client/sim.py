@@ -97,6 +97,13 @@ class SimClient:
             t.name: TaskState(state="running", started_at=_time.time())
             for t in (alloc.job.lookup_task_group(alloc.task_group).tasks
                       if alloc.job else [])}
+        # deployment allocs report health immediately on running (the real
+        # client's health watcher waits min_healthy_time; the sim keeps
+        # e2e deployment tests fast)
+        if alloc.deployment_id and status == ALLOC_CLIENT_RUNNING:
+            from ..structs import AllocDeploymentStatus
+            upd.deployment_status = AllocDeploymentStatus(
+                healthy=True, timestamp=_time.time())
         upd.modify_time = _time.time()
         return upd
 
@@ -110,6 +117,10 @@ class SimClient:
             t.name: TaskState(state="dead", failed=failed, finished_at=now)
             for t in (alloc.job.lookup_task_group(alloc.task_group).tasks
                       if alloc.job else [])}
+        if alloc.deployment_id and failed:
+            from ..structs import AllocDeploymentStatus
+            upd.deployment_status = AllocDeploymentStatus(
+                healthy=False, timestamp=now)
         upd.modify_time = now
         return upd
 

@@ -18,6 +18,7 @@ from ..structs import (ALLOC_CLIENT_PENDING, ALLOC_DESIRED_RUN,
                        CONSTRAINT_DISTINCT_PROPERTY, EVAL_STATUS_BLOCKED,
                        EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED,
                        EVAL_TRIGGER_ALLOC_STOP, EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+                       EVAL_TRIGGER_DEPLOYMENT_PROMOTION,
                        EVAL_TRIGGER_FAILED_FOLLOW_UP,
                        EVAL_TRIGGER_JOB_DEREGISTER, EVAL_TRIGGER_JOB_REGISTER,
                        EVAL_TRIGGER_MAX_PLANS, EVAL_TRIGGER_NODE_DRAIN,
@@ -46,6 +47,7 @@ _VALID_TRIGGERS = {
     EVAL_TRIGGER_ALLOC_STOP, EVAL_TRIGGER_ROLLING_UPDATE,
     EVAL_TRIGGER_QUEUED_ALLOCS, EVAL_TRIGGER_PERIODIC_JOB,
     EVAL_TRIGGER_MAX_PLANS, EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+    EVAL_TRIGGER_DEPLOYMENT_PROMOTION,
     EVAL_TRIGGER_RETRY_FAILED_ALLOC, EVAL_TRIGGER_FAILED_FOLLOW_UP,
     EVAL_TRIGGER_PREEMPTION, EVAL_TRIGGER_SCALING,
 }

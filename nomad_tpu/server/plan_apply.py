@@ -140,14 +140,23 @@ class PlanApplier:
                 pending.future.respond(None, f"plan apply error: {e}")
 
     def apply_one(self, pending: PendingPlan) -> None:
+        from ..utils.metrics import global_metrics as _m
         plan = pending.plan
+        _m.set_gauge("plan.queue_depth", self.queue.depth()
+                     if hasattr(self.queue, "depth") else 0)
         snapshot = self.store.snapshot()
-        result = evaluate_plan(snapshot, plan)
+        with _m.timed("plan.evaluate"):
+            result = evaluate_plan(snapshot, plan)
         if result.is_no_op() and not result.refresh_index:
             pending.future.respond(result, None)
             return
-        index = self.apply_fn(plan, result)
+        with _m.timed("plan.apply"):
+            index = self.apply_fn(plan, result)
         result.alloc_index = index
+        if result.refresh_index:
+            _m.incr_counter("plan.partial_commit")
+        _m.incr_counter("plan.node_allocations",
+                        sum(len(v) for v in result.node_allocation.values()))
 
         # preempted allocs need follow-up evals for their jobs
         if self.create_evals and plan.node_preemptions:

@@ -15,7 +15,9 @@ from typing import Dict, Iterable, List, Optional
 
 from ..state.store import StateStore
 from ..structs import (ALLOC_CLIENT_FAILED, CORE_JOB_PRIORITY,
-                       EVAL_STATUS_PENDING, EVAL_TRIGGER_JOB_DEREGISTER,
+                       EVAL_STATUS_PENDING,
+                       EVAL_TRIGGER_DEPLOYMENT_PROMOTION,
+                       EVAL_TRIGGER_JOB_DEREGISTER,
                        EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
                        EVAL_TRIGGER_RETRY_FAILED_ALLOC, JOB_TYPE_CORE,
                        JOB_TYPE_SERVICE, NODE_STATUS_DOWN, NODE_STATUS_READY,
@@ -68,6 +70,8 @@ class Server:
             heartbeat_grace_s=heartbeat_grace_s,
             failover_heartbeat_ttl_s=failover_heartbeat_ttl_s)
         self.periodic = PeriodicDispatcher(self)
+        from .deployment_watcher import DeploymentWatcher
+        self.deployment_watcher = DeploymentWatcher(self)
         self.time_table = TimeTable()
         self.gc_interval_s = gc_interval_s
         self.job_gc_threshold_s = job_gc_threshold_s
@@ -98,6 +102,7 @@ class Server:
         self.heartbeater.set_enabled(True)
         self.heartbeater.initialize(
             n.id for n in self.store.nodes() if not n.terminal_status())
+        self.deployment_watcher.set_enabled(True)
         # periodic jobs resume their schedules (leader.go restorePeriodicDispatcher)
         self.periodic.set_enabled(True)
         for job in self.store.jobs():
@@ -111,6 +116,7 @@ class Server:
 
     def stop(self) -> None:
         self.heartbeater.set_enabled(False)
+        self.deployment_watcher.set_enabled(False)
         self.periodic.set_enabled(False)
         self._stop_reapers.set()
         for w in self.workers:
@@ -433,6 +439,98 @@ class Server:
                     triggered_by=EVAL_TRIGGER_NODE_UPDATE, node_id=node.id,
                     status=EVAL_STATUS_PENDING))
         self._create_evals(evals)
+
+    # -------------------------------------------------------- deployments
+    def apply_deployment_status_update(self, update,
+                                       mark_stable=None) -> int:
+        """Raft-apply a deployment status change; optionally mark the
+        job version stable in the same apply (reference:
+        fsm.go applyDeploymentStatusUpdate)."""
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.upsert_deployment_updates(index, [update])
+            if mark_stable is not None:
+                namespace, job_id, version = mark_stable
+                self.store.update_job_stability(index, namespace, job_id,
+                                                version, True)
+        return index
+
+    def promote_deployment(self, dep_id: str,
+                           all_groups: bool = True,
+                           groups=None) -> Optional[Evaluation]:
+        """Promote canaries (reference: deployments_watcher.go
+        PromoteDeployment -> fsm applyDeploymentPromotion): flips the
+        groups' promoted bit and evaluates the job so the reconciler
+        replaces the old version."""
+        dep = self.store.deployment_by_id(dep_id)
+        if dep is None or not dep.active():
+            return None
+        # reference PromoteDeployment rejects unhealthy canaries — the
+        # promotion replaces the known-good version cluster-wide
+        unhealthy = self._unhealthy_canary_groups(
+            dep, None if all_groups else groups)
+        if unhealthy:
+            raise ValueError(
+                f"canaries not healthy in group(s): {', '.join(unhealthy)}")
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_deployment_promotion(
+                index, dep_id, None if all_groups else groups)
+        job = self.store.job_by_id(dep.namespace, dep.job_id)
+        if job is None:
+            return None
+        ev = Evaluation(
+            namespace=dep.namespace, job_id=dep.job_id, type=job.type,
+            priority=job.priority, deployment_id=dep_id,
+            triggered_by=EVAL_TRIGGER_DEPLOYMENT_PROMOTION,
+            status=EVAL_STATUS_PENDING)
+        self._create_evals([ev])
+        return ev
+
+    def _unhealthy_canary_groups(self, dep, groups=None) -> List[str]:
+        out = []
+        for name, state in dep.task_groups.items():
+            if state.desired_canaries <= 0 or state.promoted:
+                continue
+            if groups is not None and name not in groups:
+                continue
+            healthy = 0
+            for aid in state.placed_canaries:
+                a = self.store.alloc_by_id(aid)
+                if (a is not None and a.deployment_status is not None
+                        and a.deployment_status.is_healthy()):
+                    healthy += 1
+            if healthy < state.desired_canaries:
+                out.append(name)
+        return out
+
+    def fail_deployment(self, dep_id: str) -> Optional[Evaluation]:
+        """Manual fail (reference: Deployment.Fail RPC)."""
+        from ..structs import (DEPLOYMENT_STATUS_FAILED,
+                               DeploymentStatusUpdate)
+        dep = self.store.deployment_by_id(dep_id)
+        if dep is None or not dep.active():
+            return None
+        self.apply_deployment_status_update(DeploymentStatusUpdate(
+            deployment_id=dep_id, status=DEPLOYMENT_STATUS_FAILED,
+            status_description="Deployment marked as failed"))
+        job = self.store.job_by_id(dep.namespace, dep.job_id)
+        if job is None:
+            return None
+        ev = Evaluation(
+            namespace=dep.namespace, job_id=dep.job_id, type=job.type,
+            priority=job.priority, deployment_id=dep_id,
+            triggered_by="deployment-watcher", status=EVAL_STATUS_PENDING)
+        self._create_evals([ev])
+        return ev
+
+    def revert_job(self, stable_job: Job) -> Optional[Evaluation]:
+        """Re-register a historical job version as the newest one
+        (reference: Job.Revert — copies the old version forward)."""
+        import copy as _copy
+        j = _copy.deepcopy(stable_job)
+        j.create_index = j.modify_index = j.job_modify_index = 0
+        return self.register_job(j)
 
     # ----------------------------------------------------------- GC reaps
     def reap_evals(self, eval_ids: List[str], alloc_ids: List[str]) -> int:

@@ -58,13 +58,21 @@ class Worker(threading.Thread):
                     self.server.broker.nack(ev.id, token)
 
     def _process(self, ev: Evaluation, token: str) -> None:
+        import time as _t
+
+        from ..utils.metrics import global_metrics as _m
         server = self.server
+        _m.incr_counter("worker.dequeue_eval")
         # the raft catch-up + solve + plan wait can exceed the nack
         # timeout; hold the timer while we own the eval
         server.broker.pause_nack_timeout(ev.id, token)
         # wait for local state to reach the eval's creation point
+        # (reference metric: nomad.worker.wait_for_index)
         wait_index = max(ev.modify_index, ev.snapshot_index)
+        t0 = _t.monotonic()
         server.store.wait_for_index(wait_index, timeout=5.0)
+        _m.measure_since("worker.wait_for_index", t0)
+        _invoke_t0 = _t.monotonic()
         try:
             from ..structs import JOB_TYPE_CORE
             if ev.type == JOB_TYPE_CORE:
@@ -87,6 +95,11 @@ class Worker(threading.Thread):
             server.upsert_evals([failed])
             server.broker.nack(ev.id, token)
             return
+        finally:
+            # reference metric: nomad.worker.invoke_scheduler_<type>
+            from ..utils.metrics import global_metrics as _gm
+            _gm.measure_since(f"worker.invoke_scheduler_{ev.type}",
+                              _invoke_t0)
         if err is not None:
             server.broker.nack(ev.id, token)
         else:
@@ -95,10 +108,17 @@ class Worker(threading.Thread):
     # ---------------------------------------------------- Planner interface
     def submit_plan(self, plan: Plan
                     ) -> Tuple[Optional[PlanResult], Optional[object]]:
+        import time as _t
+
+        from ..utils.metrics import global_metrics as _m
+        t0 = _t.monotonic()
         pending = self.server.plan_queue.enqueue(plan)
         if pending is None:
             return None, None
         result, err = pending.future.wait(30.0)
+        # reference metric: nomad.worker.submit_plan (p50/p99 plan-submit
+        # latency — the BASELINE.md headline latency metric)
+        _m.measure_since("worker.submit_plan", t0)
         if err is not None or result is None:
             return None, None
         if result.refresh_index:

@@ -689,6 +689,35 @@ class StateStore(StateSnapshot):
         d2.modify_index = index
         self._t["deployments"][du.deployment_id] = d2
 
+    def upsert_deployment_updates(self, index: int, updates) -> None:
+        """Standalone deployment status updates (reference:
+        fsm.go applyDeploymentStatusUpdate)."""
+        with self._lock:
+            for du in updates:
+                self._apply_deployment_update_locked(index, du)
+            self._bump("deployments", index)
+
+    def update_deployment_promotion(self, index: int, dep_id: str,
+                                    groups=None) -> None:
+        """Flip promoted for canary groups (reference:
+        state_store.go UpdateDeploymentPromotion). groups=None promotes
+        every canary group."""
+        with self._lock:
+            dep = self._t["deployments"].get(dep_id)
+            if dep is None:
+                raise KeyError(f"deployment {dep_id} not found")
+            d2 = dep.copy()
+            for name, state in d2.task_groups.items():
+                if state.desired_canaries <= 0:
+                    continue
+                if groups is not None and name not in groups:
+                    continue
+                state.promoted = True
+            d2.status_description = "Deployment is running"
+            d2.modify_index = index
+            self._t["deployments"][dep_id] = d2
+            self._bump("deployments", index)
+
     def delete_deployment(self, index: int, dep_ids: List[str]) -> None:
         with self._lock:
             for did in dep_ids:

@@ -64,6 +64,7 @@ EVAL_TRIGGER_ALLOC_STOP = "alloc-stop"
 EVAL_TRIGGER_SCHEDULED = "scheduled"
 EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
 EVAL_TRIGGER_DEPLOYMENT_WATCHER = "deployment-watcher"
+EVAL_TRIGGER_DEPLOYMENT_PROMOTION = "deployment-promotion"
 EVAL_TRIGGER_FAILED_FOLLOW_UP = "failed-follow-up"
 EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
 EVAL_TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"

@@ -14,27 +14,44 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 
+_RESERVOIR = 2048
+
+
 class _Summary:
-    __slots__ = ("count", "sum", "min", "max")
+    __slots__ = ("count", "sum", "min", "max", "values")
 
     def __init__(self):
         self.count = 0
         self.sum = 0.0
         self.min = float("inf")
         self.max = 0.0
+        # bounded tail reservoir for percentiles (the last N samples —
+        # recency-biased, which is what latency dashboards want)
+        from collections import deque
+        self.values = deque(maxlen=_RESERVOIR)
 
     def add(self, v: float) -> None:
         self.count += 1
         self.sum += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        self.values.append(v)
+
+    def percentile(self, p: float) -> float:
+        if not self.values:
+            return 0.0
+        vals = sorted(self.values)
+        k = min(int(len(vals) * p), len(vals) - 1)
+        return vals[k]
 
     def snapshot(self) -> dict:
         mean = self.sum / self.count if self.count else 0.0
         return {"count": self.count, "sum": round(self.sum, 6),
                 "mean": round(mean, 6),
                 "min": round(self.min, 6) if self.count else 0.0,
-                "max": round(self.max, 6)}
+                "max": round(self.max, 6),
+                "p50": round(self.percentile(0.50), 6),
+                "p99": round(self.percentile(0.99), 6)}
 
 
 class MetricsRegistry:

@@ -1,0 +1,210 @@
+"""Deployment watcher e2e tests (reference:
+nomad/deploymentwatcher/deployments_watcher_test.go + e2e rolling-update
+behaviors): multi-batch rolling updates driven purely by health signals,
+canary auto-promote, manual promote, failure auto-revert, progress
+deadline."""
+import copy
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.sim import SimClient, wait_until
+from nomad_tpu.server.server import Server
+
+
+@pytest.fixture
+def cluster():
+    server = Server(num_workers=2)
+    server.start()
+    clients = [SimClient(server, mock.node()) for _ in range(4)]
+    for c in clients:
+        c.start()
+    yield server, clients
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+def service_job(count=3, max_parallel=1, canary=0, auto_revert=False,
+                auto_promote=False):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = structs.UpdateStrategy(
+        max_parallel=max_parallel, canary=canary,
+        auto_revert=auto_revert, auto_promote=auto_promote,
+        min_healthy_time_s=0.0, healthy_deadline_s=30.0,
+        progress_deadline_s=60.0)
+    job.update = job.task_groups[0].update
+    return job
+
+
+def healthy_deployment(server, job_id, version=None):
+    deps = server.store.deployments_by_job("default", job_id)
+    for d in deps:
+        if version is not None and d.job_version != version:
+            continue
+        return d
+    return None
+
+
+def running_allocs(server, job_id):
+    return [a for a in server.store.allocs_by_job("default", job_id)
+            if a.client_status == structs.ALLOC_CLIENT_RUNNING
+            and not a.server_terminal_status()]
+
+
+def test_initial_deployment_completes_and_marks_stable(cluster):
+    server, clients = cluster
+    job = service_job(count=3)
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 3,
+                      timeout=10)
+    assert wait_until(lambda: any(
+        d.status == structs.DEPLOYMENT_STATUS_SUCCESSFUL
+        for d in server.store.deployments_by_job("default", job.id)),
+        timeout=10), "watcher must flip the deployment successful"
+    stored = server.store.job_by_id("default", job.id)
+    assert wait_until(
+        lambda: server.store.job_by_id("default", job.id).stable,
+        timeout=5), "successful deployment must mark the version stable"
+
+
+def test_multi_batch_rolling_update_completes_on_health(cluster):
+    """max_parallel=1 x 3 replicas: each batch is unblocked by the
+    previous batch's health signal (VERDICT r2 'done' criterion)."""
+    server, clients = cluster
+    job = service_job(count=3, max_parallel=1)
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 3,
+                      timeout=10)
+    assert wait_until(lambda: healthy_deployment(server, job.id, 0) and
+                      healthy_deployment(server, job.id, 0).status
+                      == structs.DEPLOYMENT_STATUS_SUCCESSFUL, timeout=10)
+    # destructive update: change the task env
+    job2 = copy.deepcopy(server.store.job_by_id("default", job.id))
+    job2.task_groups[0].tasks[0].env = {"VERSION": "2"}
+    job2.create_index = job2.modify_index = job2.job_modify_index = 0
+    server.register_job(job2)
+    # the rollout must finish: new deployment successful, all 3 allocs on
+    # the new version, purely from health-driven next-batch evals
+    assert wait_until(lambda: (
+        healthy_deployment(server, job.id, 1) is not None
+        and healthy_deployment(server, job.id, 1).status
+        == structs.DEPLOYMENT_STATUS_SUCCESSFUL), timeout=20), \
+        "rolling deployment must complete on health signals"
+    new_allocs = [a for a in running_allocs(server, job.id)
+                  if a.job and a.job.version == 1]
+    assert len(new_allocs) == 3
+    dep = healthy_deployment(server, job.id, 1)
+    state = dep.task_groups["web"]
+    assert state.healthy_allocs >= 3
+
+
+def test_canary_auto_promote_completes(cluster):
+    server, clients = cluster
+    job = service_job(count=3)
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 3,
+                      timeout=10)
+    job2 = copy.deepcopy(server.store.job_by_id("default", job.id))
+    job2.task_groups[0].tasks[0].env = {"VERSION": "2"}
+    job2.task_groups[0].update.canary = 1
+    job2.task_groups[0].update.auto_promote = True
+    job2.create_index = job2.modify_index = job2.job_modify_index = 0
+    server.register_job(job2)
+    assert wait_until(lambda: (
+        healthy_deployment(server, job.id, 1) is not None
+        and healthy_deployment(server, job.id, 1).status
+        == structs.DEPLOYMENT_STATUS_SUCCESSFUL), timeout=20), \
+        "auto-promote + rollout must complete"
+    dep = healthy_deployment(server, job.id, 1)
+    assert dep.task_groups["web"].promoted
+
+
+def test_canary_manual_promote(cluster):
+    server, clients = cluster
+    job = service_job(count=2)
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 2,
+                      timeout=10)
+    job2 = copy.deepcopy(server.store.job_by_id("default", job.id))
+    job2.task_groups[0].tasks[0].env = {"VERSION": "2"}
+    job2.task_groups[0].update.canary = 1
+    job2.create_index = job2.modify_index = job2.job_modify_index = 0
+    server.register_job(job2)
+    # canary placed + healthy, deployment waits (not promoted)
+    assert wait_until(lambda: (
+        healthy_deployment(server, job.id, 1) is not None
+        and healthy_deployment(server, job.id, 1)
+        .task_groups["web"].placed_canaries), timeout=15)
+    time.sleep(0.5)
+    dep = healthy_deployment(server, job.id, 1)
+    assert dep.status == structs.DEPLOYMENT_STATUS_RUNNING
+    assert not dep.task_groups["web"].promoted
+    ev = server.promote_deployment(dep.id)
+    assert ev is not None
+    assert wait_until(lambda: healthy_deployment(server, job.id, 1).status
+                      == structs.DEPLOYMENT_STATUS_SUCCESSFUL, timeout=20)
+
+
+def test_failed_canary_auto_reverts_to_stable(cluster):
+    server, clients = cluster
+    job = service_job(count=2, auto_revert=True)
+    server.register_job(job)
+    assert wait_until(lambda: len(running_allocs(server, job.id)) == 2,
+                      timeout=10)
+    assert wait_until(
+        lambda: server.store.job_by_id("default", job.id).stable,
+        timeout=10)
+    # v1: canary that fails
+    job2 = copy.deepcopy(server.store.job_by_id("default", job.id))
+    job2.task_groups[0].tasks[0].env = {"VERSION": "2"}
+    job2.task_groups[0].tasks[0].config = {
+        "mock_outcome": "fail", "mock_runtime_s": 0.05}
+    job2.task_groups[0].update.canary = 1
+    job2.task_groups[0].update.auto_revert = True
+    job2.create_index = job2.modify_index = job2.job_modify_index = 0
+    server.register_job(job2)
+    assert wait_until(lambda: (
+        healthy_deployment(server, job.id, 1) is not None
+        and healthy_deployment(server, job.id, 1).status
+        == structs.DEPLOYMENT_STATUS_FAILED), timeout=20), \
+        "failed canary must fail the deployment"
+    dep = healthy_deployment(server, job.id, 1)
+    assert "rolling back" in dep.status_description
+    # auto-revert re-registers the stable v0 spec as a new version
+    assert wait_until(lambda: server.store.job_by_id(
+        "default", job.id).version == 2, timeout=10)
+    reverted = server.store.job_by_id("default", job.id)
+    assert reverted.task_groups[0].tasks[0].env.get("VERSION") != "2"
+    assert reverted.task_groups[0].tasks[0].config.get("mock_outcome") \
+        != "fail"
+
+
+def test_progress_deadline_fails_stuck_deployment():
+    server = Server(num_workers=2)
+    server.start()
+    # one tiny node: capacity for exactly one alloc of this size
+    node = mock.node()
+    node.node_resources.cpu = 700
+    node.node_resources.memory_mb = 512
+    node.compute_class()
+    client = SimClient(server, node)
+    client.start()
+    try:
+        job = service_job(count=3)
+        for tg in job.task_groups:
+            tg.update.progress_deadline_s = 1.0
+            for t in tg.tasks:
+                t.resources.cpu = 500
+                t.resources.networks = []
+        server.register_job(job)
+        assert wait_until(lambda: any(
+            d.status == structs.DEPLOYMENT_STATUS_FAILED
+            and "progress deadline" in d.status_description
+            for d in server.store.deployments_by_job("default", job.id)),
+            timeout=20), "stuck deployment must fail on progress deadline"
+    finally:
+        client.stop()
+        server.stop()

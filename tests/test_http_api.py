@@ -148,7 +148,12 @@ def test_metrics_and_agent_self(agent):
     assert self_["server"]["workers"] == 2
     assert self_["client"]["node_id"] == client.node.id
     metrics = api.agent.metrics()
-    assert "counters" in metrics and "samples" in metrics
+    # the scheduler/plan hot paths must actually be instrumented
+    # (reference: nomad.worker.* / nomad.plan.* go-metrics)
+    assert metrics["counters"].get("worker.dequeue_eval", 0) > 0
+    assert metrics["samples"]["worker.invoke_scheduler_service"]["count"] > 0
+    assert metrics["samples"]["worker.submit_plan"]["p50"] >= 0
+    assert metrics["samples"]["plan.evaluate"]["count"] > 0
 
 
 def _run_cli(api, *argv):
