@@ -78,14 +78,27 @@ def diff_system_allocs(job: Optional[Job], ready_nodes: List[Node],
                 if a.terminal_status():
                     diff.ignore.append(a)
                 elif node is None or node.terminal_status():
+                    # node down/gone wins over a drainer mark: the alloc
+                    # is lost, not politely stopped
                     diff.lost.append(a)
-                else:
-                    # draining: system allocs stop rather than migrate
+                elif a.desired_transition.should_migrate():
+                    # drainer-marked on a live draining node: stop it
                     diff.stop.append(a)
+                else:
+                    # draining but not yet marked by the drainer: left
+                    # alone — system allocs drain LAST
+                    # (reference: util.go:96-127 goto IGNORE)
+                    diff.ignore.append(a)
+                continue
+            # drainer-marked allocs elsewhere migrate (stop + replace)
+            if (not a.terminal_status()
+                    and a.desired_transition.should_migrate()):
+                diff.stop.append(a)
                 continue
             if nid not in eligible:
-                if not a.terminal_status():
-                    diff.stop.append(a)
+                # ineligible (but live) node: existing allocs are left
+                # alone (reference: util.go:131-135 goto IGNORE)
+                diff.ignore.append(a)
                 continue
             if a.terminal_status():
                 # terminal alloc on an eligible node: replaced below via

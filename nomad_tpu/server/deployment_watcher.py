@@ -79,7 +79,6 @@ class DeploymentWatcher:
     # ------------------------------------------------------------- loop
     def _watch(self) -> None:
         store = self.server.store
-        last_index = 0
         while True:
             with self._cv:
                 if not self._enabled:
@@ -94,8 +93,8 @@ class DeploymentWatcher:
                 _log.exception("deployment watcher pass failed")
             # block until new writes (health updates bump the store) or a
             # short tick for deadline checks
-            last_index = store.wait_for_change(store.latest_index(),
-                                               self.poll_interval_s * 4)
+            store.wait_for_change(store.latest_index(),
+                                  self.poll_interval_s * 4)
 
     # ------------------------------------------------------------ checks
     def _check(self, dep: Deployment) -> None:
@@ -160,19 +159,8 @@ class DeploymentWatcher:
         return out or 600.0
 
     def _canaries_healthy(self, dep: Deployment) -> bool:
-        store = self.server.store
-        for state in dep.task_groups.values():
-            if state.desired_canaries <= 0 or state.promoted:
-                continue
-            healthy = 0
-            for aid in state.placed_canaries:
-                a = store.alloc_by_id(aid)
-                if (a is not None and a.deployment_status is not None
-                        and a.deployment_status.is_healthy()):
-                    healthy += 1
-            if healthy < state.desired_canaries:
-                return False
-        return True
+        # single source of truth with manual promotion's validation
+        return not self.server._unhealthy_canary_groups(dep)
 
     # ----------------------------------------------------------- actions
     def _create_eval(self, dep: Deployment, trigger: str) -> None:
@@ -201,6 +189,16 @@ class DeploymentWatcher:
         rollback_job = None
         if any(s.auto_revert for s in dep.task_groups.values()):
             rollback_job = self._latest_stable_job(dep)
+        # same-spec guard (reference: deployment_watcher.go:357
+        # FailDeployment rollback skips when the stable spec equals the
+        # current one) — otherwise a failed re-revert loops forever
+        if rollback_job is not None:
+            current = self.server.store.job_by_id(dep.namespace,
+                                                  dep.job_id)
+            from ..state.store import StateStore
+            if current is not None and \
+                    not StateStore._job_spec_changed(current, rollback_job):
+                rollback_job = None
         if rollback_job is not None:
             desc += DESC_AUTO_REVERT_SUFFIX.format(rollback_job.version)
         self.server.apply_deployment_status_update(DeploymentStatusUpdate(

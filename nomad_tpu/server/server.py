@@ -17,6 +17,8 @@ from ..state.store import StateStore
 from ..structs import (ALLOC_CLIENT_FAILED, CORE_JOB_PRIORITY,
                        EVAL_STATUS_PENDING,
                        EVAL_TRIGGER_DEPLOYMENT_PROMOTION,
+                       EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+                       EVAL_TRIGGER_NODE_DRAIN,
                        EVAL_TRIGGER_JOB_DEREGISTER,
                        EVAL_TRIGGER_JOB_REGISTER, EVAL_TRIGGER_NODE_UPDATE,
                        EVAL_TRIGGER_RETRY_FAILED_ALLOC, JOB_TYPE_CORE,
@@ -72,6 +74,8 @@ class Server:
         self.periodic = PeriodicDispatcher(self)
         from .deployment_watcher import DeploymentWatcher
         self.deployment_watcher = DeploymentWatcher(self)
+        from .drainer import NodeDrainer
+        self.drainer = NodeDrainer(self)
         self.time_table = TimeTable()
         self.gc_interval_s = gc_interval_s
         self.job_gc_threshold_s = job_gc_threshold_s
@@ -103,6 +107,7 @@ class Server:
         self.heartbeater.initialize(
             n.id for n in self.store.nodes() if not n.terminal_status())
         self.deployment_watcher.set_enabled(True)
+        self.drainer.set_enabled(True)
         # periodic jobs resume their schedules (leader.go restorePeriodicDispatcher)
         self.periodic.set_enabled(True)
         for job in self.store.jobs():
@@ -117,6 +122,7 @@ class Server:
     def stop(self) -> None:
         self.heartbeater.set_enabled(False)
         self.deployment_watcher.set_enabled(False)
+        self.drainer.set_enabled(False)
         self.periodic.set_enabled(False)
         self._stop_reapers.set()
         for w in self.workers:
@@ -237,6 +243,12 @@ class Server:
 
     def update_node_drain(self, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> int:
+        # stamp the absolute force deadline at request time
+        # (reference: node_endpoint.go UpdateDrain)
+        if drain_strategy is not None and drain_strategy.deadline_s > 0 \
+                and not drain_strategy.force_deadline:
+            drain_strategy.force_deadline = \
+                _time.time() + drain_strategy.deadline_s
         with self._apply_lock:
             index = self._next_index()
             self.store.update_node_drain(index, node_id, drain_strategy,
@@ -244,6 +256,35 @@ class Server:
         node = self.store.node_by_id(node_id)
         if node is not None:
             self._create_node_evals(node, index)
+        return index
+
+    def drain_allocs(self, alloc_ids: List[str]) -> int:
+        """Mark allocs for migration and evaluate their jobs — the
+        drainer's only write (reference: drainer.go drainAllocs ->
+        Allocs.UpdateDesiredTransition)."""
+        from ..structs import DesiredTransition
+        with self._apply_lock:
+            index = self._next_index()
+            self.store.update_alloc_desired_transition(
+                index, alloc_ids, DesiredTransition(migrate=True))
+        evals: List[Evaluation] = []
+        seen = set()
+        for aid in alloc_ids:
+            a = self.store.alloc_by_id(aid)
+            if a is None:
+                continue
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = a.job or self.store.job_by_id(*key)
+            evals.append(Evaluation(
+                namespace=a.namespace, job_id=a.job_id,
+                type=job.type if job else JOB_TYPE_SERVICE,
+                priority=job.priority if job else 50,
+                triggered_by=EVAL_TRIGGER_NODE_DRAIN,
+                status=EVAL_STATUS_PENDING))
+        self._create_evals(evals)
         return index
 
     def update_node_eligibility(self, node_id: str,
@@ -520,7 +561,8 @@ class Server:
         ev = Evaluation(
             namespace=dep.namespace, job_id=dep.job_id, type=job.type,
             priority=job.priority, deployment_id=dep_id,
-            triggered_by="deployment-watcher", status=EVAL_STATUS_PENDING)
+            triggered_by=EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+            status=EVAL_STATUS_PENDING)
         self._create_evals([ev])
         return ev
 

@@ -97,9 +97,8 @@ class Worker(threading.Thread):
             return
         finally:
             # reference metric: nomad.worker.invoke_scheduler_<type>
-            from ..utils.metrics import global_metrics as _gm
-            _gm.measure_since(f"worker.invoke_scheduler_{ev.type}",
-                              _invoke_t0)
+            _m.measure_since(f"worker.invoke_scheduler_{ev.type}",
+                             _invoke_t0)
         if err is not None:
             server.broker.nack(ev.id, token)
         else:

@@ -46,12 +46,16 @@ class _Summary:
 
     def snapshot(self) -> dict:
         mean = self.sum / self.count if self.count else 0.0
+        vals = sorted(self.values)     # one sort for both percentiles
+        p50 = vals[min(int(len(vals) * 0.50), len(vals) - 1)] if vals \
+            else 0.0
+        p99 = vals[min(int(len(vals) * 0.99), len(vals) - 1)] if vals \
+            else 0.0
         return {"count": self.count, "sum": round(self.sum, 6),
                 "mean": round(mean, 6),
                 "min": round(self.min, 6) if self.count else 0.0,
                 "max": round(self.max, 6),
-                "p50": round(self.percentile(0.50), 6),
-                "p99": round(self.percentile(0.99), 6)}
+                "p50": round(p50, 6), "p99": round(p99, 6)}
 
 
 class MetricsRegistry:

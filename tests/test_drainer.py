@@ -1,0 +1,159 @@
+"""Node drainer tests (reference: nomad/drainer tests + e2e drain
+behaviors): paced migrate waves honoring max_parallel, deadline force,
+system-jobs-last, drain completion."""
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.sim import SimClient, wait_until
+from nomad_tpu.server.server import Server
+
+
+def make_cluster(n_nodes=2):
+    server = Server(num_workers=2)
+    server.start()
+    clients = [SimClient(server, mock.node()) for _ in range(n_nodes)]
+    for c in clients:
+        c.start()
+    return server, clients
+
+
+def stop_cluster(server, clients):
+    for c in clients:
+        c.stop()
+    server.stop()
+
+
+def _job_on_one_node(server, clients, count=4, max_parallel=2):
+    """Job whose allocs all land on clients[0]'s node (others are made
+    ineligible during placement)."""
+    for c in clients[1:]:
+        server.update_node_eligibility(c.node.id, "ineligible")
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].migrate = structs.MigrateStrategy(
+        max_parallel=max_parallel)
+    for t in job.task_groups[0].tasks:
+        t.resources.networks = []
+        t.resources.cpu = 100
+        t.resources.memory_mb = 64
+    server.register_job(job)
+    assert wait_until(lambda: len([
+        a for a in server.store.allocs_by_job("default", job.id)
+        if a.client_status == structs.ALLOC_CLIENT_RUNNING]) == count,
+        timeout=15)
+    return job
+
+
+def migrating(server, job_id):
+    return [a for a in server.store.allocs_by_job("default", job_id)
+            if a.desired_transition.should_migrate()]
+
+
+def test_drain_paced_waves_respect_max_parallel():
+    server, clients = make_cluster(2)
+    try:
+        job = _job_on_one_node(server, clients, count=4, max_parallel=2)
+        node_id = clients[0].node.id
+        # replacements are unplaceable (other node ineligible), so the
+        # first wave must stall at exactly max_parallel
+        server.update_node_drain(node_id, structs.DrainStrategy(
+            deadline_s=3600.0))
+        assert wait_until(lambda: len(migrating(server, job.id)) >= 2,
+                          timeout=10)
+        time.sleep(0.5)          # give the drainer a chance to overshoot
+        assert len(migrating(server, job.id)) == 2, \
+            "wave must be capped at migrate.max_parallel"
+        # open capacity: replacements place, then the next wave fires
+        server.update_node_eligibility(clients[1].node.id, "eligible")
+        assert wait_until(lambda: len([
+            a for a in server.store.allocs_by_job("default", job.id)
+            if a.node_id == clients[1].node.id
+            and a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 4,
+            timeout=20), "all four allocs must migrate to the other node"
+        # drain completes: strategy cleared, node stays ineligible
+        assert wait_until(lambda: server.store.node_by_id(node_id)
+                          .drain_strategy is None, timeout=10)
+        node = server.store.node_by_id(node_id)
+        assert node.scheduling_eligibility == "ineligible"
+    finally:
+        stop_cluster(server, clients)
+
+
+def test_drain_deadline_forces_remaining():
+    server, clients = make_cluster(2)
+    try:
+        job = _job_on_one_node(server, clients, count=4, max_parallel=1)
+        node_id = clients[0].node.id
+        # replacements unplaceable and a short deadline: everything must
+        # be force-migrated at the deadline
+        server.update_node_drain(node_id, structs.DrainStrategy(
+            deadline_s=1.0))
+        assert wait_until(lambda: len(migrating(server, job.id)) == 4,
+                          timeout=10), "deadline must force all allocs"
+        assert wait_until(lambda: all(
+            a.server_terminal_status() or a.client_terminal_status()
+            for a in server.store.allocs_by_job("default", job.id)
+            if a.node_id == node_id), timeout=15)
+    finally:
+        stop_cluster(server, clients)
+
+
+def test_drain_system_jobs_last():
+    server, clients = make_cluster(2)
+    try:
+        sysjob = mock.system_job()
+        sysjob.constraints = []
+        for t in sysjob.task_groups[0].tasks:
+            t.resources.networks = []
+        server.register_job(sysjob)
+        assert wait_until(lambda: len([
+            a for a in server.store.allocs_by_job("default", sysjob.id)
+            if a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 2,
+            timeout=15)
+        job = _job_on_one_node(server, clients, count=2, max_parallel=2)
+        node_id = clients[0].node.id
+        server.update_node_eligibility(clients[1].node.id, "eligible")
+        server.update_node_drain(node_id, structs.DrainStrategy(
+            deadline_s=3600.0))
+        # the service allocs migrate; the system alloc must outlive them
+        assert wait_until(lambda: len([
+            a for a in server.store.allocs_by_job("default", job.id)
+            if a.node_id == clients[1].node.id
+            and a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 2,
+            timeout=20)
+        # then the system alloc drains and the node finishes
+        assert wait_until(lambda: all(
+            a.terminal_status() for a in
+            server.store.allocs_by_job("default", sysjob.id)
+            if a.node_id == node_id), timeout=15)
+        assert wait_until(lambda: server.store.node_by_id(node_id)
+                          .drain_strategy is None, timeout=10)
+    finally:
+        stop_cluster(server, clients)
+
+
+def test_drain_ignore_system_jobs():
+    server, clients = make_cluster(1)
+    try:
+        sysjob = mock.system_job()
+        sysjob.constraints = []
+        for t in sysjob.task_groups[0].tasks:
+            t.resources.networks = []
+        server.register_job(sysjob)
+        assert wait_until(lambda: len([
+            a for a in server.store.allocs_by_job("default", sysjob.id)
+            if a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 1,
+            timeout=15)
+        node_id = clients[0].node.id
+        server.update_node_drain(node_id, structs.DrainStrategy(
+            deadline_s=3600.0, ignore_system_jobs=True))
+        # drain completes while the system alloc keeps running
+        assert wait_until(lambda: server.store.node_by_id(node_id)
+                          .drain_strategy is None, timeout=10)
+        allocs = server.store.allocs_by_job("default", sysjob.id)
+        assert any(a.client_status == structs.ALLOC_CLIENT_RUNNING
+                   and not a.server_terminal_status() for a in allocs)
+    finally:
+        stop_cluster(server, clients)

@@ -144,6 +144,20 @@ def test_system_drain_stops_allocs():
     ev2 = mock.eval_(job_id=job.id, type="system",
                      triggered_by=structs.EVAL_TRIGGER_NODE_DRAIN)
     h.process("system", ev2)
+    # a draining node's system allocs are left alone until the DRAINER
+    # marks them (reference: util.go:96-127 goto IGNORE — system allocs
+    # drain last)
+    live = [a for a in h.store.allocs_by_job("default", job.id)
+            if not a.server_terminal_status()]
+    assert len(live) == 2
+    # once marked for migration, the system scheduler stops them
+    target = [a for a in live if a.node_id == nodes[0].id][0]
+    h.store.update_alloc_desired_transition(
+        h.next_index(), [target.id],
+        structs.DesiredTransition(migrate=True))
+    ev3 = mock.eval_(job_id=job.id, type="system",
+                     triggered_by=structs.EVAL_TRIGGER_NODE_DRAIN)
+    h.process("system", ev3)
     live = [a for a in h.store.allocs_by_job("default", job.id)
             if not a.server_terminal_status()]
     assert len(live) == 1
