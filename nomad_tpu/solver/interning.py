@@ -54,6 +54,14 @@ class RankColumn:
     def rank(self, value: str) -> int:
         return self._rank.get(value, self.MISSING)
 
+    def insertion(self, value: str) -> int:
+        """bisect_left of `value` in the universe: every rank < insertion
+        sorts strictly before `value`, every rank >= insertion sorts at or
+        after it. Lets ordered comparisons against operands OUTSIDE the
+        built universe stay exact (used by Tensorizer.repack_asks)."""
+        import bisect
+        return bisect.bisect_left(self._values, value)
+
     @property
     def n_values(self) -> int:
         return len(self._values)

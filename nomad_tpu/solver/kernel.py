@@ -5,16 +5,36 @@ Replaces the reference's per-placement iterator chain
 select.go limit/max) with dense tensor math over the full node axis:
 
   static feasibility mask  [G, N]   (constraints, dc, host-evaluated ops)
-  `lax.scan` over placements: fit-check + score + masked top-k + commit
+  wave loop: batched [G, N] scoring -> per-group top-k -> parallel commit
 
-The scan is the equivalent of the reference's in-plan visibility
-(scheduler/context.go:120 ProposedAllocs): each placement sees all resources
-committed by earlier placements in the batch. Scores follow the reference's
-conditional-append-then-average normalization (rank.go:667).
+Wave semantics (the TPU recast of in-plan visibility,
+scheduler/context.go:120 ProposedAllocs): instead of committing one
+placement per step, every wave
 
-Where the reference subsamples nodes (limit = max(2, log2 N),
-scheduler/stack.go:80-87), this solve scores every node — strictly better
-placements at far higher eval throughput.
+  1. scores all (group, node) pairs against current usage in one batched
+     pass — the MXU-friendly shape,
+  2. ranks each group's remaining placements and assigns the r-th one to
+     the group's r-th best node (top-k), so same-group placements fan out
+     across nodes exactly as the reference's job anti-affinity pressure
+     (rank.go:462) makes them do one step at a time,
+  3. commits every assignment that survives cross-group conflict checks:
+     cumulative capacity on shared nodes (segment-sum by node),
+     first-per-(node, distinct-group) for distinct_hosts, and a spread
+     quota per (group, value) so targeted/even spread cannot be
+     overfilled inside a single wave (spread.go semantics),
+  4. placements that lose a conflict simply retry next wave against
+     refreshed usage.
+
+Every committed placement's capacity is checked against the usage its
+wave started from plus all earlier same-wave commits on the node, so no
+node ever oversubscribes.  A batch of K placements converges in
+O(K / WAVE_K) waves instead of K serial scan steps; each wave is one
+fused XLA program over [G, N] tensors.
+
+Scores follow the reference's conditional-append-then-average
+normalization (rank.go:667).  Where the reference subsamples nodes
+(limit = max(2, log2 N), scheduler/stack.go:80-87), this solve scores
+every node — strictly better placements at far higher eval throughput.
 """
 from __future__ import annotations
 
@@ -29,6 +49,7 @@ from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT, OP_NE,
                         OP_NONE, OP_NOT_SET, R_CPU, R_MEM)
 
 TOP_K = 4
+WAVE_K = 32       # min per-group wave width; scales up with batch size
 NEG_INF = -1e30
 
 
@@ -58,12 +79,13 @@ class SolveResult(NamedTuple):
     choice: jnp.ndarray        # [K, TOP_K] node indices, best first
     choice_ok: jnp.ndarray     # [K, TOP_K] bool (feasible + fits)
     score: jnp.ndarray         # [K, TOP_K] final normalized scores
-    n_feasible: jnp.ndarray    # [K] feasible node count at step
+    n_feasible: jnp.ndarray    # [K] feasible node count at commit wave
     n_exhausted: jnp.ndarray   # [K] feasible but resource-exhausted
     dim_exhausted: jnp.ndarray  # [K, R] counts per exhausted dimension
     feas: jnp.ndarray          # [G, N] static feasibility mask
     cons_filtered: jnp.ndarray  # [G, C] nodes filtered per constraint slot
     used_final: jnp.ndarray    # [N, R] resource usage after all commits
+    dev_used_final: jnp.ndarray  # [N, D] device usage after all commits
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -76,8 +98,16 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ) -> SolveResult:
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
-    C = c_op.shape[1]
+    S = sp_col.shape[1]
+    R = avail.shape[1]
     K = p_ask.shape[0]
+    # wider waves for bigger batches: a group may commit up to W
+    # placements per wave, so a K-placement batch converges in O(K / W)
+    # fused-wave iterations
+    TK = min(max(WAVE_K, K // 8) + TOP_K, Np)
+    W = max(TK - TOP_K, 1)          # effective per-group wave width
+    ks = jnp.arange(K)
+    gs = jnp.arange(Gp)
 
     # ---------- static feasibility [Gp, Np] ----------
     def per_ask_feas(g):
@@ -92,7 +122,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         filtered = first_fail.sum(axis=0)                  # [C]
         return base & ok.all(axis=1), filtered
 
-    feas, cons_filtered = lax.map(per_ask_feas, jnp.arange(Gp))
+    feas, cons_filtered = lax.map(per_ask_feas, gs)
 
     # affinity matches are also placement-invariant: [Gp, Np]
     def per_ask_aff(g):
@@ -100,29 +130,26 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         match = _op_eval(vals, a_op[g], a_rank[g])
         return (match * a_weight[g][None, :]).sum(axis=1)  # [Np]
 
-    aff_score = lax.map(per_ask_aff, jnp.arange(Gp)) + a_host
+    aff_score = lax.map(per_ask_aff, gs) + a_host
+    pen_score = jnp.where(penalty, -1.0, 0.0)              # rank.go:532
+    pen_counts = penalty
 
-    # ---------- placement scan ----------
-    def step(carry, p):
-        used, dev_used, coll, sp_used, blocked = carry
-        g = p_ask[p]
-        active = p < n_place
-        res_g = ask_res[g]
-
-        after = used + res_g[None, :]                      # [Np, R]
-        fit_dims = after <= avail                          # [Np, R]
-        fit = fit_dims.all(axis=1)
-        dev_after = dev_used + dev_ask[g][None, :]
-        dev_fit = (dev_after <= dev_cap).all(axis=1)
-
-        feas_g = feas[g] & ~blocked[g]
-        placeable = feas_g & fit & dev_fit
+    def group_scores(used, dev_used, coll, sp_used, blocked):
+        """Batched scoring of every (group, node) pair against current
+        usage — one instance of the reference's rank pipeline, [Gp, Np]."""
+        after = used[None, :, :] + ask_res[:, None, :]     # [Gp, Np, R]
+        fit_dims = after <= avail[None, :, :]
+        fit = fit_dims.all(axis=-1)
+        dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
+                   <= dev_cap[None, :, :]).all(axis=-1)
+        feas_b = feas & ~blocked
+        placeable = feas_b & fit & dev_fit
 
         # -- binpack (funcs.go:155 ScoreFit, normalized rank.go:441) --
-        denom_cpu = avail[:, R_CPU]
-        denom_mem = avail[:, R_MEM]
-        util_cpu = after[:, R_CPU] + reserved[:, R_CPU]
-        util_mem = after[:, R_MEM] + reserved[:, R_MEM]
+        denom_cpu = avail[None, :, R_CPU]
+        denom_mem = avail[None, :, R_MEM]
+        util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
+        util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
         ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
         free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
         free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
@@ -131,95 +158,194 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                             jnp.clip(raw, 0.0, 18.0) / 18.0, 0.0)
 
         # -- job anti-affinity (rank.go:462) --
-        collg = coll[g]
-        anti = jnp.where(collg > 0, -(collg + 1.0) / ask_desired[g], 0.0)
-        anti_counts = collg > 0
-
-        # -- node reschedule penalty (rank.go:532) --
-        pen = jnp.where(penalty[g], -1.0, 0.0)
-        pen_counts = penalty[g]
-
-        # -- node affinity (rank.go:577; append-if-nonzero) --
-        affg = aff_score[g]
-        aff_counts = affg != 0.0
+        anti = jnp.where(coll > 0,
+                         -(coll + 1.0) / ask_desired[:, None], 0.0)
+        anti_counts = coll > 0
 
         # -- spread (spread.go; append-if-nonzero) --
         def one_spread(s):
-            col = sp_col[g, s]
+            col = sp_col[:, s]                             # [Gp]
             has = col >= 0
-            v = attr_rank[:, jnp.maximum(col, 0)]          # [Np]
+            v = attr_rank[:, jnp.maximum(col, 0)].T        # [Gp, Np]
             has_v = v >= 0
             vc = jnp.maximum(v, 0)
-            used_vec = sp_used[g, s]                       # [V]
-            cur = jnp.where(has_v, used_vec[vc], 0.0)
+            used_vec = sp_used[:, s]                       # [Gp, V]
+            cur = jnp.where(has_v,
+                            jnp.take_along_axis(used_vec, vc, axis=1), 0.0)
             # targeted scoring (desired counts, +1 for this placement)
-            desired = jnp.where(has_v, sp_desired[g, s, vc], -1.0)
-            desired = jnp.where(desired < 0, sp_implicit[g, s], desired)
+            desired = jnp.where(
+                has_v, jnp.take_along_axis(sp_desired[:, s], vc, axis=1),
+                -1.0)
+            desired = jnp.where(desired < 0, sp_implicit[:, s][:, None],
+                                desired)
             boost = ((desired - (cur + 1.0)) / jnp.maximum(desired, 1e-9)
-                     ) * sp_weight[g, s]
+                     ) * sp_weight[:, s][:, None]
             targeted = jnp.where(~has_v, -1.0,
                                  jnp.where(desired <= 0, -1.0, boost))
             # even-spread scoring (spread.go evenSpreadScoreBoost)
-            present = used_vec > 0
-            any_present = present.any()
-            minc = jnp.min(jnp.where(present, used_vec, jnp.inf))
-            maxc = jnp.max(jnp.where(present, used_vec, -jnp.inf))
+            present = used_vec > 0                         # [Gp, V]
+            any_present = present.any(axis=1)[:, None]
+            minc = jnp.min(jnp.where(present, used_vec, jnp.inf),
+                           axis=1)[:, None]
+            maxc = jnp.max(jnp.where(present, used_vec, -jnp.inf),
+                           axis=1)[:, None]
             delta_boost = (minc - cur) / jnp.maximum(minc, 1e-9)
             even = jnp.where(cur != minc, delta_boost,
                              jnp.where(minc == maxc, -1.0,
-                                       (maxc - minc) / jnp.maximum(minc, 1e-9)))
+                                       (maxc - minc) / jnp.maximum(minc,
+                                                                   1e-9)))
             even = jnp.where(~has_v, -1.0, even)
             even = jnp.where(any_present, even, 0.0)
-            contrib = jnp.where(sp_targeted[g, s], targeted, even)
-            return jnp.where(has, contrib, 0.0)
+            contrib = jnp.where(sp_targeted[:, s][:, None], targeted, even)
+            return jnp.where(has[:, None], contrib, 0.0)
 
-        S = sp_col.shape[1]
-        sp_scores = lax.map(one_spread, jnp.arange(S))     # [S, Np]
+        sp_scores = lax.map(one_spread, jnp.arange(S))     # [S, Gp, Np]
         spread_total = sp_scores.sum(axis=0)
         spread_counts = spread_total != 0.0
 
+        aff_counts = aff_score != 0.0
         # -- normalization: mean over appended scorers (rank.go:667) --
         n_scorers = (1.0 + anti_counts + pen_counts + aff_counts
                      + spread_counts)
-        total = (binpack + anti + pen + affg + spread_total) / n_scorers
+        total = (binpack + anti + pen_score + aff_score
+                 + spread_total) / n_scorers
         score = jnp.where(placeable, total, NEG_INF)
+        return score, placeable, feas_b, fit, fit_dims, dev_fit
 
-        top_score, top_idx = lax.top_k(score, TOP_K)
-        top_ok = (top_score > NEG_INF / 2) & active
-        choice = top_idx[0]
-        ok = top_ok[0]
+    # ---------- wave loop ----------
+    def cond(st):
+        (_, _, _, _, _, done, _, _, _, _, _, _, wave) = st
+        return ((~done & (ks < n_place)).any()) & (wave < K + 1)
 
-        # -- commit the winner --
-        add = jnp.where(ok, 1.0, 0.0)
-        used = used.at[choice].add(res_g * add)
-        dev_used = dev_used.at[choice].add(dev_ask[g] * add)
-        coll = coll.at[g, choice].add(add)
-        # distinct_hosts: later placements of any ask sharing this ask's
-        # distinct group (same job for job-level constraints) skip the node
-        same_grp = (distinct == distinct[g]) & (distinct[g] >= 0)   # [Gp]
-        hit = (jnp.arange(Np) == choice) & ok                       # [Np]
-        blocked = blocked | (same_grp[:, None] & hit[None, :])
-        # spread usage: bump the chosen node's value per spread slot
-        ch_vals = attr_rank[choice, jnp.maximum(sp_col[g], 0)]   # [S]
-        valid_slot = (sp_col[g] >= 0) & (ch_vals >= 0)
-        sp_used = sp_used.at[g, jnp.arange(S),
-                             jnp.maximum(ch_vals, 0)].add(
-            jnp.where(valid_slot, add, 0.0))
+    def body(st):
+        (used, dev_used, coll, sp_used, blocked, done,
+         out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
+         wave) = st
+        active = ~done & (ks < n_place)
 
-        n_feas = (feas_g & valid).sum()
-        n_exh = (feas_g & valid & ~(fit & dev_fit)).sum()
-        dim_exh = (feas_g[:, None] & valid[:, None] & ~fit_dims).sum(axis=0)
+        score, placeable, feas_b, fit, fit_dims, dev_fit = group_scores(
+            used, dev_used, coll, sp_used, blocked)
+        top_score, top_idx = lax.top_k(score, TK)          # [Gp, TK]
+        grp_any = placeable.any(axis=1)                    # [Gp]
 
-        return ((used, dev_used, coll, sp_used, blocked),
-                (top_idx, top_ok, top_score, n_feas, n_exh, dim_exh))
+        # metrics snapshot for placements finishing this wave
+        n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
+        n_exh_g = (feas_b & valid[None, :] & ~(fit & dev_fit)).sum(axis=1)
+        dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
+                     & ~fit_dims).sum(axis=1)              # [Gp, R]
 
-    init = (used0, dev_used0, coll0, sp_used0,
-            jnp.zeros((Gp, Np), bool))
-    (used_final, _, _, _, _), outs = lax.scan(init=init, xs=jnp.arange(K),
-                                              f=step)
-    top_idx, top_ok, top_score, n_feas, n_exh, dim_exh = outs
+        # rank each active placement within its group; the r-th remaining
+        # placement is assigned the group's r-th best node this wave
+        g_idx = p_ask
+        grp_onehot = ((g_idx[None, :] == gs[:, None])
+                      & active[None, :]).astype(jnp.int32)  # [Gp, K]
+        rank = (jnp.cumsum(grp_onehot, axis=1)
+                - grp_onehot)[g_idx, ks]                   # exclusive count
+        in_wave = active & (rank < W)
+        cr = jnp.minimum(rank, W - 1)
+        cand = top_idx[g_idx, cr]                          # [K]
+        cand_score = top_score[g_idx, cr]
+        cand_ok = in_wave & (cand_score > NEG_INF / 2)
 
-    return SolveResult(choice=top_idx, choice_ok=top_ok, score=top_score,
-                       n_feasible=n_feas, n_exhausted=n_exh,
-                       dim_exhausted=dim_exh, feas=feas,
-                       cons_filtered=cons_filtered, used_final=used_final)
+        # a group with nothing placeable fails all its remaining placements
+        fail_now = active & ~grp_any[g_idx]
+
+        # -- cross-group conflict checks over shared nodes --
+        earlier = ks[None, :] < ks[:, None]                # [K, K]
+        both_ok = cand_ok[None, :] & cand_ok[:, None]
+        same_node = (cand[None, :] == cand[:, None]) & both_ok & earlier
+        res_k = ask_res[g_idx] * cand_ok[:, None]
+        dev_k = dev_ask[g_idx] * cand_ok[:, None]
+        prior = same_node.astype(jnp.float32) @ res_k      # [K, R]
+        prior_dev = same_node.astype(jnp.float32) @ dev_k  # [K, D]
+        fits = ((used[cand] + prior + ask_res[g_idx])
+                <= avail[cand]).all(axis=-1)
+        dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
+                    <= dev_cap[cand]).all(axis=-1)
+
+        # distinct_hosts: one commit per (node, distinct group) per wave;
+        # cross-wave blocking below keeps later waves off the node too
+        dg = distinct[g_idx]
+        same_dg = same_node & (dg[None, :] == dg[:, None]) & (dg[:, None] >= 0)
+        dg_ok = ~same_dg.any(axis=1)
+
+        # spread quota: cap same-wave commits per (group, slot, value) so a
+        # wave cannot overfill a spread target the serial reference would
+        # have steered away from (S is a small static pad; unrolled)
+        same_g = both_ok & earlier & (g_idx[None, :] == g_idx[:, None])
+        sp_ok = jnp.ones(K, bool)
+        for s in range(S):
+            cols = sp_col[g_idx, s]
+            vs = attr_rank[cand, jnp.maximum(cols, 0)]
+            has_s = (cols >= 0) & (vs >= 0)
+            vsc = jnp.maximum(vs, 0)
+            des_s = sp_desired[:, s]                       # [Gp, V]
+            use_s = sp_used[:, s]
+            des_eff = jnp.where(des_s < 0, sp_implicit[:, s][:, None],
+                                des_s)
+            present = use_s > 0
+            maxc = jnp.max(jnp.where(present, use_s, 0.0),
+                           axis=1)[:, None]
+            quota = jnp.where(sp_targeted[:, s][:, None],
+                              jnp.maximum(1.0, des_eff - use_s),
+                              jnp.maximum(1.0, maxc - use_s))  # [Gp, V]
+            same_gv = (same_g & (vs[None, :] == vs[:, None])
+                       & has_s[:, None] & has_s[None, :])
+            gv_rank = same_gv.sum(axis=1).astype(jnp.float32)
+            sp_ok &= ~has_s | (gv_rank < quota[g_idx, vsc])
+
+        commit = cand_ok & fits & dev_fits & dg_ok & sp_ok
+        cm = commit[:, None]
+
+        # -- apply all of this wave's commits at once --
+        used = used.at[cand].add(ask_res[g_idx] * cm)
+        dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
+        coll = coll.at[g_idx, cand].add(commit.astype(jnp.float32))
+        hit = jnp.zeros((Gp, Np), jnp.int32).at[
+            jnp.maximum(dg, 0), cand].add(
+            (commit & (dg >= 0)).astype(jnp.int32)) > 0
+        blocked = blocked | (hit[jnp.maximum(distinct, 0)]
+                             & (distinct >= 0)[:, None])
+        svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
+        okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm
+        sp_used = sp_used.at[g_idx[:, None], jnp.arange(S)[None, :],
+                             jnp.maximum(svals, 0)].add(
+            okslot.astype(jnp.float32))
+
+        # -- record results: a committed placement's fall-through top-K is
+        # its group's candidate list starting at its own rank --
+        offs = cr[:, None] + jnp.arange(TOP_K)[None, :]    # < TK by constr.
+        pk_idx = top_idx[g_idx[:, None], offs]
+        pk_score = top_score[g_idx[:, None], offs]
+        pk_ok = pk_score > NEG_INF / 2
+        newly = commit | fail_now
+        upd = newly[:, None]
+        out_idx = jnp.where(upd, pk_idx, out_idx)
+        out_score = jnp.where(upd, pk_score, out_score)
+        out_ok = jnp.where(upd, pk_ok & cm, out_ok)
+        out_nfeas = jnp.where(newly, n_feas_g[g_idx], out_nfeas)
+        out_nexh = jnp.where(newly, n_exh_g[g_idx], out_nexh)
+        out_dimexh = jnp.where(newly[:, None], dim_exh_g[g_idx], out_dimexh)
+        done = done | newly
+        return (used, dev_used, coll, sp_used, blocked, done,
+                out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
+                wave + 1)
+
+    st0 = (used0, dev_used0, coll0, sp_used0,
+           jnp.zeros((Gp, Np), bool),
+           jnp.zeros(K, bool),
+           jnp.zeros((K, TOP_K), jnp.int32),
+           jnp.zeros((K, TOP_K), bool),
+           jnp.full((K, TOP_K), NEG_INF, jnp.float32),
+           jnp.zeros(K, jnp.int32),
+           jnp.zeros(K, jnp.int32),
+           jnp.zeros((K, R), jnp.int32),
+           jnp.int32(0))
+    (used_final, dev_used_final, _, _, _, _, out_idx, out_ok, out_score,
+     out_nfeas, out_nexh, out_dimexh, _) = lax.while_loop(cond, body, st0)
+
+    return SolveResult(choice=out_idx, choice_ok=out_ok, score=out_score,
+                       n_feasible=out_nfeas, n_exhausted=out_nexh,
+                       dim_exhausted=out_dimexh, feas=feas,
+                       cons_filtered=cons_filtered, used_final=used_final,
+                       dev_used_final=dev_used_final)

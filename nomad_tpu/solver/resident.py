@@ -1,0 +1,174 @@
+"""Resident solve: node tensors live on device, eval batches stream.
+
+The transport between host and TPU has a large fixed cost per transfer
+and per round trip (hundreds of microseconds locally, ~100ms over a
+tunnel), while the solve itself is sub-millisecond.  The reference never
+faces this — its scheduler runs in-process (nomad/worker.go) — so the
+TPU-first design has to restructure the *data flow*, not just the math:
+
+  * pack the node side ONCE (capacity, attributes, device inventory) and
+    `device_put` it a single time;
+  * per eval batch, pack only the [G, ...] ask programs
+    (Tensorizer.repack_asks) — no O(N) host walk, no O(N) transfer;
+  * carry `used` / `dev_used` ON DEVICE between batches, so cluster
+    usage never bounces through the host;
+  * fuse MANY eval batches into one device call with `lax.scan`
+    (solve_stream), amortizing the round trip over thousands of
+    placements; each batch's placements see every earlier batch's
+    RESOURCE commits (cpu/mem/disk/net + devices) through the carried
+    usage.  Job-scoped scoring state — distinct_hosts blocking,
+    anti-affinity collocation, spread usage — is seeded per batch, which
+    is sound because the eval broker serializes evals per job
+    (reference: nomad/eval_broker.go job-token dedup): one job can never
+    appear in two batches of the same stream, and those dimensions never
+    cross jobs.  solve_stream enforces that invariant;
+  * fetch ONE packed [B, K, TOP_K, 2] result buffer (node index + score;
+    `ok` is derivable because failed slots score NEG_INF).
+
+Falls back to the general Solver path whenever an ask steps outside the
+resident universe (repack_asks returns None).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..structs import Node
+from .kernel import NEG_INF, TOP_K, solve_kernel
+from .tensorize import PackedBatch, PlacementAsk, Tensorizer
+
+# ask-side solve_kernel args stacked per batch (see sharded._ARG_SPECS)
+_ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
+             "coll0", "penalty", "c_op", "c_col", "c_rank", "a_op", "a_col",
+             "a_rank", "a_weight", "a_host", "sp_col", "sp_weight",
+             "sp_targeted", "sp_desired", "sp_implicit", "sp_used0",
+             "dev_ask", "p_ask")
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
+                   used0, dev_used0, stacked, n_places):
+    """lax.scan solve_kernel over a leading batch axis of ask tensors,
+    threading resource usage from batch to batch on device."""
+
+    def step(carry, xs):
+        used, dev_used = carry
+        batch, n_place = xs
+        res = solve_kernel(
+            avail, reserved, used, valid, node_dc, attr_rank,
+            batch["ask_res"], batch["ask_desired"], batch["distinct"],
+            batch["dc_ok"], batch["host_ok"], batch["coll0"],
+            batch["penalty"], batch["c_op"], batch["c_col"],
+            batch["c_rank"], batch["a_op"], batch["a_col"],
+            batch["a_rank"], batch["a_weight"], batch["a_host"],
+            batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
+            batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
+            dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place)
+        packed = jnp.stack(
+            [res.choice.astype(jnp.float32), res.score], axis=-1)
+        return (res.used_final, res.dev_used_final), packed
+
+    (used_f, dev_used_f), out = jax.lax.scan(step, (used0, dev_used0),
+                                             (stacked, n_places))
+    return used_f, dev_used_f, out
+
+
+class ResidentSolver:
+    """Streaming placement engine for one node snapshot.
+
+    Build once per (node set, attribute/driver universe); then
+    `solve_stream` processes eval batches with device-resident state.
+    The probe asks passed to the constructor define the tensor universe
+    (attr columns, constraint/affinity/spread slot counts, device
+    patterns); real batches whose asks fit that universe take the fast
+    path.
+    """
+
+    def __init__(self, nodes: Sequence[Node],
+                 probe_asks: Sequence[PlacementAsk],
+                 allocs_by_node: Optional[Dict[str, list]] = None,
+                 gp: Optional[int] = None, kp: Optional[int] = None):
+        self.nodes = list(nodes)
+        self._tz = Tensorizer()
+        self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
+        self.gp = gp or self.template.ask_res.shape[0]
+        self.kp = kp or self.template.p_ask.shape[0]
+        self._drv_cache: Dict[str, np.ndarray] = {}
+        t = self.template
+        self._dev_node = {
+            "avail": jax.device_put(t.avail),
+            "reserved": jax.device_put(t.reserved),
+            "valid": jax.device_put(t.valid),
+            "node_dc": jax.device_put(t.node_dc),
+            "attr_rank": jax.device_put(t.attr_rank),
+            "dev_cap": jax.device_put(t.dev_cap),
+        }
+        self._used = jax.device_put(t.used0)
+        self._dev_used = jax.device_put(t.dev_used0)
+
+    def pack_batch(self, asks: Sequence[PlacementAsk]
+                   ) -> Optional[PackedBatch]:
+        """Ask-side-only pack against the resident universe."""
+        pb = self._tz.repack_asks(self.nodes, asks, self.template,
+                                  gp=self.gp, kp=self.kp,
+                                  drv_cache=self._drv_cache)
+        if pb is not None:
+            pb.job_keys = {(a.job.namespace, a.job.id) for a in asks}
+        return pb
+
+    def solve_stream(self, batches: Sequence[PackedBatch]
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Solve B ask batches in ONE device call.
+
+        Returns (choice [B, K, TOP_K] int, ok [B, K, TOP_K] bool,
+        score [B, K, TOP_K] float).  Resource usage carries on device: a
+        later batch sees every earlier batch's placements, and the
+        carried usage persists for the next solve_stream call.
+
+        A job may appear in at most ONE batch per stream (the broker's
+        per-job eval serialization): job-scoped scoring state is seeded
+        per batch and does not carry.
+        """
+        seen: set = set()
+        for pb in batches:
+            keys = getattr(pb, "job_keys", None)
+            if keys:
+                overlap = seen & keys
+                if overlap:
+                    raise ValueError(
+                        f"job {overlap} appears in multiple batches of "
+                        "one stream; job-scoped state (distinct_hosts, "
+                        "anti-affinity, spread) would not be visible "
+                        "across them")
+                seen |= keys
+        stacked = {
+            name: np.stack([getattr(pb, name) for pb in batches])
+            for name in _ASK_ARGS
+        }
+        n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+        self._used, self._dev_used, out = _stream_kernel(
+            self._dev_node["avail"], self._dev_node["reserved"],
+            self._dev_node["valid"], self._dev_node["node_dc"],
+            self._dev_node["attr_rank"], self._dev_node["dev_cap"],
+            self._used, self._dev_used, stacked, n_places)
+        out = np.asarray(out)                     # ONE fetched buffer
+        choice = out[..., 0].astype(np.int32)
+        score = out[..., 1]
+        ok = score > NEG_INF / 2
+        return choice, ok, score
+
+    def usage(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch the carried device usage (one sync — call sparingly)."""
+        return np.asarray(self._used), np.asarray(self._dev_used)
+
+    def reset_usage(self, used0: Optional[np.ndarray] = None,
+                    dev_used0: Optional[np.ndarray] = None) -> None:
+        t = self.template
+        self._used = jax.device_put(
+            t.used0 if used0 is None else used0)
+        self._dev_used = jax.device_put(
+            t.dev_used0 if dev_used0 is None else dev_used0)

@@ -161,6 +161,9 @@ class PackedBatch:
     attr_targets: List[str] = field(default_factory=list)
     constraint_labels: List[List[str]] = field(default_factory=list)
     class_ids: Dict[str, int] = field(default_factory=dict)
+    dc_ids: Dict[str, int] = field(default_factory=dict)
+    dev_pattern_ids: Dict[Tuple[str, str, str], int] = field(
+        default_factory=dict)
 
 
 class Tensorizer:
@@ -503,6 +506,270 @@ class Tensorizer:
             rank_columns=rank_columns, attr_targets=attr_targets,
             constraint_labels=constraint_labels,
             class_ids=dict(class_interner.items()),
+            dc_ids=dict(dc_interner.items()),
+            dev_pattern_ids=dict(dev_pattern_ix),
+        )
+
+    def repack_asks(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
+                    template: PackedBatch,
+                    gp: Optional[int] = None, kp: Optional[int] = None,
+                    drv_cache: Optional[Dict[str, np.ndarray]] = None
+                    ) -> Optional[PackedBatch]:
+        """Rebuild ONLY the ask-side tensors of `template`, reusing its
+        node-side arrays and rank universes untouched.
+
+        This is the resident-solve fast path (solver/resident.py): the node
+        tensors stay on device across eval batches, so per batch we only
+        have to pack [G, ...] ask programs — no O(N) node walk, no O(N)
+        transfer. Returns None when an ask steps outside the template's
+        universe (unknown attr column, too many constraint slots, unknown
+        device pattern, host volumes), in which case the caller falls back
+        to a full `pack`.
+
+        Ordered comparisons against operands the universe has never seen
+        stay exact via RankColumn.insertion (a `<` against an unseen
+        operand becomes `<` against its insertion rank, etc. — lexical
+        order is preserved by construction).
+        """
+        N = len(nodes)
+        Np = template.avail.shape[0]
+        if N != template.n_real:
+            return None
+        G = len(asks)
+        gp = gp or template.ask_res.shape[0]
+        C = template.c_op.shape[1]
+        CA = template.a_op.shape[1]
+        S = template.sp_col.shape[1]
+        V = template.sp_desired.shape[2]
+        D = template.dev_cap.shape[1]
+        NDC = template.dc_ok.shape[1]
+        if G > gp:
+            return None
+        # distinct_property limits are enforced host-side by Solver.solve's
+        # _property_fit walk, which the resident path skips — fall back
+        if any(ask.property_limits for ask in asks):
+            return None
+        rank_columns = template.rank_columns
+        attr_ix = {t: i for i, t in enumerate(template.attr_targets)}
+
+        def ranked(col: int, operand: str, op: int
+                   ) -> Optional[Tuple[int, int]]:
+            """(op, rank) for an operand vs a fixed universe; exact for
+            every op. None = inexpressible (can't happen today)."""
+            rc = rank_columns[col]
+            r = rc.rank(operand)
+            if r >= 0:
+                return op, r
+            if op in (OP_EQ, OP_NE, OP_IS_SET, OP_NOT_SET):
+                return op, -2          # never equals a real rank
+            ins = rc.insertion(operand)
+            if op in (OP_LT, OP_LE):   # value < unseen  ==  value <= pred
+                return OP_LT, ins
+            if op in (OP_GT, OP_GE):
+                return OP_GE, ins
+            return None
+
+        c_op = np.zeros((gp, C), np.int32)
+        c_col = np.zeros((gp, C), np.int32)
+        c_rank = np.zeros((gp, C), np.int32)
+        a_op = np.zeros((gp, CA), np.int32)
+        a_col = np.zeros((gp, CA), np.int32)
+        a_rank = np.zeros((gp, CA), np.int32)
+        a_weight = np.zeros((gp, CA), np.float32)
+        a_host = np.zeros((gp, Np), np.float32)
+        host_ok = np.zeros((gp, Np), bool)
+        host_ok[:, :N] = True
+        constraint_labels: List[List[str]] = []
+        node_index = {n.id: i for i, n in enumerate(nodes)}
+        if drv_cache is None:
+            drv_cache = {}
+
+        for g, ask in enumerate(asks):
+            vec, labels, host = [], [], []
+            for c in hostfeas.merged_constraints(ask.job, ask.tg):
+                if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
+                                 CONSTRAINT_DISTINCT_PROPERTY):
+                    continue
+                op = _VECTOR_OPS.get(c.operand)
+                if (op is not None and c.ltarget.startswith("${")
+                        and not c.rtarget.startswith("${")):
+                    col = attr_ix.get(c.ltarget)
+                    if col is None:
+                        return None
+                    orank = ranked(col, c.rtarget, op)
+                    if orank is None:
+                        return None
+                    vec.append((orank[0], col, orank[1]))
+                    labels.append(str(c))
+                else:
+                    host.append(c)
+            if len(vec) > C:
+                return None
+            for k, (op, col, r) in enumerate(vec):
+                c_op[g, k], c_col[g, k], c_rank[g, k] = op, col, r
+            constraint_labels.append(labels)
+
+            mask = np.ones(N, bool)
+            for c in host:
+                mask &= self._class_masked(nodes, c)
+            for drv in hostfeas.group_drivers(ask.tg):
+                dmask = drv_cache.get(drv)
+                if dmask is None:
+                    dmask = np.fromiter(
+                        (hostfeas.driver_feasible(n, drv) for n in nodes),
+                        bool, N)
+                    drv_cache[drv] = dmask
+                mask &= dmask
+            if any(v.type in ("", "host") for v in ask.tg.volumes.values()):
+                mask &= np.fromiter(
+                    (hostfeas.host_volumes_feasible(n, ask.tg)
+                     for n in nodes), bool, N)
+            for nid in ask.distinct_hosts_blocked:
+                i = node_index.get(nid)
+                if i is not None:
+                    mask[i] = False
+            host_ok[g, :N] = mask
+
+            affs, haffs = [], []
+            merged_affs = list(ask.job.affinities) + list(ask.tg.affinities)
+            for t in ask.tg.tasks:
+                merged_affs.extend(t.affinities)
+            for a in merged_affs:
+                op = _VECTOR_OPS.get(a.operand)
+                if (op is not None and a.ltarget.startswith("${")
+                        and not a.rtarget.startswith("${")):
+                    col = attr_ix.get(a.ltarget)
+                    if col is None:
+                        return None
+                    affs.append((col, a.rtarget, op, float(a.weight)))
+                else:
+                    haffs.append(a)
+            if len(affs) > CA:
+                return None
+            total = (sum(abs(w) for _, _, _, w in affs)
+                     + sum(abs(a.weight) for a in haffs))
+            for k, (col, operand, op, w) in enumerate(affs):
+                orank = ranked(col, operand, op)
+                if orank is None:
+                    return None
+                a_op[g, k], a_col[g, k] = orank[0], col
+                a_rank[g, k] = orank[1]
+                a_weight[g, k] = w / total if total else 0.0
+            for aff in haffs:
+                c = Constraint(aff.ltarget, aff.rtarget, aff.operand)
+                match = self._class_masked(nodes, c)
+                a_host[g, :N] += match * (aff.weight / total if total
+                                          else 0.0)
+
+        # ---- dc eligibility against the template's dc universe ----
+        dc_ok = np.zeros((gp, NDC), bool)
+        for g, ask in enumerate(asks):
+            dcs = set(ask.job.datacenters)
+            for dc, did in template.dc_ids.items():
+                if dc in dcs or "*" in dcs:
+                    dc_ok[g, did] = True
+
+        # ---- asks / spreads / devices ----
+        ask_res = np.zeros((gp, NUM_R), np.float32)
+        ask_desired = np.ones(gp, np.float32)
+        distinct = np.full(gp, -1, np.int32)
+        distinct_interner = Interner()
+        coll0 = np.zeros((gp, Np), np.float32)
+        penalty = np.zeros((gp, Np), bool)
+        sp_col = np.full((gp, S), -1, np.int32)
+        sp_weight = np.zeros((gp, S), np.float32)
+        sp_targeted = np.zeros((gp, S), bool)
+        sp_desired = np.full((gp, S, V), -1.0, np.float32)
+        sp_implicit = np.full((gp, S), -1.0, np.float32)
+        sp_used0 = np.zeros((gp, S, V), np.float32)
+        dev_ask = np.zeros((gp, D), np.float32)
+        p_ask_list: List[int] = []
+        for g, ask in enumerate(asks):
+            ask_res[g] = group_resource_vector(ask.tg)
+            ask_desired[g] = max(ask.tg.count, 1)
+            if any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                   for c in ask.job.constraints):
+                distinct[g] = distinct_interner.intern("job:" + ask.job.id)
+            elif any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                     for c in hostfeas.merged_constraints(ask.job, ask.tg)):
+                distinct[g] = distinct_interner.intern(
+                    f"tg:{ask.job.id}:{ask.tg.name}")
+            for nid, cnt in ask.existing_by_node.items():
+                i = node_index.get(nid)
+                if i is not None:
+                    coll0[g, i] = cnt
+            for nid in ask.penalty_nodes:
+                i = node_index.get(nid)
+                if i is not None:
+                    penalty[g, i] = True
+
+            sps = list(ask.job.spreads) + list(ask.tg.spreads)
+            if len(sps) > S:
+                return None
+            sum_w = sum(sp.weight for sp in sps)
+            total_count = max(ask.tg.count, 1)
+            for s, sp in enumerate(sps):
+                col = attr_ix.get(sp.attribute)
+                if col is None:
+                    return None
+                rc = rank_columns[col]
+                if rc.n_values > V:
+                    return None
+                sp_col[g, s] = col
+                sp_weight[g, s] = sp.weight / sum_w if sum_w else 0.0
+                if sp.spread_targets:
+                    sp_targeted[g, s] = True
+                    sum_desired = 0.0
+                    for st in sp.spread_targets:
+                        d = (st.percent / 100.0) * total_count
+                        r = rc.rank(st.value)
+                        if r >= 0:
+                            sp_desired[g, s, r] = d
+                        sum_desired += d
+                    if 0 < sum_desired < total_count:
+                        sp_implicit[g, s] = total_count - sum_desired
+                seed = ask.spread_seed.get(sp.attribute, {})
+                for val, cnt in seed.items():
+                    r = rc.rank(val)
+                    if r >= 0:
+                        sp_used0[g, s, r] = cnt
+
+            for t in ask.tg.tasks:
+                for d in t.resources.devices:
+                    dix = template.dev_pattern_ids.get(d.id_tuple())
+                    if dix is None:
+                        return None
+                    dev_ask[g, dix] += d.count
+            p_ask_list.extend([g] * ask.count)
+
+        kp = kp or _pad_pow2(max(len(p_ask_list), 1), floor=1)
+        if len(p_ask_list) > kp:
+            return None
+        p_ask = np.zeros(kp, np.int32)
+        p_ask[:len(p_ask_list)] = p_ask_list
+
+        return PackedBatch(
+            node_ids=template.node_ids, n_real=template.n_real,
+            avail=template.avail, reserved=template.reserved,
+            used0=template.used0, valid=template.valid,
+            node_class=template.node_class, node_dc=template.node_dc,
+            attr_rank=template.attr_rank,
+            n_asks=G, ask_res=ask_res, ask_desired=ask_desired,
+            distinct=distinct, dc_ok=dc_ok, host_ok=host_ok,
+            coll0=coll0, penalty=penalty,
+            c_op=c_op, c_col=c_col, c_rank=c_rank,
+            a_op=a_op, a_col=a_col, a_rank=a_rank, a_weight=a_weight,
+            a_host=a_host,
+            sp_col=sp_col, sp_weight=sp_weight, sp_targeted=sp_targeted,
+            sp_desired=sp_desired, sp_implicit=sp_implicit,
+            sp_used0=sp_used0,
+            dev_cap=template.dev_cap, dev_used0=template.dev_used0,
+            dev_ask=dev_ask,
+            p_ask=p_ask, n_place=len(p_ask_list),
+            rank_columns=rank_columns, attr_targets=template.attr_targets,
+            constraint_labels=constraint_labels,
+            class_ids=template.class_ids, dc_ids=template.dc_ids,
+            dev_pattern_ids=template.dev_pattern_ids,
         )
 
     def _class_masked(self, nodes: Sequence[Node], c: Constraint) -> np.ndarray:

@@ -67,8 +67,11 @@ def test_parse_register_and_status_via_http(agent):
     assert evs and evs[0]["job_id"] == "httpd"
     ev = api.evaluations.info(resp["eval_id"])
     assert ev["status"] == "complete"
-    summary = api.jobs.summary("httpd")
-    assert summary["summary"]["web"]["running"] == 2
+    # the summary read races the client-status writes above (two separate
+    # HTTP round-trips) — wait rather than assert a single snapshot
+    assert wait_until(
+        lambda: api.jobs.summary("httpd")["summary"]["web"]["running"] == 2,
+        timeout=10)
 
 
 def test_blocking_query_fires_on_change(agent):
