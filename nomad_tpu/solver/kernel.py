@@ -50,7 +50,11 @@ from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT, OP_NE,
 
 TOP_K = 4
 WAVE_K = 32       # min per-group wave width; scales up with batch size
+MAX_WAVES = 12    # static wave budget per solve (see scan note below)
 NEG_INF = -1e30
+# test hook: force the sort-based conflict path at small K (read at
+# trace time; tests clear jit caches after flipping it)
+_FORCE_SORT_CONFLICTS = False
 
 
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
@@ -86,6 +90,9 @@ class SolveResult(NamedTuple):
     cons_filtered: jnp.ndarray  # [G, C] nodes filtered per constraint slot
     used_final: jnp.ndarray    # [N, R] resource usage after all commits
     dev_used_final: jnp.ndarray  # [N, D] device usage after all commits
+    n_waves: jnp.ndarray       # [] wave-loop iterations that did work
+    unfinished: jnp.ndarray    # [K] active but undecided after MAX_WAVES
+    #  (rare; absorbed by the blocked-eval retry path)
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -94,8 +101,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  penalty,
                  c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
                  sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
-                 sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place
-                 ) -> SolveResult:
+                 sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place,
+                 seed=0) -> SolveResult:
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
     S = sp_col.shape[1]
@@ -104,7 +111,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # wider waves for bigger batches: a group may commit up to W
     # placements per wave, so a K-placement batch converges in O(K / W)
     # fused-wave iterations
-    TK = min(max(WAVE_K, K // 8) + TOP_K, Np)
+    # cap the wave width: top_k cost grows with k, and per-group counts
+    # rarely exceed a few hundred
+    TK = min(max(WAVE_K, min(K // 8, 256)) + TOP_K, Np)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)
@@ -122,7 +131,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         filtered = first_fail.sum(axis=0)                  # [C]
         return base & ok.all(axis=1), filtered
 
-    feas, cons_filtered = lax.map(per_ask_feas, gs)
+    # vmap, not lax.map: map would serialize Gp dispatch rounds; the
+    # batched [Gp, Np, C] intermediates are small
+    feas, cons_filtered = jax.vmap(per_ask_feas)(gs)
 
     # affinity matches are also placement-invariant: [Gp, Np]
     def per_ask_aff(g):
@@ -130,9 +141,24 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         match = _op_eval(vals, a_op[g], a_rank[g])
         return (match * a_weight[g][None, :]).sum(axis=1)  # [Np]
 
-    aff_score = lax.map(per_ask_aff, gs) + a_host
+    aff_score = jax.vmap(per_ask_aff)(gs) + a_host
     pen_score = jnp.where(penalty, -1.0, 0.0)              # rank.go:532
     pen_counts = penalty
+
+    # tie-break jitter: the reference visits nodes in per-worker shuffled
+    # order (stack.go NewRandomIterator), so equal-scoring nodes resolve
+    # differently per worker. seed=0 keeps exact deterministic scoring;
+    # seed != 0 decorrelates both sibling batches (resident.solve_parallel
+    # passes distinct seeds) and sibling GROUPS within a batch, fanning
+    # same-shaped asks across equal-scoring nodes instead of colliding on
+    # one argmax — fewer contention waves for identical placements.
+    h = (jnp.arange(Np, dtype=jnp.uint32)[None, :] * jnp.uint32(2654435761)
+         + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
+            + jnp.uint32(seed)) * jnp.uint32(40503))
+    h = (h ^ (h >> 16)) * jnp.uint32(2246822519)
+    jitter = jnp.where(jnp.int32(seed) == 0, 0.0,
+                       (h & jnp.uint32(1023)).astype(jnp.float32)
+                       * (1e-6 / 1023.0))                  # [Gp, Np]
 
     def group_scores(used, dev_used, coll, sp_used, blocked):
         """Batched scoring of every (group, node) pair against current
@@ -199,7 +225,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             contrib = jnp.where(sp_targeted[:, s][:, None], targeted, even)
             return jnp.where(has[:, None], contrib, 0.0)
 
-        sp_scores = lax.map(one_spread, jnp.arange(S))     # [S, Gp, Np]
+        sp_scores = jax.vmap(one_spread)(jnp.arange(S))    # [S, Gp, Np]
         spread_total = sp_scores.sum(axis=0)
         spread_counts = spread_total != 0.0
 
@@ -208,24 +234,45 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         n_scorers = (1.0 + anti_counts + pen_counts + aff_counts
                      + spread_counts)
         total = (binpack + anti + pen_score + aff_score
-                 + spread_total) / n_scorers
+                 + spread_total) / n_scorers + jitter
         score = jnp.where(placeable, total, NEG_INF)
         return score, placeable, feas_b, fit, fit_dims, dev_fit
 
     # ---------- wave loop ----------
-    def cond(st):
-        (_, _, _, _, _, done, _, _, _, _, _, _, wave) = st
-        return ((~done & (ks < n_place)).any()) & (wave < K + 1)
-
+    # The carry is kept COMPACT (per-placement vectors, no [Gp, Np]
+    # matrices): tunneled transports copy the whole carry every
+    # iteration, so collocation counts and distinct-hosts blocking are
+    # rebuilt each wave from the committed outputs with one scatter
+    # instead of being carried.
     def body(st):
-        (used, dev_used, coll, sp_used, blocked, done,
+        (used, dev_used, sp_used, done,
          out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
          wave) = st
         active = ~done & (ks < n_place)
+        g_idx = p_ask
+
+        committed = done & out_ok[:, 0]
+        chosen = jnp.where(committed, out_idx[:, 0], 0)
+        coll = coll0.at[g_idx, chosen].add(
+            committed.astype(jnp.float32))
+        dg_all = distinct[g_idx]
+        hit = jnp.zeros((Gp, Np), jnp.int32).at[
+            jnp.maximum(dg_all, 0), chosen].add(
+            (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
+        blocked = hit[jnp.maximum(distinct, 0)] & (distinct >= 0)[:, None]
 
         score, placeable, feas_b, fit, fit_dims, dev_fit = group_scores(
             used, dev_used, coll, sp_used, blocked)
-        top_score, top_idx = lax.top_k(score, TK)          # [Gp, TK]
+        # full sort-based top_k dominates wave cost at scale; TPU's
+        # approx_max_k (recall ~0.95 over near-tied scores) is the
+        # hardware-native candidate search — the solve still scores every
+        # node, only the top-W *extraction* is approximate, a far smaller
+        # perturbation than the reference's 14-node subsample. Small
+        # problems (tests, dryruns) keep the exact path.
+        if Np >= 4096:
+            top_score, top_idx = lax.approx_max_k(score, TK)
+        else:
+            top_score, top_idx = lax.top_k(score, TK)      # [Gp, TK]
         grp_any = placeable.any(axis=1)                    # [Gp]
 
         # metrics snapshot for placements finishing this wave
@@ -234,46 +281,99 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
                      & ~fit_dims).sum(axis=1)              # [Gp, R]
 
-        # rank each active placement within its group; the r-th remaining
-        # placement is assigned the group's r-th best node this wave
-        g_idx = p_ask
+        # rank each active placement within its group, then assign the
+        # r-th remaining placement the group's (r mod M)-th best node,
+        # where M is the group's real candidate count this wave: ranks
+        # beyond the candidate list WRAP onto it, so every active
+        # placement gets a candidate every wave and per-node cumulative
+        # fit commits as many as capacity allows — a count >> W group
+        # converges in a couple of waves instead of count/W
         grp_onehot = ((g_idx[None, :] == gs[:, None])
                       & active[None, :]).astype(jnp.int32)  # [Gp, K]
+        act_g = grp_onehot.sum(axis=1)                     # [Gp]
         rank = (jnp.cumsum(grp_onehot, axis=1)
                 - grp_onehot)[g_idx, ks]                   # exclusive count
-        in_wave = active & (rank < W)
-        cr = jnp.minimum(rank, W - 1)
+        n_cand = (top_score > NEG_INF / 2).sum(axis=1)     # [Gp] real slots
+        M = jnp.clip(jnp.minimum(n_cand, W), 1, W)
+        cr = rank % M[g_idx]
         cand = top_idx[g_idx, cr]                          # [K]
         cand_score = top_score[g_idx, cr]
-        cand_ok = in_wave & (cand_score > NEG_INF / 2)
+        cand_ok = active & (cand_score > NEG_INF / 2)
 
         # a group with nothing placeable fails all its remaining placements
         fail_now = active & ~grp_any[g_idx]
 
-        # -- cross-group conflict checks over shared nodes --
-        earlier = ks[None, :] < ks[:, None]                # [K, K]
-        both_ok = cand_ok[None, :] & cand_ok[:, None]
-        same_node = (cand[None, :] == cand[:, None]) & both_ok & earlier
+        # -- same-wave conflict checks over shared nodes --
+        # prior_rank(key)[p] = #earlier candidates with the same key;
+        # prior_sum(key, v)[p] = sum of v over them. Small K uses [K, K]
+        # masks (matmul on the MXU); large K uses sort-based segmented
+        # prefix sums, O(K log K) — identical results.
+        if K <= 2048 and not _FORCE_SORT_CONFLICTS:
+            earlier = ks[None, :] < ks[:, None]            # [K, K]
+            both_ok = cand_ok[None, :] & cand_ok[:, None]
+            same_node = ((cand[None, :] == cand[:, None])
+                         & both_ok & earlier)
+
+            def prior_sum_node(vals):
+                return same_node.astype(jnp.float32) @ vals
+
+            def prior_rank(key, member):
+                m = member & cand_ok
+                same = ((key[None, :] == key[:, None])
+                        & m[None, :] & m[:, None] & earlier)
+                return same.sum(axis=1)
+        else:
+            def _seg(key):
+                """Sort (key, idx); return per-element exclusive segment
+                rank and a segmented exclusive-prefix summer."""
+                keyc = jnp.where(cand_ok, key, jnp.int32(0x7FFFFFF0))
+                s_key, s_ix = lax.sort((keyc, ks), num_keys=2)
+                pos = ks
+                is_start = jnp.concatenate(
+                    [jnp.ones(1, bool), s_key[1:] != s_key[:-1]])
+                start_pos = lax.cummax(jnp.where(is_start, pos, 0))
+
+                def summer(vals):
+                    v = vals[s_ix]
+                    cum = jnp.cumsum(v, axis=0) - v        # exclusive
+                    prior_sorted = cum - cum[start_pos]
+                    return jnp.zeros_like(vals).at[s_ix].set(prior_sorted)
+
+                rank = jnp.zeros(K, jnp.int32).at[s_ix].set(
+                    (pos - start_pos).astype(jnp.int32))
+                return rank, summer
+
+            _, prior_sum_node = _seg(cand)
+
+            def prior_rank(key, member):
+                # exclusive count of earlier ok members with equal key;
+                # non-members get a key outside every real segment
+                keyc = jnp.where(member, key, jnp.int32(0x3FFFFFF0))
+                rank, _ = _seg(keyc)
+                return jnp.where(member, rank, 0)
+
         res_k = ask_res[g_idx] * cand_ok[:, None]
         dev_k = dev_ask[g_idx] * cand_ok[:, None]
-        prior = same_node.astype(jnp.float32) @ res_k      # [K, R]
-        prior_dev = same_node.astype(jnp.float32) @ dev_k  # [K, D]
+        prior = prior_sum_node(res_k)                      # [K, R]
+        prior_dev = prior_sum_node(dev_k)                  # [K, D]
         fits = ((used[cand] + prior + ask_res[g_idx])
                 <= avail[cand]).all(axis=-1)
         dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
                     <= dev_cap[cand]).all(axis=-1)
 
         # distinct_hosts: one commit per (node, distinct group) per wave;
-        # cross-wave blocking below keeps later waves off the node too
+        # cross-wave blocking keeps later waves off the node too
         dg = distinct[g_idx]
-        same_dg = same_node & (dg[None, :] == dg[:, None]) & (dg[:, None] >= 0)
-        dg_ok = ~same_dg.any(axis=1)
+        dg_key = cand * jnp.int32(Gp) + jnp.maximum(dg, 0)
+        dg_ok = prior_rank(dg_key, dg >= 0) == 0
 
-        # spread quota: cap same-wave commits per (group, slot, value) so a
-        # wave cannot overfill a spread target the serial reference would
-        # have steered away from (S is a small static pad; unrolled)
-        same_g = both_ok & earlier & (g_idx[None, :] == g_idx[:, None])
+        # spread quota: cap same-wave commits per (group, slot, value) so
+        # a wave cannot blow far past a spread target the serial
+        # reference would have steered away from; targeted spreads stop
+        # at their desired counts, even spreads at a balanced level
+        # (S is a small static pad; unrolled)
         sp_ok = jnp.ones(K, bool)
+        V = sp_desired.shape[2]
         for s in range(S):
             cols = sp_col[g_idx, s]
             vs = attr_rank[cand, jnp.maximum(cols, 0)]
@@ -286,26 +386,34 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             present = use_s > 0
             maxc = jnp.max(jnp.where(present, use_s, 0.0),
                            axis=1)[:, None]
-            quota = jnp.where(sp_targeted[:, s][:, None],
-                              jnp.maximum(1.0, des_eff - use_s),
-                              jnp.maximum(1.0, maxc - use_s))  # [Gp, V]
-            same_gv = (same_g & (vs[None, :] == vs[:, None])
-                       & has_s[:, None] & has_s[None, :])
-            gv_rank = same_gv.sum(axis=1).astype(jnp.float32)
+            minc = jnp.min(jnp.where(present, use_s,
+                                     jnp.where(present.any(axis=1)[:, None],
+                                               jnp.inf, 0.0)),
+                           axis=1)[:, None]
+            minc = jnp.where(jnp.isfinite(minc), minc, 0.0)
+            # even spread: every value may grow to a common level L =
+            # max(current max, min + fair share of this wave's active
+            # placements). A balanced group fills all values in one
+            # wave; per-wave imbalance is bounded by the share and
+            # corrected by the next wave's rescoring (the serial
+            # reference corrects per placement instead).
+            share = jnp.ceil(act_g.astype(jnp.float32) / V)[:, None]
+            level = jnp.maximum(maxc, minc + share)
+            quota = jnp.where(
+                sp_targeted[:, s][:, None],
+                jnp.maximum(1.0, des_eff - use_s),
+                jnp.maximum(1.0, level - use_s))           # [Gp, V]
+            gv_key = (g_idx * jnp.int32(V) + vsc) * jnp.int32(2) + 1
+            gv_rank = prior_rank(gv_key, has_s).astype(jnp.float32)
             sp_ok &= ~has_s | (gv_rank < quota[g_idx, vsc])
 
         commit = cand_ok & fits & dev_fits & dg_ok & sp_ok
         cm = commit[:, None]
 
-        # -- apply all of this wave's commits at once --
+        # -- apply all of this wave's commits at once (coll/blocked are
+        # rebuilt from the outputs next wave, not carried) --
         used = used.at[cand].add(ask_res[g_idx] * cm)
         dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
-        coll = coll.at[g_idx, cand].add(commit.astype(jnp.float32))
-        hit = jnp.zeros((Gp, Np), jnp.int32).at[
-            jnp.maximum(dg, 0), cand].add(
-            (commit & (dg >= 0)).astype(jnp.int32)) > 0
-        blocked = blocked | (hit[jnp.maximum(distinct, 0)]
-                             & (distinct >= 0)[:, None])
         svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
         okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm
         sp_used = sp_used.at[g_idx[:, None], jnp.arange(S)[None, :],
@@ -327,12 +435,23 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         out_nexh = jnp.where(newly, n_exh_g[g_idx], out_nexh)
         out_dimexh = jnp.where(newly[:, None], dim_exh_g[g_idx], out_dimexh)
         done = done | newly
-        return (used, dev_used, coll, sp_used, blocked, done,
+        return (used, dev_used, sp_used, done,
                 out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
-                wave + 1)
+                wave + jnp.int32(1))
 
-    st0 = (used0, dev_used0, coll0, sp_used0,
-           jnp.zeros((Gp, Np), bool),
+    # Fixed-trip scan, not while_loop: a data-dependent loop condition
+    # forces a host sync per iteration on tunneled transports (tens of
+    # ms each), while a static-length scan is one uninterrupted device
+    # program. Drained waves skip the body through lax.cond, costing
+    # only the (compact) carry. The rank-wrap commit above converges
+    # real batches in a handful of waves; anything still unfinished
+    # after MAX_WAVES is reported in `unfinished` and flows into the
+    # system's blocked-eval retry path.
+    def body_scan(st, _):
+        any_active = (~st[3] & (ks < n_place)).any()
+        return lax.cond(any_active, body, lambda s: s, st), None
+
+    st0 = (used0, dev_used0, sp_used0,
            jnp.zeros(K, bool),
            jnp.zeros((K, TOP_K), jnp.int32),
            jnp.zeros((K, TOP_K), bool),
@@ -341,11 +460,14 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
            jnp.zeros(K, jnp.int32),
            jnp.zeros((K, R), jnp.int32),
            jnp.int32(0))
-    (used_final, dev_used_final, _, _, _, _, out_idx, out_ok, out_score,
-     out_nfeas, out_nexh, out_dimexh, _) = lax.while_loop(cond, body, st0)
+    (st_final, _) = lax.scan(body_scan, st0, None, length=MAX_WAVES)
+    (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
+     out_nfeas, out_nexh, out_dimexh, waves) = st_final
+    unfinished = ~done & (ks < n_place)
 
     return SolveResult(choice=out_idx, choice_ok=out_ok, score=out_score,
                        n_feasible=out_nfeas, n_exhausted=out_nexh,
                        dim_exhausted=out_dimexh, feas=feas,
                        cons_filtered=cons_filtered, used_final=used_final,
-                       dev_used_final=dev_used_final)
+                       dev_used_final=dev_used_final, n_waves=waves,
+                       unfinished=unfinished)

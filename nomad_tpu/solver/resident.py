@@ -22,8 +22,9 @@ TPU-first design has to restructure the *data flow*, not just the math:
     (reference: nomad/eval_broker.go job-token dedup): one job can never
     appear in two batches of the same stream, and those dimensions never
     cross jobs.  solve_stream enforces that invariant;
-  * fetch ONE packed [B, K, TOP_K, 2] result buffer (node index + score;
-    `ok` is derivable because failed slots score NEG_INF).
+  * fetch ONE packed [B, K, 2*TOP_K+1] result buffer (node indices,
+    scores, and a per-placement STATUS_* outcome; `ok` is derivable
+    because failed slots score NEG_INF).
 
 Falls back to the general Solver path whenever an ask steps outside the
 resident universe (repack_asks returns None).
@@ -41,12 +42,97 @@ from ..structs import Node
 from .kernel import NEG_INF, TOP_K, solve_kernel
 from .tensorize import PackedBatch, PlacementAsk, Tensorizer
 
+# per-placement outcome in the packed result's last column
+STATUS_FAILED = 0      # infeasible / resources exhausted — terminal
+STATUS_COMMITTED = 1   # slot-0 choice committed into carried usage
+STATUS_RETRY = 2       # bounced by revalidation or wave budget — resubmit
+
 # ask-side solve_kernel args stacked per batch (see sharded._ARG_SPECS)
 _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
              "coll0", "penalty", "c_op", "c_col", "c_rank", "a_op", "a_col",
              "a_rank", "a_weight", "a_host", "sp_col", "sp_weight",
              "sp_targeted", "sp_desired", "sp_implicit", "sp_used0",
              "dev_ask", "p_ask")
+
+
+def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
+               used, dev_used, batch, n_place, seed=0):
+    return solve_kernel(
+        avail, reserved, used, valid, node_dc, attr_rank,
+        batch["ask_res"], batch["ask_desired"], batch["distinct"],
+        batch["dc_ok"], batch["host_ok"], batch["coll0"],
+        batch["penalty"], batch["c_op"], batch["c_col"],
+        batch["c_rank"], batch["a_op"], batch["a_col"],
+        batch["a_rank"], batch["a_weight"], batch["a_host"],
+        batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
+        batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
+        dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
+        seed)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
+                     used0, dev_used0, stacked, n_places, seeds):
+    """The TPU recast of the reference's optimistic worker concurrency
+    (nomad/worker.go goroutines + nomad/plan_apply.go serial applier):
+    vmap B batch-solves against ONE shared usage snapshot — each with its
+    own tie-break seed, the analog of per-worker shuffled node order —
+    then revalidate every batch's placements serially against cumulative
+    usage, bouncing whatever no longer fits.  All on device; one round
+    trip for the whole fleet of batches."""
+    res = jax.vmap(
+        lambda b, n, s: _solve_one(avail, reserved, valid, node_dc,
+                                   attr_rank, dev_cap, used0, dev_used0,
+                                   b, n, s))(stacked, n_places, seeds)
+    # res.* have a leading [B] axis; slot-0 choices are the commits
+    K = res.choice.shape[1]
+    ks = jnp.arange(K)
+
+    def apply_batch(carry, xs):
+        used, dev_used = carry
+        choice, ok0, score, unfin, res_k, dev_k, n_place = xs
+        cand = choice[:, 0]
+        ok = ok0[:, 0] & (ks < n_place)
+        # cumulative same-node load within this batch, in placement
+        # order. Conservative one-round revalidation: a bounced
+        # placement's load still counts toward later same-node
+        # placements (exact first-fit would need a per-node serial
+        # walk), so a bounce can cascade — every bounce is reported
+        # STATUS_RETRY, never failed, and clears in the retry stream.
+        earlier = ks[None, :] < ks[:, None]
+        same = (cand[None, :] == cand[:, None]) & ok[None, :] \
+            & ok[:, None] & earlier
+        prior = same.astype(jnp.float32) @ (res_k * ok[:, None])
+        prior_dev = same.astype(jnp.float32) @ (dev_k * ok[:, None])
+        fits = ((used[cand] + prior + res_k) <= avail[cand]).all(-1)
+        dev_fits = ((dev_used[cand] + prior_dev + dev_k)
+                    <= dev_cap[cand]).all(-1)
+        commit = ok & fits & dev_fits
+        cm = commit[:, None]
+        used = used.at[cand].add(res_k * cm)
+        dev_used = dev_used.at[cand].add(dev_k * cm)
+        # bounced placements lose ALL slots (their fall-through scores
+        # were solved against a stale snapshot and were never charged)
+        score = jnp.where(cm, score, NEG_INF)
+        status = jnp.where(commit, STATUS_COMMITTED,
+                           jnp.where(ok | unfin, STATUS_RETRY,
+                                     STATUS_FAILED))
+        packed = jnp.concatenate(
+            [choice.astype(jnp.float32), score,
+             status.astype(jnp.float32)[:, None]], axis=-1)
+        return (used, dev_used), packed
+
+    res_per_p = jnp.take_along_axis(
+        stacked["ask_res"],
+        stacked["p_ask"][:, :, None].astype(jnp.int32), axis=1)  # [B,K,R]
+    dev_per_p = jnp.take_along_axis(
+        stacked["dev_ask"],
+        stacked["p_ask"][:, :, None].astype(jnp.int32), axis=1)  # [B,K,D]
+    (used_f, dev_used_f), out = jax.lax.scan(
+        apply_batch, (used0, dev_used0),
+        (res.choice, res.choice_ok, res.score, res.unfinished,
+         res_per_p, dev_per_p, n_places))
+    return used_f, dev_used_f, out
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -58,18 +144,14 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     def step(carry, xs):
         used, dev_used = carry
         batch, n_place = xs
-        res = solve_kernel(
-            avail, reserved, used, valid, node_dc, attr_rank,
-            batch["ask_res"], batch["ask_desired"], batch["distinct"],
-            batch["dc_ok"], batch["host_ok"], batch["coll0"],
-            batch["penalty"], batch["c_op"], batch["c_col"],
-            batch["c_rank"], batch["a_op"], batch["a_col"],
-            batch["a_rank"], batch["a_weight"], batch["a_host"],
-            batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
-            batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
-            dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place)
-        packed = jnp.stack(
-            [res.choice.astype(jnp.float32), res.score], axis=-1)
+        res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
+                         dev_cap, used, dev_used, batch, n_place)
+        status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
+                           jnp.where(res.unfinished, STATUS_RETRY,
+                                     STATUS_FAILED))
+        packed = jnp.concatenate(
+            [res.choice.astype(jnp.float32), res.score,
+             status.astype(jnp.float32)[:, None]], axis=-1)
         return (res.used_final, res.dev_used_final), packed
 
     (used_f, dev_used_f), out = jax.lax.scan(step, (used0, dev_used0),
@@ -125,14 +207,41 @@ class ResidentSolver:
         """Solve B ask batches in ONE device call.
 
         Returns (choice [B, K, TOP_K] int, ok [B, K, TOP_K] bool,
-        score [B, K, TOP_K] float).  Resource usage carries on device: a
-        later batch sees every earlier batch's placements, and the
-        carried usage persists for the next solve_stream call.
+        score [B, K, TOP_K] float, status [B, K] int — STATUS_*).
+        Resource usage carries on device: a later batch sees every
+        earlier batch's placements, and the carried usage persists for
+        the next solve_stream call.  STATUS_RETRY placements (wave
+        budget ran out) should be resubmitted in a later stream.
 
         A job may appear in at most ONE batch per stream (the broker's
         per-job eval serialization): job-scoped scoring state is seeded
         per batch and does not carry.
         """
+        self._check_stream_jobs(batches)
+        stacked = {
+            name: np.stack([getattr(pb, name) for pb in batches])
+            for name in _ASK_ARGS
+        }
+        n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+        self._used, self._dev_used, out = _stream_kernel(
+            self._dev_node["avail"], self._dev_node["reserved"],
+            self._dev_node["valid"], self._dev_node["node_dc"],
+            self._dev_node["attr_rank"], self._dev_node["dev_cap"],
+            self._used, self._dev_used, stacked, n_places)
+        return self._unpack(out)
+
+    @staticmethod
+    def _unpack(out) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                              np.ndarray]:
+        out = np.asarray(out)                     # ONE fetched buffer
+        choice = out[..., :TOP_K].astype(np.int32)
+        score = out[..., TOP_K:2 * TOP_K]
+        status = out[..., -1].astype(np.int32)
+        ok = score > NEG_INF / 2
+        return choice, ok, score, status
+
+    @staticmethod
+    def _check_stream_jobs(batches: Sequence[PackedBatch]) -> None:
         seen: set = set()
         for pb in batches:
             keys = getattr(pb, "job_keys", None)
@@ -145,21 +254,33 @@ class ResidentSolver:
                         "anti-affinity, spread) would not be visible "
                         "across them")
                 seen |= keys
+
+    def solve_parallel(self, batches: Sequence[PackedBatch]
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+        """Optimistic-parallel variant of solve_stream: all B batches
+        solve concurrently against the CURRENT usage snapshot (each with
+        a distinct tie-break seed), then a serial on-device revalidation
+        pass commits them in order and bounces placements that no longer
+        fit — the reference's worker/plan-applier split, fused into one
+        device call.  Bounced placements come back STATUS_RETRY with all
+        score slots nulled; the caller resubmits them in a later stream.
+        Higher throughput than solve_stream, weaker in-batch visibility
+        (batches don't see each other's scoring state at all, only the
+        revalidation)."""
+        self._check_stream_jobs(batches)
         stacked = {
             name: np.stack([getattr(pb, name) for pb in batches])
             for name in _ASK_ARGS
         }
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
-        self._used, self._dev_used, out = _stream_kernel(
+        seeds = np.arange(1, len(batches) + 1, dtype=np.int32)
+        self._used, self._dev_used, out = _parallel_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
-            self._used, self._dev_used, stacked, n_places)
-        out = np.asarray(out)                     # ONE fetched buffer
-        choice = out[..., 0].astype(np.int32)
-        score = out[..., 1]
-        ok = score > NEG_INF / 2
-        return choice, ok, score
+            self._used, self._dev_used, stacked, n_places, seeds)
+        return self._unpack(out)
 
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch the carried device usage (one sync — call sparingly)."""

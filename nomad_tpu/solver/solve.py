@@ -62,6 +62,7 @@ class Solver:
         dim_exhausted = np.asarray(res.dim_exhausted)
         feas = np.asarray(res.feas)
         cons_filtered = np.asarray(res.cons_filtered)
+        unfinished = np.asarray(res.unfinished)
 
         # host fixup state: per-node port/device accounting incl. in-batch.
         # host_used is the AUTHORITATIVE usage: when a placement falls through
@@ -128,8 +129,14 @@ class Solver:
                                    resources=resources)
                 break
             if placed is None:
-                reason = ("resources exhausted" if n_feasible[p] > 0
-                          else "no feasible nodes")
+                if unfinished[p]:
+                    # the wave budget ran out before this placement was
+                    # decided; the blocked-eval path will retry it
+                    reason = "solve wave budget exhausted (retryable)"
+                elif n_feasible[p] > 0:
+                    reason = "resources exhausted"
+                else:
+                    reason = "no feasible nodes"
                 placed = Placement(ask_index=g, node=None, score=0.0,
                                    metrics=m, failed_reason=reason)
             placements.append(placed)

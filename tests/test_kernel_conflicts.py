@@ -1,0 +1,92 @@
+"""The kernel's two same-wave conflict-resolution implementations
+(O(K^2) masks for small K, sort-based segmented prefix sums for large K)
+must produce identical solves."""
+import numpy as np
+import pytest
+
+import jax
+
+from nomad_tpu import mock
+from nomad_tpu.solver import kernel as KM
+from nomad_tpu.solver.solve import _run_kernel
+from nomad_tpu.solver.tensorize import PlacementAsk, Tensorizer
+from nomad_tpu.structs import Constraint, Spread, SpreadTarget
+
+
+def build_problem():
+    """Contended: few nodes, several groups, distinct_hosts + spread,
+    so every conflict rule (capacity, distinct, quota) fires."""
+    nodes = []
+    for i in range(12):
+        n = mock.node(datacenter=f"dc{i % 3}")
+        n.node_resources.cpu = 2500
+        n.node_resources.memory_mb = 4096
+        n.compute_class()
+        nodes.append(n)
+    asks = []
+    for g in range(4):
+        job = mock.job()
+        job.datacenters = ["dc0", "dc1", "dc2"]
+        tg = job.task_groups[0]
+        tg.count = 6
+        tg.tasks[0].resources.networks = []
+        tg.tasks[0].resources.cpu = 400 + g * 100
+        tg.tasks[0].resources.memory_mb = 256
+        if g == 1:
+            tg.constraints = [Constraint("", "", "distinct_hosts")]
+        if g == 2:
+            job.spreads = [Spread(attribute="${node.datacenter}",
+                                  weight=100)]
+        if g == 3:
+            job.spreads = [Spread(
+                attribute="${node.datacenter}", weight=100,
+                spread_targets=[SpreadTarget("dc0", 50),
+                                SpreadTarget("dc1", 50)])]
+        asks.append(PlacementAsk(job=job, tg=tg, count=6))
+    return nodes, asks
+
+
+@pytest.fixture
+def both_paths():
+    yield
+    KM._FORCE_SORT_CONFLICTS = False
+    jax.clear_caches()
+
+
+def test_sort_conflicts_match_matmul_conflicts(both_paths):
+    nodes, asks = build_problem()
+    pb = Tensorizer().pack(nodes, asks, None)
+
+    KM._FORCE_SORT_CONFLICTS = False
+    jax.clear_caches()
+    r_mm = _run_kernel(pb)
+    mm = (np.asarray(r_mm.choice), np.asarray(r_mm.choice_ok),
+          np.asarray(r_mm.score), np.asarray(r_mm.used_final))
+
+    KM._FORCE_SORT_CONFLICTS = True
+    jax.clear_caches()
+    r_st = _run_kernel(pb)
+    st = (np.asarray(r_st.choice), np.asarray(r_st.choice_ok),
+          np.asarray(r_st.score), np.asarray(r_st.used_final))
+
+    n = pb.n_place
+    np.testing.assert_array_equal(mm[1][:n], st[1][:n])
+    ok = mm[1][:n]
+    np.testing.assert_array_equal(mm[0][:n][ok], st[0][:n][ok])
+    np.testing.assert_allclose(mm[2][:n][ok], st[2][:n][ok], rtol=1e-6)
+    np.testing.assert_allclose(mm[3], st[3], rtol=1e-6)
+
+
+def test_distinct_hosts_respected_under_sort_path(both_paths):
+    KM._FORCE_SORT_CONFLICTS = True
+    jax.clear_caches()
+    nodes, asks = build_problem()
+    pb = Tensorizer().pack(nodes, asks, None)
+    res = _run_kernel(pb)
+    choice = np.asarray(res.choice)[:pb.n_place, 0]
+    ok = np.asarray(res.choice_ok)[:pb.n_place, 0]
+    # group 1 (ask index 1) has distinct_hosts: its committed nodes are
+    # unique
+    g1 = [choice[p] for p in range(pb.n_place)
+          if pb.p_ask[p] == 1 and ok[p]]
+    assert len(g1) == len(set(g1))
