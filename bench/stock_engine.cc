@@ -1,0 +1,516 @@
+// Stock-semantics scheduler engine: the honest benchmark denominator.
+//
+// A faithful C++ implementation of the reference scheduler's placement
+// path (HashiCorp Nomad v0.11), preserving its semantics AND its data
+// layout so the measured cost is representative of the real Go engine:
+//
+//   * string UUIDs / string-keyed hash maps for state (Go: map[string],
+//     memdb radix tables)                      nomad/state/state_store.go
+//   * per-eval stack: shuffled node order      scheduler/stack.go:107
+//   * lazy feasibility iterators, memoized by node computed class
+//                                              scheduler/feasible.go:915
+//   * ranking limited to max(2, ceil(log2 N)) feasible options
+//                                              scheduler/stack.go:80-87
+//   * bin-pack scoring over "proposed" allocs = state + in-plan
+//                                              scheduler/rank.go:441,
+//                                              scheduler/context.go:120
+//   * job anti-affinity / affinity / spread boosts with
+//     append-then-average normalization        scheduler/rank.go:462,577,
+//                                              scheduler/spread.go
+//   * serial plan applier that re-validates every node's capacity before
+//     commit                                    nomad/plan_apply.go:49-70
+//
+// The scenario generator mirrors bench.py's formulas exactly (same
+// node attributes, capacities, jobs); the two engines are fed identical
+// clusters by construction. Single-threaded, per BASELINE.md's
+// denominator plan (the reference Harness drives one scheduler).
+//
+// Usage: stock_engine <config> <n_nodes> <n_evals> <count_per_eval>
+//                     <resident_allocs> [repeat]
+// Prints one JSON line of metrics.
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+using std::string;
+using std::vector;
+
+struct Resources {
+  int64_t cpu = 0, mem = 0, disk = 0, net = 0;
+};
+
+struct Alloc {
+  string id;
+  string job_id;
+  string tg;
+  string node_id;
+  Resources res;
+  int devices = 0;
+};
+
+struct Node {
+  string id;
+  string dc;
+  std::unordered_map<string, string> attrs;
+  Resources cap;
+  string computed_class;
+  int device_cap = 0;  // healthy instances of the single device pattern
+};
+
+struct Constraint {
+  string ltarget, rtarget, op;  // op: "=", "!=", ">=" (lexical)
+};
+struct Affinity {
+  string ltarget, rtarget, op;
+  double weight;
+};
+struct Spread {
+  string attribute;  // even spread when no targets
+  double weight;
+};
+
+struct TaskGroup {
+  string name;
+  int count;
+  Resources res;
+  int devices = 0;
+};
+
+struct Job {
+  string id;
+  vector<string> dcs;
+  vector<Constraint> constraints;
+  vector<Affinity> affinities;
+  vector<Spread> spreads;
+  vector<TaskGroup> groups;
+};
+
+// ---------------- state (the memdb analog) ----------------
+struct State {
+  vector<Node> nodes;
+  std::unordered_map<string, int> node_ix;
+  std::unordered_map<string, vector<Alloc>> allocs_by_node;
+
+  void add_alloc(const Alloc& a) { allocs_by_node[a.node_id].push_back(a); }
+};
+
+// ---------------- scoring (rank.go / structs/funcs.go) ----------------
+static double score_fit(const Node& n, const Resources& util) {
+  if (n.cap.cpu <= 0 || n.cap.mem <= 0) return 0.0;
+  double free_cpu = 1.0 - double(util.cpu) / double(n.cap.cpu);
+  double free_mem = 1.0 - double(util.mem) / double(n.cap.mem);
+  double raw = 20.0 - (std::pow(10.0, free_cpu) + std::pow(10.0, free_mem));
+  if (raw < 0) raw = 0;
+  if (raw > 18) raw = 18;
+  return raw / 18.0;
+}
+
+static bool attr_get(const Node& n, const string& target, string* out) {
+  if (target == "${node.datacenter}") { *out = n.dc; return true; }
+  const string kAttr = "${attr.";
+  if (target.rfind(kAttr, 0) == 0) {
+    auto it = n.attrs.find(target.substr(kAttr.size(),
+                                         target.size() - kAttr.size() - 1));
+    if (it == n.attrs.end()) return false;
+    *out = it->second;
+    return true;
+  }
+  return false;
+}
+
+static bool check_constraint(const Node& n, const Constraint& c) {
+  string v;
+  bool found = attr_get(n, c.ltarget, &v);
+  if (c.op == "=") return found && v == c.rtarget;
+  if (c.op == "!=") return !found || v != c.rtarget;  // feasible.go:671
+  if (c.op == ">=") return found && v >= c.rtarget;   // lexical
+  if (c.op == "<") return found && v < c.rtarget;
+  return false;
+}
+
+// ---------------- the per-eval stack ----------------
+struct EvalMetrics {
+  int64_t feas_checks = 0;
+  int64_t nodes_scored = 0;
+};
+
+struct Placement {
+  int node_ix;
+  Resources res;
+  int devices;
+  string job_id, tg;
+};
+
+class Stack {
+ public:
+  Stack(State* st, std::mt19937* rng) : st_(st), rng_(rng) {
+    order_.resize(st->nodes.size());
+    for (size_t i = 0; i < order_.size(); ++i) order_[i] = int(i);
+  }
+
+  // Per-eval setup: shuffle node order (stack.go NewRandomIterator),
+  // clear the class-memoization cache (EvalCache lifetime = one eval).
+  void set_job(const Job* job) {
+    job_ = job;
+    std::shuffle(order_.begin(), order_.end(), *rng_);
+    class_memo_.clear();
+    spread_used_.clear();
+    limit_ = std::max<int>(
+        2, int(std::ceil(std::log2(double(st_->nodes.size())))));
+  }
+
+  // One placement: walk shuffled nodes, lazily filter, rank the first
+  // `limit_` feasible options, return best (or -1).
+  int select(const TaskGroup& tg,
+             const std::unordered_map<int, vector<Alloc>>& in_plan,
+             EvalMetrics* m) {
+    int best = -1;
+    double best_score = -1e30;
+    int ranked = 0;
+    for (int oi = 0; oi < int(order_.size()) && ranked < limit_; ++oi) {
+      int ni = order_[oi];
+      const Node& n = st_->nodes[ni];
+      if (!dc_ok(n)) continue;
+      if (!feasible(ni, n, m)) continue;
+      if (tg.devices > 0 && !device_fit(ni, n, tg, in_plan)) continue;
+
+      // ---- proposed allocs: state + in-plan (context.go:120) ----
+      Resources util = tg.res;
+      int same_job = 0;
+      auto it = st_->allocs_by_node.find(n.id);
+      if (it != st_->allocs_by_node.end()) {
+        for (const Alloc& a : it->second) {
+          util.cpu += a.res.cpu;
+          util.mem += a.res.mem;
+          util.disk += a.res.disk;
+          util.net += a.res.net;
+          if (a.job_id == job_->id) same_job++;
+        }
+      }
+      auto ip = in_plan.find(ni);
+      if (ip != in_plan.end()) {
+        for (const Alloc& a : ip->second) {
+          util.cpu += a.res.cpu;
+          util.mem += a.res.mem;
+          util.disk += a.res.disk;
+          util.net += a.res.net;
+          if (a.job_id == job_->id) same_job++;
+        }
+      }
+      if (util.cpu > n.cap.cpu || util.mem > n.cap.mem ||
+          util.disk > n.cap.disk || util.net > n.cap.net)
+        continue;  // BinPackIterator drops over-committed nodes
+
+      ranked++;
+      m->nodes_scored++;
+      double total = score_fit(n, util);
+      double n_scorers = 1.0;
+      if (same_job > 0) {  // rank.go:462 job anti-affinity
+        total += -double(same_job + 1) / double(tg.count);
+        n_scorers += 1.0;
+      }
+      double aff = affinity_score(n);
+      if (aff != 0.0) {
+        total += aff;
+        n_scorers += 1.0;
+      }
+      double spr = spread_score(n, tg);
+      if (spr != 0.0) {
+        total += spr;
+        n_scorers += 1.0;
+      }
+      total /= n_scorers;  // rank.go:667
+      if (total > best_score) {
+        best_score = total;
+        best = ni;
+      }
+    }
+    if (best >= 0) spread_commit(st_->nodes[best]);
+    return best;
+  }
+
+ private:
+  bool dc_ok(const Node& n) const {
+    for (const auto& d : job_->dcs)
+      if (d == "*" || d == n.dc) return true;
+    return false;
+  }
+
+  bool feasible(int ni, const Node& n, EvalMetrics* m) {
+    // FeasibilityWrapper: memoize whole-constraint-set verdict by
+    // computed class (feasible.go:915)
+    auto mit = class_memo_.find(n.computed_class);
+    if (mit != class_memo_.end()) return mit->second;
+    m->feas_checks++;
+    bool ok = true;
+    for (const auto& c : job_->constraints)
+      if (!check_constraint(n, c)) {
+        ok = false;
+        break;
+      }
+    class_memo_.emplace(n.computed_class, ok);
+    return ok;
+  }
+
+  bool device_fit(int ni, const Node& n, const TaskGroup& tg,
+                  const std::unordered_map<int, vector<Alloc>>& in_plan) {
+    if (n.device_cap <= 0) return false;
+    int used = 0;
+    auto it = st_->allocs_by_node.find(n.id);
+    if (it != st_->allocs_by_node.end())
+      for (const Alloc& a : it->second) used += a.devices;
+    auto ip = in_plan.find(ni);
+    if (ip != in_plan.end())
+      for (const Alloc& a : ip->second) used += a.devices;
+    return used + tg.devices <= n.device_cap;
+  }
+
+  double affinity_score(const Node& n) const {
+    if (job_->affinities.empty()) return 0.0;
+    double total_w = 0, sum = 0;
+    for (const auto& a : job_->affinities) total_w += std::fabs(a.weight);
+    for (const auto& a : job_->affinities) {
+      Constraint c{a.ltarget, a.rtarget, a.op};
+      if (check_constraint(n, c)) sum += a.weight / total_w;
+    }
+    return sum;
+  }
+
+  double spread_score(const Node& n, const TaskGroup& tg) {
+    if (job_->spreads.empty()) return 0.0;
+    double sum_w = 0;
+    for (const auto& s : job_->spreads) sum_w += s.weight;
+    double boost = 0;
+    for (const auto& s : job_->spreads) {
+      string v;
+      if (!attr_get(n, s.attribute, &v)) continue;
+      auto& used = spread_used_[s.attribute];
+      double cur = used.count(v) ? used[v] : 0.0;
+      // even spread (spread.go evenSpreadScoreBoost): compare this
+      // value's count against the current min/max
+      double minc = 1e30, maxc = -1e30;
+      bool any = false;
+      for (auto& kv : used) {
+        if (kv.second > 0) {
+          any = true;
+          minc = std::min(minc, kv.second);
+          maxc = std::max(maxc, kv.second);
+        }
+      }
+      double contrib;
+      if (!any)
+        contrib = 0.0;
+      else if (cur != minc)
+        contrib = (minc - cur) / std::max(minc, 1e-9);
+      else if (minc == maxc)
+        contrib = -1.0;
+      else
+        contrib = (maxc - minc) / std::max(minc, 1e-9);
+      (void)sum_w;
+      boost += contrib;
+    }
+    return boost;
+  }
+
+  void spread_commit(const Node& n) {
+    for (const auto& s : job_->spreads) {
+      string v;
+      if (attr_get(n, s.attribute, &v)) spread_used_[s.attribute][v] += 1.0;
+    }
+  }
+
+  State* st_;
+  std::mt19937* rng_;
+  const Job* job_ = nullptr;
+  vector<int> order_;
+  int limit_ = 2;
+  std::unordered_map<string, bool> class_memo_;
+  std::unordered_map<string, std::unordered_map<string, double>>
+      spread_used_;
+};
+
+// ---------------- plan applier (nomad/plan_apply.go) ----------------
+// Serial: re-validate every touched node's capacity against committed
+// state (the leader's single-threaded protection against optimistic
+// worker races), then commit.
+static bool apply_plan(State* st, const vector<Placement>& plan) {
+  for (const auto& p : plan) {
+    const Node& n = st->nodes[p.node_ix];
+    Resources util = p.res;
+    auto it = st->allocs_by_node.find(n.id);
+    if (it != st->allocs_by_node.end())
+      for (const Alloc& a : it->second) {
+        util.cpu += a.res.cpu;
+        util.mem += a.res.mem;
+        util.disk += a.res.disk;
+        util.net += a.res.net;
+      }
+    if (util.cpu > n.cap.cpu || util.mem > n.cap.mem) return false;
+  }
+  static int64_t seq = 0;
+  for (const auto& p : plan) {
+    Alloc a;
+    a.id = "alloc-" + std::to_string(seq++);
+    a.job_id = p.job_id;
+    a.tg = p.tg;
+    a.node_id = st->nodes[p.node_ix].id;
+    a.res = p.res;
+    a.devices = p.devices;
+    st->add_alloc(a);
+  }
+  return true;
+}
+
+// ---------------- scenario generator (mirrors bench.py) ----------------
+static State make_cluster(int n_nodes, int resident, bool devices) {
+  State st;
+  st.nodes.resize(n_nodes);
+  for (int i = 0; i < n_nodes; ++i) {
+    Node& n = st.nodes[i];
+    n.id = "node-" + std::to_string(i);
+    n.dc = "dc" + std::to_string(i % 4);
+    n.attrs["kernel.name"] = "linux";
+    n.attrs["rack"] = "r" + std::to_string(i % 64);
+    n.attrs["zone"] = "z" + std::to_string(i % 16);
+    n.cap.cpu = 4000 + (i % 8) * 1000;
+    n.cap.mem = 8192 + (i % 4) * 4096;
+    n.cap.disk = 100000;
+    n.cap.net = 1000;
+    if (devices && i % 4 == 0) n.device_cap = 4;
+    // computed class = everything non-unique (node.go ComputedClass)
+    n.computed_class = n.dc + "|" + n.attrs["rack"] + "|" + n.attrs["zone"] +
+                       "|" + std::to_string(n.cap.cpu) + "|" +
+                       std::to_string(n.cap.mem) + "|" +
+                       std::to_string(n.device_cap);
+    st.node_ix[n.id] = i;
+  }
+  for (int i = 0; i < resident; ++i) {
+    Alloc a;
+    a.id = "resident-" + std::to_string(i);
+    a.job_id = "resident-job-" + std::to_string(i % 97);
+    a.tg = "g";
+    a.node_id = st.nodes[i % n_nodes].id;
+    a.res = {200, 256, 300, 0};
+    st.add_alloc(a);
+  }
+  return st;
+}
+
+static Job make_job(int config, int eval_ix, int count) {
+  Job j;
+  j.id = "job-" + std::to_string(eval_ix);
+  j.dcs = {"dc0", "dc1", "dc2", "dc3"};
+  if (config == 1) {
+    // 10 task groups, count/10 each
+    for (int g = 0; g < 10; ++g)
+      j.groups.push_back(
+          {"g" + std::to_string(g), std::max(1, count / 10),
+           {400 + (g % 4) * 150, 256 + (g % 4) * 128, 300, 0}, 0});
+    j.constraints.push_back({"${attr.kernel.name}", "linux", "="});
+    return j;
+  }
+  if (config == 3) {
+    j.constraints.push_back({"${attr.rack}", "r63", "!="});
+    j.constraints.push_back({"${attr.zone}", "z1", ">="});  // lexical
+    j.affinities.push_back({"${attr.rack}", "r7", "=", 35.0});
+    j.spreads.push_back({"${node.datacenter}", 50.0});
+  }
+  int g_res = (config == 3) ? 4 : 1;
+  for (int g = 0; g < g_res; ++g)
+    j.groups.push_back({"g" + std::to_string(g), count / g_res,
+                        {400 + (g % 4) * 150, 256 + (g % 4) * 128, 300, 0},
+                        (config == 4) ? 1 : 0});
+  return j;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    std::fprintf(stderr,
+                 "usage: %s <config 1-5> <n_nodes> <n_evals> "
+                 "<count_per_eval> <resident> [repeat]\n",
+                 argv[0]);
+    return 2;
+  }
+  int config = std::atoi(argv[1]);
+  int n_nodes = std::atoi(argv[2]);
+  int n_evals = std::atoi(argv[3]);
+  int count = std::atoi(argv[4]);
+  int resident = std::atoi(argv[5]);
+  int regions = (config == 5) ? 4 : 1;
+
+  std::mt19937 rng(42);
+  vector<State> states;
+  for (int r = 0; r < regions; ++r)
+    states.push_back(make_cluster(n_nodes, resident, config == 4));
+
+  vector<double> lat_ms;
+  lat_ms.reserve(size_t(n_evals) * regions);
+  int64_t placed = 0, failed = 0;
+  EvalMetrics em;
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int r = 0; r < regions; ++r) {
+    State& st = states[r];
+    Stack stack(&st, &rng);
+    for (int e = 0; e < n_evals; ++e) {
+      auto e0 = std::chrono::steady_clock::now();
+      Job job = make_job(config, e + r * n_evals, count);
+      stack.set_job(&job);
+      std::unordered_map<int, vector<Alloc>> in_plan;
+      vector<Placement> plan;
+      plan.reserve(count);
+      for (const auto& tg : job.groups) {
+        for (int c = 0; c < tg.count; ++c) {
+          int ni = stack.select(tg, in_plan, &em);
+          if (ni < 0) {
+            failed++;
+            continue;
+          }
+          Alloc a;
+          a.job_id = job.id;
+          a.tg = tg.name;
+          a.res = tg.res;
+          a.devices = tg.devices;
+          in_plan[ni].push_back(a);
+          plan.push_back({ni, tg.res, tg.devices, job.id, tg.name});
+        }
+      }
+      apply_plan(&st, plan);
+      placed += int64_t(plan.size());
+      auto e1 = std::chrono::steady_clock::now();
+      lat_ms.push_back(
+          std::chrono::duration<double, std::milli>(e1 - e0).count());
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double elapsed = std::chrono::duration<double>(t1 - t0).count();
+
+  std::sort(lat_ms.begin(), lat_ms.end());
+  auto pct = [&](double p) {
+    if (lat_ms.empty()) return 0.0;
+    size_t ix = size_t(p * double(lat_ms.size() - 1));
+    return lat_ms[ix];
+  };
+  int64_t total_evals = int64_t(n_evals) * regions;
+  std::printf(
+      "{\"engine\": \"stock-cc\", \"config\": %d, \"evals\": %lld, "
+      "\"placements\": %lld, \"failed\": %lld, \"elapsed_s\": %.4f, "
+      "\"evals_per_sec\": %.1f, \"placements_per_sec\": %.1f, "
+      "\"p50_ms\": %.3f, \"p99_ms\": %.3f, "
+      "\"feas_checks_per_eval\": %.1f, \"nodes_scored_per_placement\": "
+      "%.2f}\n",
+      config, (long long)total_evals, (long long)placed, (long long)failed,
+      elapsed, double(total_evals) / elapsed, double(placed) / elapsed,
+      pct(0.5), pct(0.99), double(em.feas_checks) / double(total_evals),
+      placed ? double(em.nodes_scored) / double(placed) : 0.0);
+  return 0;
+}

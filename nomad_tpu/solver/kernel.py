@@ -55,6 +55,8 @@ NEG_INF = -1e30
 # test hook: force the sort-based conflict path at small K (read at
 # trace time; tests clear jit caches after flipping it)
 _FORCE_SORT_CONFLICTS = False
+# node count from which top-k extraction switches to approx_max_k
+_APPROX_MIN_NP = 4096
 
 
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
@@ -95,14 +97,14 @@ class SolveResult(NamedTuple):
     #  (rare; absorbed by the blocked-eval retry path)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("has_spread",))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
                  c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
                  sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
                  sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place,
-                 seed=0) -> SolveResult:
+                 seed=0, *, has_spread=True) -> SolveResult:
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
     S = sp_col.shape[1]
@@ -269,10 +271,59 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # node, only the top-W *extraction* is approximate, a far smaller
         # perturbation than the reference's 14-node subsample. Small
         # problems (tests, dryruns) keep the exact path.
-        if Np >= 4096:
+        if Np >= _APPROX_MIN_NP:
             top_score, top_idx = lax.approx_max_k(score, TK)
         else:
             top_score, top_idx = lax.top_k(score, TK)      # [Gp, TK]
+
+        # spread-aware candidate interleaving (slot 0): when node
+        # classes correlate with the spread attribute (racks live in one
+        # dc, zones in one region — the common cluster layout), a
+        # group's global top-W concentrates in ONE value and the spread
+        # quota strands all but a few commits per wave. Instead, build a
+        # per-value top list and interleave (slot j -> value j mod V),
+        # so a group's candidates arrive pre-balanced across values;
+        # holes (exhausted values) compact to the tail to keep the
+        # rank-wrap contiguous. Skipped for huge vocabularies where
+        # per-value extraction would dominate.
+        Vs = sp_desired.shape[2]
+        if has_spread and Vs <= 8:
+            has0 = sp_col[:, 0] >= 0                       # [Gp]
+            col0g = jnp.maximum(sp_col[:, 0], 0)
+            vnode = jnp.take(attr_rank, col0g, axis=1).T   # [Gp, Np]
+            # one class per value PLUS a class for nodes MISSING the
+            # spread attribute — the reference still places on those
+            # with a -1 score penalty (spread.go), so they must stay
+            # candidates or feasible nodes would livelock unplaced
+            TKv = -(-TK // (Vs + 1))
+            tabs_i, tabs_s = [], []
+            for v in range(Vs + 1):
+                vmask = (vnode == v) if v < Vs else (vnode < 0)
+                sv = jnp.where(vmask, score, NEG_INF)
+                if Np >= _APPROX_MIN_NP:
+                    ts, ti = lax.approx_max_k(sv, TKv)
+                else:
+                    ts, ti = lax.top_k(sv, TKv)
+                tabs_i.append(ti)
+                tabs_s.append(ts)
+            tab_i = jnp.stack(tabs_i, axis=1)              # [Gp, V+1, TKv]
+            tab_s = jnp.stack(tabs_s, axis=1)
+            # visit values in each group's preference order (best head
+            # candidate first), so the first interleaved slot — where a
+            # lone remaining placement always lands — is the value the
+            # spread scoring actually favors this wave
+            vord = jnp.argsort(-tab_s[:, :, 0], axis=1)    # [Gp, V+1]
+            j = jnp.arange(TK)
+            vj = vord[:, j % (Vs + 1)]                     # [Gp, TK]
+            inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            order = jnp.argsort((inter_s <= NEG_INF / 2)
+                                .astype(jnp.int32), axis=1, stable=True)
+            inter_i = jnp.take_along_axis(inter_i, order, axis=1)
+            inter_s = jnp.take_along_axis(inter_s, order, axis=1)
+            top_idx = jnp.where(has0[:, None], inter_i, top_idx)
+            top_score = jnp.where(has0[:, None], inter_s, top_score)
+
         grp_any = placeable.any(axis=1)                    # [Gp]
 
         # metrics snapshot for placements finishing this wave
@@ -295,7 +346,20 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 - grp_onehot)[g_idx, ks]                   # exclusive count
         n_cand = (top_score > NEG_INF / 2).sum(axis=1)     # [Gp] real slots
         M = jnp.clip(jnp.minimum(n_cand, W), 1, W)
-        cr = rank % M[g_idx]
+        # seeded per-group offset into the candidate window: without it,
+        # every group's placements sit on slots 0..act-1 and all groups
+        # hammer the same few top-scoring (often score-tied) nodes, so
+        # per-wave commits are capped by that narrow pool's capacity.
+        # Offsetting disperses groups across the whole top-W window —
+        # candidates stay within the best W of N nodes (vs the
+        # reference's random max(2, log2 N) subsample). seed=0 keeps the
+        # exact deterministic mapping.
+        g_hash = ((gs.astype(jnp.uint32) * jnp.uint32(2654435761))
+                  ^ (jnp.uint32(seed) * jnp.uint32(2246822519)))
+        g_off = jnp.where(jnp.int32(seed) == 0, 0,
+                          ((g_hash >> 8) % jnp.uint32(W)).astype(
+                              jnp.int32))                  # [Gp]
+        cr = (rank + g_off[g_idx]) % M[g_idx]
         cand = top_idx[g_idx, cr]                          # [K]
         cand_score = top_score[g_idx, cr]
         cand_ok = active & (cand_score > NEG_INF / 2)

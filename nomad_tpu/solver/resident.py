@@ -56,7 +56,7 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 
 
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
-               used, dev_used, batch, n_place, seed=0):
+               used, dev_used, batch, n_place, seed=0, has_spread=True):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -67,12 +67,13 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
         batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
         dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
-        seed)
+        seed, has_spread=has_spread)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("has_spread",))
 def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
-                     used0, dev_used0, stacked, n_places, seeds):
+                     used0, dev_used0, stacked, n_places, seeds,
+                     has_spread=True):
     """The TPU recast of the reference's optimistic worker concurrency
     (nomad/worker.go goroutines + nomad/plan_apply.go serial applier):
     vmap B batch-solves against ONE shared usage snapshot — each with its
@@ -83,7 +84,8 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     res = jax.vmap(
         lambda b, n, s: _solve_one(avail, reserved, valid, node_dc,
                                    attr_rank, dev_cap, used0, dev_used0,
-                                   b, n, s))(stacked, n_places, seeds)
+                                   b, n, s, has_spread)
+    )(stacked, n_places, seeds)
     # res.* have a leading [B] axis; slot-0 choices are the commits
     K = res.choice.shape[1]
     ks = jnp.arange(K)
@@ -135,17 +137,19 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     return used_f, dev_used_f, out
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("has_spread",))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
-                   used0, dev_used0, stacked, n_places):
+                   used0, dev_used0, stacked, n_places, seeds,
+                   has_spread=True):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device."""
 
     def step(carry, xs):
         used, dev_used = carry
-        batch, n_place = xs
+        batch, n_place, seed = xs
         res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
-                         dev_cap, used, dev_used, batch, n_place)
+                         dev_cap, used, dev_used, batch, n_place, seed,
+                         has_spread)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -154,8 +158,8 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
              status.astype(jnp.float32)[:, None]], axis=-1)
         return (res.used_final, res.dev_used_final), packed
 
-    (used_f, dev_used_f), out = jax.lax.scan(step, (used0, dev_used0),
-                                             (stacked, n_places))
+    (used_f, dev_used_f), out = jax.lax.scan(
+        step, (used0, dev_used0), (stacked, n_places, seeds))
     return used_f, dev_used_f, out
 
 
@@ -191,6 +195,14 @@ class ResidentSolver:
         }
         self._used = jax.device_put(t.used0)
         self._dev_used = jax.device_put(t.dev_used0)
+        # device-resident constants for the [G, N] ask-side arrays that
+        # are usually all-zero (fresh jobs) or at their universe default
+        # (host_ok): shipping them dense per call costs ~100MB/s-class
+        # transports far more than the solve itself
+        self._const_cache: Dict[Tuple[str, int], object] = {}
+        self._default_host_ok = np.zeros((self.gp, t.avail.shape[0]),
+                                         bool)
+        self._default_host_ok[:, :t.n_real] = True
 
     def pack_batch(self, asks: Sequence[PlacementAsk]
                    ) -> Optional[PackedBatch]:
@@ -202,8 +214,10 @@ class ResidentSolver:
             pb.job_keys = {(a.job.namespace, a.job.id) for a in asks}
         return pb
 
-    def solve_stream(self, batches: Sequence[PackedBatch]
-                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def solve_stream(self, batches: Sequence[PackedBatch],
+                     seeds: Optional[Sequence[int]] = None
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray]:
         """Solve B ask batches in ONE device call.
 
         Returns (choice [B, K, TOP_K] int, ok [B, K, TOP_K] bool,
@@ -216,19 +230,28 @@ class ResidentSolver:
         A job may appear in at most ONE batch per stream (the broker's
         per-job eval serialization): job-scoped scoring state is seeded
         per batch and does not carry.
+
+        `seeds`: optional per-batch tie-break seeds (see the kernel's
+        jitter note). None keeps exact deterministic scoring; passing
+        distinct seeds fans identical asks across equal-scoring nodes,
+        which converges contended batches in fewer waves.
         """
         self._check_stream_jobs(batches)
-        stacked = {
-            name: np.stack([getattr(pb, name) for pb in batches])
-            for name in _ASK_ARGS
-        }
+        stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+        seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
+                    else np.asarray(list(seeds), np.int32))
         self._used, self._dev_used, out = _stream_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
-            self._used, self._dev_used, stacked, n_places)
+            self._used, self._dev_used, stacked, n_places, seed_arr,
+            has_spread=self._has_spread(batches))
         return self._unpack(out)
+
+    @staticmethod
+    def _has_spread(batches: Sequence[PackedBatch]) -> bool:
+        return bool(any((pb.sp_col[:, 0] >= 0).any() for pb in batches))
 
     @staticmethod
     def _unpack(out) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -239,6 +262,38 @@ class ResidentSolver:
         status = out[..., -1].astype(np.int32)
         ok = score > NEG_INF / 2
         return choice, ok, score, status
+
+    def _stack_args(self, batches: Sequence[PackedBatch]):
+        """Stack ask tensors on a leading batch axis, substituting
+        cached device-resident constants for the big [G, N] arrays when
+        every batch carries the default value (all-zero coll0 / penalty
+        / a_host, universe-default host_ok) — the common fresh-job case.
+        A host-side compare costs milliseconds; shipping the dense zeros
+        costs hundreds on tunneled transports."""
+        B = len(batches)
+        stacked = {}
+        for name in _ASK_ARGS:
+            mats = [getattr(pb, name) for pb in batches]
+            if name in ("coll0", "penalty", "a_host") and not any(
+                    m.any() for m in mats):
+                key = (name, B)
+                if key not in self._const_cache:
+                    self._const_cache[key] = jax.device_put(
+                        np.zeros((B,) + mats[0].shape, mats[0].dtype))
+                stacked[name] = self._const_cache[key]
+                continue
+            if name == "host_ok" and all(
+                    np.array_equal(m, self._default_host_ok)
+                    for m in mats):
+                key = (name, B)
+                if key not in self._const_cache:
+                    self._const_cache[key] = jax.device_put(np.broadcast_to(
+                        self._default_host_ok,
+                        (B,) + self._default_host_ok.shape).copy())
+                stacked[name] = self._const_cache[key]
+                continue
+            stacked[name] = np.stack(mats)
+        return stacked
 
     @staticmethod
     def _check_stream_jobs(batches: Sequence[PackedBatch]) -> None:
@@ -269,17 +324,15 @@ class ResidentSolver:
         (batches don't see each other's scoring state at all, only the
         revalidation)."""
         self._check_stream_jobs(batches)
-        stacked = {
-            name: np.stack([getattr(pb, name) for pb in batches])
-            for name in _ASK_ARGS
-        }
+        stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seeds = np.arange(1, len(batches) + 1, dtype=np.int32)
         self._used, self._dev_used, out = _parallel_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
-            self._used, self._dev_used, stacked, n_places, seeds)
+            self._used, self._dev_used, stacked, n_places, seeds,
+            has_spread=self._has_spread(batches))
         return self._unpack(out)
 
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:

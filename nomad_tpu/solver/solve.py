@@ -84,16 +84,21 @@ class Solver:
             m = AllocMetric()
             m.nodes_evaluated = pb.n_real
             m.nodes_available = dict(by_dc or {})
-            m.nodes_filtered = pb.n_real - int(n_feasible[p])
-            for ci, label in enumerate(pb.constraint_labels[g]):
-                cnt = int(cons_filtered[g, ci])
-                if cnt:
-                    m.constraint_filtered[label] = cnt
-            m.nodes_exhausted = int(n_exhausted[p])
-            for d in range(NUM_R):
-                cnt = int(dim_exhausted[p, d])
-                if cnt:
-                    m.dimension_exhausted[_DIM_NAMES[d]] = cnt
+            if unfinished[p]:
+                # never decided: its per-wave metric slots were never
+                # written, so don't fabricate filtered/exhausted counts
+                m.nodes_filtered = 0
+            else:
+                m.nodes_filtered = pb.n_real - int(n_feasible[p])
+                for ci, label in enumerate(pb.constraint_labels[g]):
+                    cnt = int(cons_filtered[g, ci])
+                    if cnt:
+                        m.constraint_filtered[label] = cnt
+                m.nodes_exhausted = int(n_exhausted[p])
+                for d in range(NUM_R):
+                    cnt = int(dim_exhausted[p, d])
+                    if cnt:
+                        m.dimension_exhausted[_DIM_NAMES[d]] = cnt
 
             placed = None
             ask_vec = pb.ask_res[g]
@@ -261,7 +266,14 @@ class Solver:
 
 
 def _run_kernel(pb: PackedBatch):
-    return solve_kernel(
+    import numpy as _np
+    return solve_kernel(*_kernel_args(pb),
+                        has_spread=bool((_np.asarray(pb.sp_col[:, 0])
+                                         >= 0).any()))
+
+
+def _kernel_args(pb: PackedBatch):
+    return (
         pb.avail, pb.reserved, pb.used0, pb.valid, pb.node_dc, pb.attr_rank,
         pb.ask_res, pb.ask_desired, pb.distinct, pb.dc_ok, pb.host_ok,
         pb.coll0,

@@ -20,4 +20,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The wave kernel takes tens of seconds to compile per tensor shape on
+# CPU; without a persistent cache every fresh (nodes, asks) shape in the
+# suite re-pays that, and timing-sensitive e2e tests flake on compile
+# stalls. Cache compiled executables on disk across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/nomad_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

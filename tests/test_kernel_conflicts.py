@@ -77,6 +77,33 @@ def test_sort_conflicts_match_matmul_conflicts(both_paths):
     np.testing.assert_allclose(mm[3], st[3], rtol=1e-6)
 
 
+def test_spread_places_on_nodes_missing_the_attribute():
+    """Nodes without the spread attribute stay candidates (reference:
+    spread.go scores them -1 but still places) — they must not be
+    excluded from the interleaved candidate tables."""
+    nodes = []
+    for i in range(8):
+        n = mock.node()
+        n.node_resources.cpu = 400 if i < 2 else 4000
+        n.node_resources.memory_mb = 4096
+        if i < 2:
+            n.attributes["rack"] = f"r{i}"   # only 2 tiny nodes have it
+        n.compute_class()
+        nodes.append(n)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 6
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = 300
+    job.spreads = [Spread(attribute="${attr.rack}", weight=100)]
+    pb = Tensorizer().pack(nodes, [PlacementAsk(job=job, tg=tg, count=6)],
+                           None)
+    res = _run_kernel(pb)
+    ok = np.asarray(res.choice_ok)[:pb.n_place, 0]
+    assert ok.all(), "placements must land on missing-attr nodes too"
+    assert not np.asarray(res.unfinished).any()
+
+
 def test_distinct_hosts_respected_under_sort_path(both_paths):
     KM._FORCE_SORT_CONFLICTS = True
     jax.clear_caches()
