@@ -1,17 +1,23 @@
-"""Single-process server: state store + broker + workers + plan applier.
+"""Server: replicated state + broker + workers + plan applier.
 
 This is the control-plane container (reference: nomad/server.go Server +
-the FSM apply paths in nomad/fsm.go). In this build the replicated log is
-an in-process critical section (`_apply` bumps a monotonic index and
-writes the store — the same contract raft's FSM apply gives the
-reference); the raft transport drops in underneath later without
-touching the layers above (SURVEY §7.2 step 6).
+the FSM apply paths in nomad/fsm.go). Every write is proposed as a typed
+entry through a raft node (nomad_tpu/raft) and applied to the state
+store by the FSM on commit — identically on leader and followers. The
+default deployment is a bootstrapped single-node cluster (immediate
+commits, optionally durable via data_dir); multi-server clusters share a
+transport and elect a leader, and only the leader runs the broker,
+workers, heartbeater, watchers and plan applier
+(reference: leader.go:197 establishLeadership / :1018 revokeLeadership).
 """
 from __future__ import annotations
 
 import threading
 import time as _time
 from typing import Dict, Iterable, List, Optional
+
+from ..raft import RaftConfig, RaftNode, StateFSM
+from ..utils.codec import to_wire
 
 from ..state.store import StateStore
 from ..structs import (ALLOC_CLIENT_FAILED, CORE_JOB_PRIORITY,
@@ -47,13 +53,24 @@ class Server:
                  job_gc_threshold_s: float = 4 * 3600.0,
                  eval_gc_threshold_s: float = 3600.0,
                  node_gc_threshold_s: float = 24 * 3600.0,
-                 deployment_gc_threshold_s: float = 3600.0):
+                 deployment_gc_threshold_s: float = 3600.0,
+                 raft_config: Optional[RaftConfig] = None,
+                 raft_transport=None):
         self.store = StateStore()
+        self.fsm = StateFSM(self.store)
+        if raft_config is None:
+            raft_config = RaftConfig(node_id="server-1", peers=[])
+        if raft_transport is None:
+            from ..raft import InProcTransport
+            raft_transport = InProcTransport()
+        self.raft = RaftNode(raft_config, self.fsm, raft_transport,
+                             on_leader=self._establish_leadership,
+                             on_follower=self._revoke_leadership)
+        self._multi = len(raft_config.peers) > 1
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.batch_size = batch_size
-        self._apply_lock = threading.Lock()
         self.planner = PlanApplier(self.plan_queue, self.store,
                                    self._apply_plan, self._create_evals)
         self.enabled_schedulers = enabled_schedulers or [
@@ -86,10 +103,29 @@ class Server:
         self._started = False
         self._stop_reapers = threading.Event()
         self._dup_reaper: Optional[threading.Thread] = None
+        self._cas_lock = threading.Lock()
+        if not self._multi:
+            # single-node deployments can accept writes immediately
+            # (pre-raft callers constructed a Server and wrote to it
+            # without start()); leader services still wait for start()
+            self.raft.bootstrap_single(defer_events=True)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
-        """Establish leadership: enable leader-only services + workers
+        """Join the raft cluster. Single-node deployments bootstrap and
+        become leader synchronously (existing callers see the same
+        behavior as before); multi-node members run the election and
+        leader services follow leadership transitions."""
+        if self._multi:
+            self.raft.start()
+        else:
+            self.raft.fire_pending_role_events()
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def _establish_leadership(self) -> None:
+        """Enable leader-only services + workers
         (reference: leader.go:197 establishLeadership)."""
         self.broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
@@ -120,6 +156,10 @@ class Server:
         self._restore_evals()
 
     def stop(self) -> None:
+        self._revoke_leadership()
+        self.raft.stop()
+
+    def _revoke_leadership(self) -> None:
         self.heartbeater.set_enabled(False)
         self.deployment_watcher.set_enabled(False)
         self.drainer.set_enabled(False)
@@ -186,16 +226,16 @@ class Server:
         return ev
 
     # -------------------------------------------------------- write paths
-    def _next_index(self) -> int:
-        index = self.store.latest_index() + 1
+    def _propose(self, etype: str, payload) -> int:
+        """Raft-apply one typed entry; returns its log index (== the
+        store modify index the FSM wrote it at)."""
+        index = self.raft.propose(etype, payload)
         self.time_table.witness(index)
         return index
 
     def register_node(self, node: Node) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            existing = self.store.node_by_id(node.id)
-            self.store.upsert_node(index, node)
+        existing = self.store.node_by_id(node.id)
+        index = self._propose("node_upsert", {"node": to_wire(node)})
         # new capacity unblocks waiters keyed by the node's class
         if node.ready():
             self.blocked_evals.unblock(node.computed_class, index)
@@ -226,9 +266,8 @@ class Server:
         self.update_node_status(node_id, NODE_STATUS_DOWN)
 
     def update_node_status(self, node_id: str, status: str) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_node_status(index, node_id, status)
+        index = self._propose("node_status",
+                              {"node_id": node_id, "status": status})
         node = self.store.node_by_id(node_id)
         if node is None:
             return index
@@ -249,10 +288,11 @@ class Server:
                 and not drain_strategy.force_deadline:
             drain_strategy.force_deadline = \
                 _time.time() + drain_strategy.deadline_s
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_node_drain(index, node_id, drain_strategy,
-                                         mark_eligible)
+        index = self._propose("node_drain", {
+            "node_id": node_id,
+            "drain_strategy": to_wire(drain_strategy)
+            if drain_strategy is not None else None,
+            "mark_eligible": mark_eligible})
         node = self.store.node_by_id(node_id)
         if node is not None:
             self._create_node_evals(node, index)
@@ -263,10 +303,9 @@ class Server:
         drainer's only write (reference: drainer.go drainAllocs ->
         Allocs.UpdateDesiredTransition)."""
         from ..structs import DesiredTransition
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_alloc_desired_transition(
-                index, alloc_ids, DesiredTransition(migrate=True))
+        index = self._propose("alloc_transition", {
+            "alloc_ids": list(alloc_ids),
+            "transition": to_wire(DesiredTransition(migrate=True))})
         evals: List[Evaluation] = []
         seen = set()
         for aid in alloc_ids:
@@ -290,9 +329,8 @@ class Server:
     def update_node_eligibility(self, node_id: str,
                                 eligibility: str) -> int:
         """Node.UpdateEligibility analog (node_endpoint.go)."""
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_node_eligibility(index, node_id, eligibility)
+        index = self._propose("node_eligibility", {
+            "node_id": node_id, "eligibility": eligibility})
         node = self.store.node_by_id(node_id)
         if node is not None and node.ready():
             self.blocked_evals.unblock(node.computed_class, index)
@@ -305,10 +343,9 @@ class Server:
         alloc = self.store.alloc_by_id(alloc_id)
         if alloc is None:
             return None
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_alloc_desired_transition(
-                index, [alloc_id], DesiredTransition(migrate=True))
+        self._propose("alloc_transition", {
+            "alloc_ids": [alloc_id],
+            "transition": to_wire(DesiredTransition(migrate=True))})
         job = alloc.job or self.store.job_by_id(alloc.namespace,
                                                 alloc.job_id)
         ev = Evaluation(
@@ -322,29 +359,33 @@ class Server:
     def register_job(self, job: Job, enforce_index: bool = False,
                      check_index: int = 0) -> Optional[Evaluation]:
         job.canonicalize()
-        with self._apply_lock:
+        # _cas_lock keeps the check-and-set registration atomic across
+        # concurrent registrars (reference: job_endpoint.go Job.Register
+        # EnforceIndex runs inside the raft apply's serialization)
+        with self._cas_lock:
             if enforce_index:
-                # check-and-set registration (reference:
-                # job_endpoint.go Job.Register EnforceIndex)
                 existing = self.store.job_by_id(job.namespace, job.id)
                 current = existing.job_modify_index if existing else 0
                 if current != check_index:
                     raise ValueError(
                         f"job modify index mismatch: have {current}, "
                         f"want {check_index}")
-            index = self._next_index()
-            self.store.upsert_job(index, job)
+            self._propose("job_upsert", {"job": to_wire(job)})
+        # the FSM applied a decoded copy; re-read for the stamped indexes
+        stored = self.store.job_by_id(job.namespace, job.id) or job
         # periodic parents and parameterized jobs are templates: tracked by
         # their dispatchers, never evaluated directly (job_endpoint.go:308)
-        if job.is_periodic():
-            self.periodic.add(job)
+        if stored.is_periodic():
+            self.periodic.add(stored)
             return None
-        if job.is_parameterized():
+        if stored.is_parameterized():
             return None
         ev = Evaluation(
-            namespace=job.namespace, priority=job.priority, type=job.type,
-            triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
-            job_modify_index=job.modify_index, status=EVAL_STATUS_PENDING)
+            namespace=stored.namespace, priority=stored.priority,
+            type=stored.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=stored.id,
+            job_modify_index=stored.modify_index,
+            status=EVAL_STATUS_PENDING)
         self._create_evals([ev])
         return ev
 
@@ -353,15 +394,14 @@ class Server:
         job = self.store.job_by_id(namespace, job_id)
         if job is None:
             return None
-        with self._apply_lock:
-            index = self._next_index()
-            if purge:
-                self.store.delete_job(index, namespace, job_id)
-            else:
-                import copy
-                j2 = copy.copy(job)
-                j2.stop = True
-                self.store.upsert_job(index, j2)
+        if purge:
+            self._propose("job_delete", {"namespace": namespace,
+                                         "job_id": job_id})
+        else:
+            import copy
+            j2 = copy.copy(job)
+            j2.stop = True
+            self._propose("job_upsert", {"job": to_wire(j2)})
         self.blocked_evals.untrack(namespace, job_id)
         self.periodic.remove(namespace, job_id)
         if job.is_periodic() or job.is_parameterized():
@@ -397,9 +437,8 @@ class Server:
     def update_allocs_from_client(self, updates: List[Allocation]) -> int:
         """Client status sync (reference: node_endpoint.go:1063
         Node.UpdateAlloc -> fsm.go:749)."""
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_allocs_from_client(index, updates)
+        index = self._propose("allocs_client", {
+            "updates": [to_wire(u) for u in updates]})
         evals: List[Evaluation] = []
         unblock_nodes = set()
         for upd in updates:
@@ -432,19 +471,21 @@ class Server:
         (reference: fsm.go:680 handleUpsertedEval)."""
         if not evals:
             return
-        with self._apply_lock:
-            index = self._next_index()
-            for ev in evals:
-                if not ev.create_time:
-                    ev.create_time = _time.time()
-                ev.modify_time = _time.time()
-                ev.snapshot_index = ev.snapshot_index or index
-            self.store.upsert_evals(index, list(evals))
+        head = self.store.latest_index() + 1
         for ev in evals:
-            if ev.should_enqueue():
-                self.broker.enqueue(ev)
-            elif ev.should_block():
-                self.blocked_evals.block(ev)
+            if not ev.create_time:
+                ev.create_time = _time.time()
+            ev.modify_time = _time.time()
+            ev.snapshot_index = ev.snapshot_index or head
+        self._propose("evals_upsert",
+                      {"evals": [to_wire(e) for e in evals]})
+        # enqueue the FSM's stored copies (they carry the apply indexes)
+        for ev in evals:
+            stored = self.store.eval_by_id(ev.id) or ev
+            if stored.should_enqueue():
+                self.broker.enqueue(stored)
+            elif stored.should_block():
+                self.blocked_evals.block(stored)
 
     def upsert_evals(self, evals: List[Evaluation]) -> None:
         self._create_evals(evals)
@@ -487,14 +528,9 @@ class Server:
         """Raft-apply a deployment status change; optionally mark the
         job version stable in the same apply (reference:
         fsm.go applyDeploymentStatusUpdate)."""
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.upsert_deployment_updates(index, [update])
-            if mark_stable is not None:
-                namespace, job_id, version = mark_stable
-                self.store.update_job_stability(index, namespace, job_id,
-                                                version, True)
-        return index
+        return self._propose("deployment_status", {
+            "updates": [to_wire(update)],
+            "mark_stable": list(mark_stable) if mark_stable else None})
 
     def promote_deployment(self, dep_id: str,
                            all_groups: bool = True,
@@ -513,10 +549,8 @@ class Server:
         if unhealthy:
             raise ValueError(
                 f"canaries not healthy in group(s): {', '.join(unhealthy)}")
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.update_deployment_promotion(
-                index, dep_id, None if all_groups else groups)
+        self._propose("deployment_promote", {
+            "dep_id": dep_id, "groups": None if all_groups else groups})
         job = self.store.job_by_id(dep.namespace, dep.job_id)
         if job is None:
             return None
@@ -577,45 +611,31 @@ class Server:
     # ----------------------------------------------------------- GC reaps
     def reap_evals(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
         """Eval.Reap analog: delete evals + allocs in one apply."""
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.delete_eval(index, eval_ids, alloc_ids)
-        return index
+        return self._propose("evals_reap", {"eval_ids": list(eval_ids),
+                                            "alloc_ids": list(alloc_ids)})
 
     def reap_jobs(self, keys: List) -> int:
         """Job.BatchDeregister(purge) analog; keys = (namespace, id)."""
-        with self._apply_lock:
-            index = self._next_index()
-            for namespace, job_id in keys:
-                self.store.delete_job(index, namespace, job_id)
-        return index
+        return self._propose("jobs_reap",
+                             {"keys": [list(k) for k in keys]})
 
     def reap_nodes(self, node_ids: List[str]) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            for nid in node_ids:
-                self.store.delete_node(index, nid)
+        index = self._propose("nodes_reap", {"node_ids": list(node_ids)})
         for nid in node_ids:
             self.heartbeater.clear(nid)
         return index
 
     def reap_deployments(self, dep_ids: List[str]) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.delete_deployment(index, dep_ids)
-        return index
+        return self._propose("deployments_reap",
+                             {"dep_ids": list(dep_ids)})
 
     def record_periodic_launch(self, namespace: str, job_id: str,
                                launch: float) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.upsert_periodic_launch(index, namespace, job_id,
-                                              launch)
-        return index
+        return self._propose("periodic_launch", {
+            "namespace": namespace, "job_id": job_id, "launch": launch})
 
     # ------------------------------------------------------- plan applier
     def _apply_plan(self, plan: Plan, result: PlanResult) -> int:
-        with self._apply_lock:
-            index = self._next_index()
-            self.store.upsert_plan_results(index, result, plan.job)
-        return index
+        return self._propose("plan_result", {
+            "result": to_wire(result),
+            "job": to_wire(plan.job) if plan.job is not None else None})

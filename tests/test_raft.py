@@ -1,0 +1,237 @@
+"""Raft consensus + durability (reference: nomad/fsm_test.go apply/
+snapshot/restore cases, nomad/leader_test.go leader transitions — tested
+fully in-process like nomad/testing.go:42)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.sim import SimClient, wait_until
+from nomad_tpu.raft import (InProcTransport, NotLeaderError, RaftConfig,
+                            RaftNode, StateFSM)
+from nomad_tpu.raft.log import LogEntry, RaftLog
+from nomad_tpu.server.server import Server
+from nomad_tpu.state.store import StateStore
+
+
+# ---------------------------------------------------------------- log
+def test_log_durability_and_reload(tmp_path):
+    d = str(tmp_path / "raft")
+    log = RaftLog(d)
+    log.append([LogEntry(1, 1, "a", {"x": 1}),
+                LogEntry(2, 1, "b", {"y": 2})])
+    log.close()
+    log2 = RaftLog(d)
+    assert log2.last_index() == 2
+    assert log2.get(2).payload == {"y": 2}
+    log2.truncate_from(2)
+    assert log2.last_index() == 1
+    log2.close()
+    log3 = RaftLog(d)
+    assert log3.last_index() == 1
+    log3.close()
+
+
+def test_log_compaction(tmp_path):
+    log = RaftLog(str(tmp_path / "raft"))
+    log.append([LogEntry(i, 1, "e", i) for i in range(1, 11)])
+    log.compact_to(7)
+    assert log.last_index() == 10
+    assert log.get(7) is None
+    assert log.get(8).payload == 8
+    assert log.term_at(9) == 1
+    log.close()
+
+
+# ---------------------------------------------------------------- fsm
+def test_fsm_snapshot_restore_roundtrip():
+    store = StateStore()
+    fsm = StateFSM(store)
+    node = mock.node()
+    job = mock.job()
+    store.upsert_node(1, node)
+    store.upsert_job(2, job)
+    a = mock.alloc(job=job, node_id=node.id)
+    store.upsert_allocs(3, [a])
+    snap = fsm.snapshot()
+
+    store2 = StateStore()
+    StateFSM(store2).restore(snap)
+    assert store2.node_by_id(node.id).id == node.id
+    assert store2.job_by_id(job.namespace, job.id).id == job.id
+    assert store2.alloc_by_id(a.id).id == a.id
+    assert [x.id for x in store2.allocs_by_node(node.id)] == [a.id]
+    assert store2.latest_index() == 3
+    assert store2.table_index("allocs") == 3
+
+
+# --------------------------------------------------- single-node server
+def test_single_server_restart_restores_state(tmp_path):
+    from nomad_tpu.raft import RaftConfig
+    d = str(tmp_path / "server")
+    cfg = RaftConfig(node_id="s1", peers=[], data_dir=d)
+    s = Server(num_workers=1, raft_config=cfg)
+    s.start()
+    job = mock.job()
+    job.task_groups[0].count = 2
+    s.register_job(job)
+    node = mock.node()
+    s.register_node(node)
+    s.stop()
+    # read the head only after stop(): the background worker may commit
+    # plans between register_node and shutdown
+    idx = s.store.latest_index()
+
+    s2 = Server(num_workers=1,
+                raft_config=RaftConfig(node_id="s1", peers=[], data_dir=d))
+    # state restored BEFORE leadership services start
+    assert s2.store.job_by_id(job.namespace, job.id) is not None
+    assert s2.store.node_by_id(node.id) is not None
+    assert s2.store.latest_index() == idx
+    s2.start()
+    # and the restored cluster still schedules: a client picks up work
+    client = SimClient(s2, s2.store.node_by_id(node.id))
+    client.start()
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in s2.store.allocs_by_job(job.namespace, job.id)),
+        timeout=30)
+    client.stop()
+    s2.stop()
+
+
+# ------------------------------------------------------- 3-node cluster
+def _cluster(tmp_path, n=3, data=False):
+    transport = InProcTransport()
+    peers = [f"s{i}" for i in range(n)]
+    servers = []
+    for i in range(n):
+        cfg = RaftConfig(
+            node_id=f"s{i}", peers=peers,
+            data_dir=str(tmp_path / f"s{i}") if data else None,
+            election_timeout_s=(0.10, 0.25), heartbeat_interval_s=0.03)
+        servers.append(Server(num_workers=1, raft_config=cfg,
+                              raft_transport=transport))
+    for s in servers:
+        s.start()
+    assert wait_until(lambda: sum(s.is_leader() for s in servers) == 1,
+                      timeout=10)
+    return transport, servers
+
+
+def _leader(servers):
+    for s in servers:
+        if s.is_leader():
+            return s
+    return None
+
+
+def test_three_node_election_replication_and_follower_rejects(tmp_path):
+    transport, servers = _cluster(tmp_path)
+    try:
+        leader = _leader(servers)
+        followers = [s for s in servers if s is not leader]
+        job = mock.job()
+        leader.register_job(job)
+        # replicated to every follower's store
+        assert wait_until(lambda: all(
+            f.store.job_by_id(job.namespace, job.id) is not None
+            for f in followers), timeout=5)
+        # followers refuse writes and point at the leader
+        with pytest.raises(NotLeaderError) as e:
+            followers[0].register_job(mock.job())
+        assert e.value.leader_id == leader.raft.id
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_leader_failover_keeps_identical_state_mid_workload(tmp_path):
+    """VERDICT r2 'done' criterion: kill the leader mid-workload; a
+    follower takes over with identical state and keeps scheduling."""
+    transport, servers = _cluster(tmp_path)
+    try:
+        leader = _leader(servers)
+        node = mock.node()
+        leader.register_node(node)
+        client = SimClient(leader, node)
+        client.start()
+        job = mock.job()
+        job.task_groups[0].count = 3
+        leader.register_job(job)
+        assert wait_until(lambda: sum(
+            1 for a in leader.store.allocs_by_job(job.namespace, job.id)
+            if a.client_status == "running") == 3, timeout=30)
+        pre_allocs = {a.id for a in
+                      leader.store.allocs_by_job(job.namespace, job.id)}
+
+        # kill the leader mid-workload
+        client.stop()
+        old = leader
+        old.stop()
+        rest = [s for s in servers if s is not old]
+        assert wait_until(lambda: sum(s.is_leader() for s in rest) == 1,
+                          timeout=10), "a follower must take over"
+        new_leader = _leader(rest)
+
+        # identical replicated state
+        assert {a.id for a in new_leader.store.allocs_by_job(
+            job.namespace, job.id)} == pre_allocs
+        assert new_leader.store.job_by_id(job.namespace,
+                                          job.id) is not None
+        assert new_leader.store.node_by_id(node.id) is not None
+
+        # and the new leader keeps serving the workload: clients
+        # reconnect, new jobs schedule
+        client2 = SimClient(new_leader, node)
+        client2.start()
+        job2 = mock.job()
+        job2.task_groups[0].count = 2
+        new_leader.register_job(job2)
+        assert wait_until(lambda: sum(
+            1 for a in new_leader.store.allocs_by_job(job2.namespace,
+                                                      job2.id)
+            if a.client_status == "running") == 2, timeout=30)
+        client2.stop()
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_lagging_follower_catches_up_via_snapshot(tmp_path):
+    transport = InProcTransport()
+    peers = ["s0", "s1", "s2"]
+    cfgs = [RaftConfig(node_id=p, peers=peers,
+                       election_timeout_s=(0.10, 0.25),
+                       heartbeat_interval_s=0.03,
+                       snapshot_threshold=32) for p in peers]
+    fsms = [StateFSM(StateStore()) for _ in peers]
+    nodes = [RaftNode(c, f, transport) for c, f in zip(cfgs, fsms)]
+    for n in nodes[:2]:
+        n.start()
+    try:
+        assert wait_until(lambda: any(n.is_leader() for n in nodes[:2]),
+                          timeout=10)
+        leader = next(n for n in nodes[:2] if n.is_leader())
+        # push enough entries to trigger compaction while s2 is dark
+        for i in range(100):
+            mn = mock.node()
+            leader.propose("node_upsert",
+                           {"node": __import__(
+                               "nomad_tpu.utils.codec",
+                               fromlist=["to_wire"]).to_wire(mn)})
+        assert leader.log.offset > 0, "log must have compacted"
+        nodes[2].start()
+        assert wait_until(
+            lambda: len(list(fsms[2].store.nodes())) == 100, timeout=10), \
+            "dark follower must be restored from the leader's snapshot"
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
