@@ -57,6 +57,9 @@ NEG_INF = -1e30
 _FORCE_SORT_CONFLICTS = False
 # node count from which top-k extraction switches to approx_max_k
 _APPROX_MIN_NP = 4096
+# value-vocabulary size up to which spread lookups unroll as select-sums
+# (gather-free); larger vocabularies fall back to take_along_axis
+_SELECT_SUM_MAX_V = 16
 
 
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
@@ -97,14 +100,18 @@ class SolveResult(NamedTuple):
     #  (rare; absorbed by the blocked-eval retry path)
 
 
-@functools.partial(jax.jit, static_argnames=("has_spread",))
+@functools.partial(jax.jit,
+                   static_argnames=("has_spread", "group_count_hint",
+                                    "max_waves"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
                  c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
                  sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
                  sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place,
-                 seed=0, *, has_spread=True) -> SolveResult:
+                 seed=0, *, has_spread=True,
+                 group_count_hint=0, max_waves=0) -> SolveResult:
+    max_waves = max_waves or MAX_WAVES
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
     S = sp_col.shape[1]
@@ -112,10 +119,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     K = p_ask.shape[0]
     # wider waves for bigger batches: a group may commit up to W
     # placements per wave, so a K-placement batch converges in O(K / W)
-    # fused-wave iterations
-    # cap the wave width: top_k cost grows with k, and per-group counts
-    # rarely exceed a few hundred
-    TK = min(max(WAVE_K, min(K // 8, 256)) + TOP_K, Np)
+    # fused-wave iterations. Size W to ~2x the LARGEST per-group
+    # placement count when the caller supplies it (group_count_hint,
+    # computed host-side at pack time): per-group candidate demand is
+    # what W serves, and oversizing it multiplies every wave's top-k /
+    # interleave / candidate costs for no extra commits. Without a hint
+    # (direct callers), fall back to the conservative K-based bound so
+    # skewed batches still converge.
+    per_group = group_count_hint if group_count_hint > 0 else K // 8
+    TK = min(max(WAVE_K, min(2 * per_group, 256)) + TOP_K, Np)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)
@@ -147,6 +159,49 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     pen_score = jnp.where(penalty, -1.0, 0.0)              # rank.go:532
     pen_counts = penalty
 
+    # ---------- hoisted spread lookups (wave-invariant) ----------
+    # The per-(group, node) spread value and desired-count are functions
+    # of static batch tensors only; gathering them once per solve keeps
+    # the wave loop gather-free (per-wave [Gp, Np] gathers dominated the
+    # solve cost before this hoist).
+    V = sp_desired.shape[2]
+    A = attr_rank.shape[1]
+    if has_spread:
+        def spread_static(s):
+            col = sp_col[:, s]                             # [Gp]
+            has = col >= 0
+            # column lookup as a one-hot matmul: a per-element gather of
+            # [Gp, Np] lowers to a near-scalar loop on TPU (~10ns/elem —
+            # it was 2/3 of the whole solve); the MXU does it in one pass.
+            # attr ranks are small ints, exact in f32.
+            onehot = (col[:, None] == jnp.arange(A)[None, :]
+                      ).astype(jnp.float32)                # [Gp, A]
+            # HIGHEST precision: default TPU matmul is bf16-accumulated,
+            # which rounds integer ranks >= 256; f32 keeps ints < 2^24
+            # exact, matching the exact gathers in the quota/commit paths
+            v = jnp.dot(onehot, attr_rank.T.astype(jnp.float32),
+                        precision=lax.Precision.HIGHEST
+                        ).astype(jnp.int32)                # [Gp, Np]
+            v = jnp.where(has[:, None], v, -1)
+            # desired-count lookup: select-sum over small vocabularies
+            # (unrolled V ops); gather fallback for high-cardinality
+            # attributes where a V-unrolled loop would blow up the trace
+            if V <= _SELECT_SUM_MAX_V:
+                desired = jnp.zeros(v.shape, jnp.float32)
+                for val in range(V):
+                    desired = desired + jnp.where(
+                        v == val, sp_desired[:, s, val][:, None], 0.0)
+            else:
+                desired = jnp.take_along_axis(sp_desired[:, s],
+                                              jnp.maximum(v, 0), axis=1)
+            desired = jnp.where(v >= 0, desired, -1.0)
+            desired = jnp.where(desired < 0, sp_implicit[:, s][:, None],
+                                desired)
+            return v, desired
+        sp_vnode, sp_des = jax.vmap(spread_static)(jnp.arange(S))
+    else:
+        sp_vnode = sp_des = None
+
     # tie-break jitter: the reference visits nodes in per-worker shuffled
     # order (stack.go NewRandomIterator), so equal-scoring nodes resolve
     # differently per worker. seed=0 keeps exact deterministic scoring;
@@ -158,9 +213,18 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
          + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
             + jnp.uint32(seed)) * jnp.uint32(40503))
     h = (h ^ (h >> 16)) * jnp.uint32(2246822519)
+    # Seeded mode quantizes scores into coarse bins and jitters within
+    # the bin: once cluster usage is heterogeneous, exact scores make
+    # every group rank the same few nodes on top and waves stall on
+    # conflicts; binning disperses groups across the whole top score
+    # band. The reference's limit iterator picks the max of a random
+    # max(2, log2 N) node sample (scheduler/stack.go:80-87) — selection
+    # within a near-tied band is no further from its semantics than
+    # exact argmax, and converges an order of magnitude faster.
+    SCORE_BIN = 0.05
     jitter = jnp.where(jnp.int32(seed) == 0, 0.0,
                        (h & jnp.uint32(1023)).astype(jnp.float32)
-                       * (1e-6 / 1023.0))                  # [Gp, Np]
+                       * (SCORE_BIN / 1023.0))             # [Gp, Np]
 
     def group_scores(used, dev_used, coll, sp_used, blocked):
         """Batched scoring of every (group, node) pair against current
@@ -191,21 +255,25 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         anti_counts = coll > 0
 
         # -- spread (spread.go; append-if-nonzero) --
+        # gather-free in-wave path: the only per-wave dependence is
+        # sp_used; `cur` comes from a select-sum over the (small) value
+        # vocabulary against the hoisted sp_vnode
         def one_spread(s):
             col = sp_col[:, s]                             # [Gp]
             has = col >= 0
-            v = attr_rank[:, jnp.maximum(col, 0)].T        # [Gp, Np]
+            v = sp_vnode[s]                                # [Gp, Np]
             has_v = v >= 0
-            vc = jnp.maximum(v, 0)
             used_vec = sp_used[:, s]                       # [Gp, V]
-            cur = jnp.where(has_v,
-                            jnp.take_along_axis(used_vec, vc, axis=1), 0.0)
+            if V <= _SELECT_SUM_MAX_V:
+                cur = jnp.zeros_like(v, jnp.float32)
+                for val in range(V):
+                    cur = cur + jnp.where(v == val,
+                                          used_vec[:, val][:, None], 0.0)
+            else:
+                cur = jnp.where(v >= 0, jnp.take_along_axis(
+                    used_vec, jnp.maximum(v, 0), axis=1), 0.0)
             # targeted scoring (desired counts, +1 for this placement)
-            desired = jnp.where(
-                has_v, jnp.take_along_axis(sp_desired[:, s], vc, axis=1),
-                -1.0)
-            desired = jnp.where(desired < 0, sp_implicit[:, s][:, None],
-                                desired)
+            desired = sp_des[s]                            # [Gp, Np]
             boost = ((desired - (cur + 1.0)) / jnp.maximum(desired, 1e-9)
                      ) * sp_weight[:, s][:, None]
             targeted = jnp.where(~has_v, -1.0,
@@ -227,16 +295,23 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             contrib = jnp.where(sp_targeted[:, s][:, None], targeted, even)
             return jnp.where(has[:, None], contrib, 0.0)
 
-        sp_scores = jax.vmap(one_spread)(jnp.arange(S))    # [S, Gp, Np]
-        spread_total = sp_scores.sum(axis=0)
-        spread_counts = spread_total != 0.0
+        if has_spread:
+            sp_scores = jax.vmap(one_spread)(jnp.arange(S))  # [S, Gp, Np]
+            spread_total = sp_scores.sum(axis=0)
+            spread_counts = spread_total != 0.0
+        else:
+            spread_total = 0.0
+            spread_counts = False
 
         aff_counts = aff_score != 0.0
         # -- normalization: mean over appended scorers (rank.go:667) --
         n_scorers = (1.0 + anti_counts + pen_counts + aff_counts
                      + spread_counts)
         total = (binpack + anti + pen_score + aff_score
-                 + spread_total) / n_scorers + jitter
+                 + spread_total) / n_scorers
+        total = jnp.where(jnp.int32(seed) == 0, total,
+                          jnp.floor(total / SCORE_BIN) * SCORE_BIN)
+        total = total + jitter
         score = jnp.where(placeable, total, NEG_INF)
         return score, placeable, feas_b, fit, fit_dims, dev_fit
 
@@ -289,8 +364,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         Vs = sp_desired.shape[2]
         if has_spread and Vs <= 8:
             has0 = sp_col[:, 0] >= 0                       # [Gp]
-            col0g = jnp.maximum(sp_col[:, 0], 0)
-            vnode = jnp.take(attr_rank, col0g, axis=1).T   # [Gp, Np]
+            vnode = sp_vnode[0]                            # [Gp, Np]
             # one class per value PLUS a class for nodes MISSING the
             # spread attribute — the reference still places on those
             # with a -1 score penalty (spread.go), so they must stay
@@ -359,7 +433,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         g_off = jnp.where(jnp.int32(seed) == 0, 0,
                           ((g_hash >> 8) % jnp.uint32(W)).astype(
                               jnp.int32))                  # [Gp]
-        cr = (rank + g_off[g_idx]) % M[g_idx]
+        # rotate the candidate window each wave (seeded mode): a
+        # placement bounced by a same-wave conflict probes a DIFFERENT
+        # slot next wave instead of re-contending for the node it lost,
+        # which otherwise stalls convergence once the cluster fills and
+        # scores tie across groups
+        # step of 1 is coprime with every window size M (a fixed larger
+        # step would be a no-op for groups where M divides it)
+        rot = jnp.where(jnp.int32(seed) == 0, 0, wave)
+        cr = (rank + g_off[g_idx] + rot) % M[g_idx]
         cand = top_idx[g_idx, cr]                          # [K]
         cand_score = top_score[g_idx, cr]
         cand_ok = active & (cand_score > NEG_INF / 2)
@@ -437,8 +519,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # at their desired counts, even spreads at a balanced level
         # (S is a small static pad; unrolled)
         sp_ok = jnp.ones(K, bool)
-        V = sp_desired.shape[2]
-        for s in range(S):
+        for s in (range(S) if has_spread else range(0)):
             cols = sp_col[g_idx, s]
             vs = attr_rank[cand, jnp.maximum(cols, 0)]
             has_s = (cols >= 0) & (vs >= 0)
@@ -478,11 +559,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # rebuilt from the outputs next wave, not carried) --
         used = used.at[cand].add(ask_res[g_idx] * cm)
         dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
-        svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
-        okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm
-        sp_used = sp_used.at[g_idx[:, None], jnp.arange(S)[None, :],
-                             jnp.maximum(svals, 0)].add(
-            okslot.astype(jnp.float32))
+        if has_spread:
+            svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
+            okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm
+            sp_used = sp_used.at[g_idx[:, None], jnp.arange(S)[None, :],
+                                 jnp.maximum(svals, 0)].add(
+                okslot.astype(jnp.float32))
 
         # -- record results: a committed placement's fall-through top-K is
         # its group's candidate list starting at its own rank --
@@ -524,7 +606,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
            jnp.zeros(K, jnp.int32),
            jnp.zeros((K, R), jnp.int32),
            jnp.int32(0))
-    (st_final, _) = lax.scan(body_scan, st0, None, length=MAX_WAVES)
+    (st_final, _) = lax.scan(body_scan, st0, None, length=max_waves)
     (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
      out_nfeas, out_nexh, out_dimexh, waves) = st_final
     unfinished = ~done & (ks < n_place)

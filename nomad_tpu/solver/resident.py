@@ -56,7 +56,8 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 
 
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
-               used, dev_used, batch, n_place, seed=0, has_spread=True):
+               used, dev_used, batch, n_place, seed=0, has_spread=True,
+               group_count_hint=0, max_waves=0):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -67,13 +68,16 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
         batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
         dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
-        seed, has_spread=has_spread)
+        seed, has_spread=has_spread, group_count_hint=group_count_hint,
+        max_waves=max_waves)
 
 
-@functools.partial(jax.jit, static_argnames=("has_spread",))
+@functools.partial(jax.jit,
+                   static_argnames=("has_spread", "group_count_hint",
+                                    "max_waves"))
 def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                      used0, dev_used0, stacked, n_places, seeds,
-                     has_spread=True):
+                     has_spread=True, group_count_hint=0, max_waves=0):
     """The TPU recast of the reference's optimistic worker concurrency
     (nomad/worker.go goroutines + nomad/plan_apply.go serial applier):
     vmap B batch-solves against ONE shared usage snapshot — each with its
@@ -84,7 +88,8 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     res = jax.vmap(
         lambda b, n, s: _solve_one(avail, reserved, valid, node_dc,
                                    attr_rank, dev_cap, used0, dev_used0,
-                                   b, n, s, has_spread)
+                                   b, n, s, has_spread,
+                                   group_count_hint, max_waves)
     )(stacked, n_places, seeds)
     # res.* have a leading [B] axis; slot-0 choices are the commits
     K = res.choice.shape[1]
@@ -137,10 +142,12 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     return used_f, dev_used_f, out
 
 
-@functools.partial(jax.jit, static_argnames=("has_spread",))
+@functools.partial(jax.jit,
+                   static_argnames=("has_spread", "group_count_hint",
+                                    "max_waves"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
-                   has_spread=True):
+                   has_spread=True, group_count_hint=0, max_waves=0):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device."""
 
@@ -149,7 +156,7 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch, n_place, seed = xs
         res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used, dev_used, batch, n_place, seed,
-                         has_spread)
+                         has_spread, group_count_hint, max_waves)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -177,13 +184,16 @@ class ResidentSolver:
     def __init__(self, nodes: Sequence[Node],
                  probe_asks: Sequence[PlacementAsk],
                  allocs_by_node: Optional[Dict[str, list]] = None,
-                 gp: Optional[int] = None, kp: Optional[int] = None):
+                 gp: Optional[int] = None, kp: Optional[int] = None,
+                 max_waves: int = 0):
         self.nodes = list(nodes)
+        self.max_waves = max_waves        # 0 = kernel default
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
         self.gp = gp or self.template.ask_res.shape[0]
         self.kp = kp or self.template.p_ask.shape[0]
         self._drv_cache: Dict[str, np.ndarray] = {}
+        self._row_cache: Dict = {}    # ask_signature -> packed spec row
         t = self.template
         self._dev_node = {
             "avail": jax.device_put(t.avail),
@@ -209,7 +219,8 @@ class ResidentSolver:
         """Ask-side-only pack against the resident universe."""
         pb = self._tz.repack_asks(self.nodes, asks, self.template,
                                   gp=self.gp, kp=self.kp,
-                                  drv_cache=self._drv_cache)
+                                  drv_cache=self._drv_cache,
+                                  row_cache=self._row_cache)
         if pb is not None:
             pb.job_keys = {(a.job.namespace, a.job.id) for a in asks}
         return pb
@@ -236,6 +247,15 @@ class ResidentSolver:
         distinct seeds fans identical asks across equal-scoring nodes,
         which converges contended batches in fewer waves.
         """
+        return self._unpack(self.solve_stream_async(batches, seeds))
+
+    def solve_stream_async(self, batches: Sequence[PackedBatch],
+                           seeds: Optional[Sequence[int]] = None):
+        """Dispatch a stream WITHOUT fetching: returns the device-side
+        packed result (pass to finish_stream to unpack).  Lets callers
+        pipeline independent streams (e.g. one per region/solver) so
+        their transport round trips overlap — JAX dispatch is async, and
+        the carried usage updates device-side immediately."""
         self._check_stream_jobs(batches)
         stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
@@ -246,12 +266,35 @@ class ResidentSolver:
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
             self._used, self._dev_used, stacked, n_places, seed_arr,
-            has_spread=self._has_spread(batches))
+            has_spread=self._has_spread(batches),
+            group_count_hint=self._group_count_hint(batches),
+            max_waves=self.max_waves)
+        return out
+
+    def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
         return self._unpack(out)
 
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:
         return bool(any((pb.sp_col[:, 0] >= 0).any() for pb in batches))
+
+    @staticmethod
+    def _group_count_hint(batches: Sequence[PackedBatch]) -> int:
+        """Pow2-rounded largest per-group placement count across the
+        stream (sizes the kernel's wave width; pow2 rounding bounds the
+        number of distinct compiled variants)."""
+        m = 1
+        for pb in batches:
+            if pb.n_place:
+                m = max(m, int(np.bincount(
+                    pb.p_ask[:pb.n_place]).max()))
+        # floor at 64: one compiled variant covers all small counts
+        # (reduced drain/retry batches would otherwise each compile
+        # their own bucket). Ceil at 128: the kernel clamps the wave
+        # width at 2*128, so larger hints would compile byte-identical
+        # programs.
+        return min(1 << max(6, (m - 1).bit_length()), 128)
 
     @staticmethod
     def _unpack(out) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -332,7 +375,9 @@ class ResidentSolver:
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
             self._used, self._dev_used, stacked, n_places, seeds,
-            has_spread=self._has_spread(batches))
+            has_spread=self._has_spread(batches),
+            group_count_hint=self._group_count_hint(batches),
+            max_waves=self.max_waves)
         return self._unpack(out)
 
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:

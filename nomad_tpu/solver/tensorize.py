@@ -510,10 +510,52 @@ class Tensorizer:
             dev_pattern_ids=dict(dev_pattern_ix),
         )
 
+    @staticmethod
+    def ask_signature(ask: PlacementAsk):
+        """Hashable semantic signature of an ask's CACHEABLE row - the
+        spec-derived program pieces (constraints, affinities, spreads,
+        resources, drivers, volumes, datacenters).  Excludes per-eval
+        state (existing allocs, penalties, blocked hosts, spread seeds),
+        which is pasted onto the cached row per ask, and excludes
+        ask.count, which only sizes the placement vector."""
+        job, tg = ask.job, ask.tg
+
+        def cons(cs):
+            return tuple((c.ltarget, c.rtarget, c.operand) for c in cs)
+
+        def affs(afs):
+            return tuple((a.ltarget, a.rtarget, a.operand, a.weight)
+                         for a in afs)
+
+        def sprs(sps):
+            return tuple(
+                (sp.attribute, sp.weight,
+                 tuple((t.value, t.percent)
+                       for t in (sp.spread_targets or ())))
+                for sp in sps)
+
+        task_sig = tuple(
+            (t.driver, cons(t.constraints), affs(t.affinities),
+             t.resources.cpu, t.resources.memory_mb, t.resources.disk_mb,
+             tuple((d.name, d.count, str(d.constraints))
+                   for d in t.resources.devices),
+             tuple(n.mbits for n in t.resources.networks))
+            for t in tg.tasks)
+        vol_sig = tuple(sorted(
+            (k, v.type, v.source, v.read_only)
+            for k, v in tg.volumes.items()))
+        net_sig = tuple(n.mbits for n in tg.networks)
+        return (cons(job.constraints), affs(job.affinities),
+                sprs(job.spreads), tuple(job.datacenters),
+                cons(tg.constraints), affs(tg.affinities), sprs(tg.spreads),
+                tg.count, tg.ephemeral_disk.size_mb, tg.ephemeral_disk.sticky,
+                vol_sig, net_sig, task_sig)
+
     def repack_asks(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
                     template: PackedBatch,
                     gp: Optional[int] = None, kp: Optional[int] = None,
-                    drv_cache: Optional[Dict[str, np.ndarray]] = None
+                    drv_cache: Optional[Dict[str, np.ndarray]] = None,
+                    row_cache: Optional[Dict] = None
                     ) -> Optional[PackedBatch]:
         """Rebuild ONLY the ask-side tensors of `template`, reusing its
         node-side arrays and rank universes untouched.
@@ -569,22 +611,32 @@ class Tensorizer:
                 return OP_GE, ins
             return None
 
-        c_op = np.zeros((gp, C), np.int32)
-        c_col = np.zeros((gp, C), np.int32)
-        c_rank = np.zeros((gp, C), np.int32)
-        a_op = np.zeros((gp, CA), np.int32)
-        a_col = np.zeros((gp, CA), np.int32)
-        a_rank = np.zeros((gp, CA), np.int32)
-        a_weight = np.zeros((gp, CA), np.float32)
-        a_host = np.zeros((gp, Np), np.float32)
-        host_ok = np.zeros((gp, Np), bool)
-        host_ok[:, :N] = True
-        constraint_labels: List[List[str]] = []
         node_index = {n.id: i for i, n in enumerate(nodes)}
         if drv_cache is None:
             drv_cache = {}
+        FALLBACK = "fallback"
 
-        for g, ask in enumerate(asks):
+        def build_row(ask):
+            """Spec-derived row pieces for one ask (no per-eval state).
+            Returns FALLBACK when the ask is inexpressible in this
+            universe (caller returns None -> full pack path)."""
+            row = {
+                "c_op": np.zeros(C, np.int32),
+                "c_col": np.zeros(C, np.int32),
+                "c_rank": np.zeros(C, np.int32),
+                "a_op": np.zeros(CA, np.int32),
+                "a_col": np.zeros(CA, np.int32),
+                "a_rank": np.zeros(CA, np.int32),
+                "a_weight": np.zeros(CA, np.float32),
+                "a_host": np.zeros(N, np.float32),
+                "dc_ok": np.zeros(NDC, bool),
+                "sp_col": np.full(S, -1, np.int32),
+                "sp_weight": np.zeros(S, np.float32),
+                "sp_targeted": np.zeros(S, bool),
+                "sp_desired": np.full((S, V), -1.0, np.float32),
+                "sp_implicit": np.full(S, -1.0, np.float32),
+                "dev_ask": np.zeros(D, np.float32),
+            }
             vec, labels, host = [], [], []
             for c in hostfeas.merged_constraints(ask.job, ask.tg):
                 if c.operand in (CONSTRAINT_DISTINCT_HOSTS,
@@ -595,19 +647,21 @@ class Tensorizer:
                         and not c.rtarget.startswith("${")):
                     col = attr_ix.get(c.ltarget)
                     if col is None:
-                        return None
+                        return FALLBACK
                     orank = ranked(col, c.rtarget, op)
                     if orank is None:
-                        return None
+                        return FALLBACK
                     vec.append((orank[0], col, orank[1]))
                     labels.append(str(c))
                 else:
                     host.append(c)
             if len(vec) > C:
-                return None
+                return FALLBACK
             for k, (op, col, r) in enumerate(vec):
-                c_op[g, k], c_col[g, k], c_rank[g, k] = op, col, r
-            constraint_labels.append(labels)
+                row["c_op"][k] = op
+                row["c_col"][k] = col
+                row["c_rank"][k] = r
+            row["labels"] = labels
 
             mask = np.ones(N, bool)
             for c in host:
@@ -624,11 +678,7 @@ class Tensorizer:
                 mask &= np.fromiter(
                     (hostfeas.host_volumes_feasible(n, ask.tg)
                      for n in nodes), bool, N)
-            for nid in ask.distinct_hosts_blocked:
-                i = node_index.get(nid)
-                if i is not None:
-                    mask[i] = False
-            host_ok[g, :N] = mask
+            row["host_ok"] = mask
 
             affs, haffs = [], []
             merged_affs = list(ask.job.affinities) + list(ask.tg.affinities)
@@ -640,36 +690,104 @@ class Tensorizer:
                         and not a.rtarget.startswith("${")):
                     col = attr_ix.get(a.ltarget)
                     if col is None:
-                        return None
+                        return FALLBACK
                     affs.append((col, a.rtarget, op, float(a.weight)))
                 else:
                     haffs.append(a)
             if len(affs) > CA:
-                return None
+                return FALLBACK
             total = (sum(abs(w) for _, _, _, w in affs)
                      + sum(abs(a.weight) for a in haffs))
             for k, (col, operand, op, w) in enumerate(affs):
                 orank = ranked(col, operand, op)
                 if orank is None:
-                    return None
-                a_op[g, k], a_col[g, k] = orank[0], col
-                a_rank[g, k] = orank[1]
-                a_weight[g, k] = w / total if total else 0.0
+                    return FALLBACK
+                row["a_op"][k] = orank[0]
+                row["a_col"][k] = col
+                row["a_rank"][k] = orank[1]
+                row["a_weight"][k] = w / total if total else 0.0
             for aff in haffs:
                 c = Constraint(aff.ltarget, aff.rtarget, aff.operand)
                 match = self._class_masked(nodes, c)
-                a_host[g, :N] += match * (aff.weight / total if total
+                row["a_host"] += match * (aff.weight / total if total
                                           else 0.0)
 
-        # ---- dc eligibility against the template's dc universe ----
-        dc_ok = np.zeros((gp, NDC), bool)
-        for g, ask in enumerate(asks):
             dcs = set(ask.job.datacenters)
             for dc, did in template.dc_ids.items():
                 if dc in dcs or "*" in dcs:
-                    dc_ok[g, did] = True
+                    row["dc_ok"][did] = True
 
-        # ---- asks / spreads / devices ----
+            row["ask_res"] = group_resource_vector(ask.tg)
+            row["ask_desired"] = float(max(ask.tg.count, 1))
+            if any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                   for c in ask.job.constraints):
+                row["distinct_kind"] = "job"
+            elif any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                     for c in hostfeas.merged_constraints(ask.job, ask.tg)):
+                row["distinct_kind"] = "tg"
+            else:
+                row["distinct_kind"] = None
+
+            sps = list(ask.job.spreads) + list(ask.tg.spreads)
+            if len(sps) > S:
+                return FALLBACK
+            sum_w = sum(sp.weight for sp in sps)
+            total_count = max(ask.tg.count, 1)
+            for si, sp in enumerate(sps):
+                col = attr_ix.get(sp.attribute)
+                if col is None:
+                    return FALLBACK
+                rc = rank_columns[col]
+                if rc.n_values > V:
+                    return FALLBACK
+                row["sp_col"][si] = col
+                row["sp_weight"][si] = sp.weight / sum_w if sum_w else 0.0
+                if sp.spread_targets:
+                    row["sp_targeted"][si] = True
+                    sum_desired = 0.0
+                    for st in sp.spread_targets:
+                        d = (st.percent / 100.0) * total_count
+                        r = rc.rank(st.value)
+                        if r >= 0:
+                            row["sp_desired"][si, r] = d
+                        sum_desired += d
+                    if 0 < sum_desired < total_count:
+                        row["sp_implicit"][si] = total_count - sum_desired
+
+            for t in ask.tg.tasks:
+                for d in t.resources.devices:
+                    dix = template.dev_pattern_ids.get(d.id_tuple())
+                    if dix is None:
+                        return FALLBACK
+                    row["dev_ask"][dix] += d.count
+            return row
+
+        # one cached spec row per distinct ask shape; per-eval state is
+        # pasted over the copy in the assembly loop below, so cached
+        # rows are never mutated
+        rows = []
+        for ask in asks:
+            sig = self.ask_signature(ask) if row_cache is not None else None
+            row = row_cache.get(sig) if sig is not None else None
+            if row is None:
+                row = build_row(ask)
+                if row is FALLBACK:
+                    return None
+                if sig is not None:
+                    row_cache[sig] = row
+            rows.append(row)
+
+        c_op = np.zeros((gp, C), np.int32)
+        c_col = np.zeros((gp, C), np.int32)
+        c_rank = np.zeros((gp, C), np.int32)
+        a_op = np.zeros((gp, CA), np.int32)
+        a_col = np.zeros((gp, CA), np.int32)
+        a_rank = np.zeros((gp, CA), np.int32)
+        a_weight = np.zeros((gp, CA), np.float32)
+        a_host = np.zeros((gp, Np), np.float32)
+        host_ok = np.zeros((gp, Np), bool)
+        host_ok[:, :N] = True       # padding rows keep the universe
+        dc_ok = np.zeros((gp, NDC), bool)
         ask_res = np.zeros((gp, NUM_R), np.float32)
         ask_desired = np.ones(gp, np.float32)
         distinct = np.full(gp, -1, np.int32)
@@ -683,15 +801,28 @@ class Tensorizer:
         sp_implicit = np.full((gp, S), -1.0, np.float32)
         sp_used0 = np.zeros((gp, S, V), np.float32)
         dev_ask = np.zeros((gp, D), np.float32)
+        constraint_labels: List[List[str]] = []
         p_ask_list: List[int] = []
-        for g, ask in enumerate(asks):
-            ask_res[g] = group_resource_vector(ask.tg)
-            ask_desired[g] = max(ask.tg.count, 1)
-            if any(c.operand == CONSTRAINT_DISTINCT_HOSTS
-                   for c in ask.job.constraints):
+
+        for g, (ask, row) in enumerate(zip(asks, rows)):
+            c_op[g], c_col[g], c_rank[g] = \
+                row["c_op"], row["c_col"], row["c_rank"]
+            constraint_labels.append(row["labels"])
+            host_ok[g, :N] = row["host_ok"]
+            for nid in ask.distinct_hosts_blocked:
+                i = node_index.get(nid)
+                if i is not None:
+                    host_ok[g, i] = False
+            a_op[g], a_col[g], a_rank[g] = \
+                row["a_op"], row["a_col"], row["a_rank"]
+            a_weight[g] = row["a_weight"]
+            a_host[g, :N] = row["a_host"]
+            dc_ok[g] = row["dc_ok"]
+            ask_res[g] = row["ask_res"]
+            ask_desired[g] = row["ask_desired"]
+            if row["distinct_kind"] == "job":
                 distinct[g] = distinct_interner.intern("job:" + ask.job.id)
-            elif any(c.operand == CONSTRAINT_DISTINCT_HOSTS
-                     for c in hostfeas.merged_constraints(ask.job, ask.tg)):
+            elif row["distinct_kind"] == "tg":
                 distinct[g] = distinct_interner.intern(
                     f"tg:{ask.job.id}:{ask.tg.name}")
             for nid, cnt in ask.existing_by_node.items():
@@ -702,44 +833,21 @@ class Tensorizer:
                 i = node_index.get(nid)
                 if i is not None:
                     penalty[g, i] = True
-
-            sps = list(ask.job.spreads) + list(ask.tg.spreads)
-            if len(sps) > S:
-                return None
-            sum_w = sum(sp.weight for sp in sps)
-            total_count = max(ask.tg.count, 1)
-            for s, sp in enumerate(sps):
-                col = attr_ix.get(sp.attribute)
-                if col is None:
-                    return None
-                rc = rank_columns[col]
-                if rc.n_values > V:
-                    return None
-                sp_col[g, s] = col
-                sp_weight[g, s] = sp.weight / sum_w if sum_w else 0.0
-                if sp.spread_targets:
-                    sp_targeted[g, s] = True
-                    sum_desired = 0.0
-                    for st in sp.spread_targets:
-                        d = (st.percent / 100.0) * total_count
-                        r = rc.rank(st.value)
-                        if r >= 0:
-                            sp_desired[g, s, r] = d
-                        sum_desired += d
-                    if 0 < sum_desired < total_count:
-                        sp_implicit[g, s] = total_count - sum_desired
-                seed = ask.spread_seed.get(sp.attribute, {})
-                for val, cnt in seed.items():
-                    r = rc.rank(val)
-                    if r >= 0:
-                        sp_used0[g, s, r] = cnt
-
-            for t in ask.tg.tasks:
-                for d in t.resources.devices:
-                    dix = template.dev_pattern_ids.get(d.id_tuple())
-                    if dix is None:
-                        return None
-                    dev_ask[g, dix] += d.count
+            sp_col[g], sp_weight[g] = row["sp_col"], row["sp_weight"]
+            sp_targeted[g] = row["sp_targeted"]
+            sp_desired[g] = row["sp_desired"]
+            sp_implicit[g] = row["sp_implicit"]
+            if ask.spread_seed:
+                for si, sp in enumerate(list(ask.job.spreads)
+                                        + list(ask.tg.spreads)):
+                    seed = ask.spread_seed.get(sp.attribute, {})
+                    if seed:
+                        rc = rank_columns[sp_col[g, si]]
+                        for val, cnt in seed.items():
+                            r = rc.rank(val)
+                            if r >= 0:
+                                sp_used0[g, si, r] = cnt
+            dev_ask[g] = row["dev_ask"]
             p_ask_list.extend([g] * ask.count)
 
         kp = kp or _pad_pow2(max(len(p_ask_list), 1), floor=1)

@@ -86,7 +86,7 @@ def test_fleet_through_running_server():
             assert wait_until(lambda j=job: len([
                 a for a in server.store.allocs_by_job("default", j.id)
                 if a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 2,
-                timeout=15), job.id
+                timeout=40), job.id
     finally:
         for c in clients:
             c.stop()
