@@ -406,21 +406,23 @@ class GenericScheduler:
                     del self.plan.node_update[m.previous.node_id]
 
     def _try_preemption(self, nodes, m: _Missing, allocs_by_node) -> bool:
-        """Second pass for an exhausted placement: find a feasible node
-        where evicting lower-priority allocs makes room (reference:
-        preemption.go PreemptForTaskGroup as a post-solve pass)."""
-        from ..solver.tensorize import group_resource_vector
-        from .preemption import pick_victims
+        """Second pass for an exhausted placement: across ALL feasible
+        nodes, find victim sets (task-group resources, then network and
+        device dimensions — preemption.find_preemption) and place on the
+        BEST node — highest bin-pack score after eviction, matching the
+        reference where preemption options feed the regular rank/max
+        pipeline (preemption.go wired via rank.go BinPackIterator) —
+        not the first node that works."""
+        from ..structs.funcs import score_fit, allocs_fit
+        from .preemption import find_preemption
 
-        vec = group_resource_vector(m.tg)
+        best = None                # (score, node, victims, resources)
         for node in nodes:
             ok, _why = hostfeas.group_feasible(node, self.job, m.tg)
             if not ok:
                 continue
             proposed = allocs_by_node.get(node.id, [])
-            victims = pick_victims(node, proposed, self.job.priority,
-                                   float(vec[0]), float(vec[1]),
-                                   float(vec[2]), float(vec[3]))
+            victims = find_preemption(node, proposed, self.job, m.tg)
             if not victims:
                 continue
             victim_ids = {v.id for v in victims}
@@ -432,15 +434,28 @@ class GenericScheduler:
                 {}, {}, trial)
             if resources is None:
                 continue
-            alloc = self._emit_alloc(m, node, resources, 0.0, None)
-            alloc.preempted_allocations = sorted(victim_ids)
-            # later placements must see both the evictions and the new
-            # alloc's usage
-            allocs_by_node[node.id] = remaining + [alloc]
-            for v in victims:
-                self.plan.append_preempted_alloc(v, alloc.id)
-            return True
-        return False
+            probe = Allocation(id="probe", task_group=m.tg.name,
+                               allocated_resources=resources)
+            fit, _dim, used = allocs_fit(node, remaining + [probe])
+            if not fit:
+                continue
+            score = score_fit(node, used)
+            if best is None or score > best[0]:
+                best = (score, node, victims, resources)
+        if best is None:
+            return False
+        _score, node, victims, resources = best
+        victim_ids = {v.id for v in victims}
+        remaining = [a for a in allocs_by_node.get(node.id, [])
+                     if a.id not in victim_ids]
+        alloc = self._emit_alloc(m, node, resources, _score, None)
+        alloc.preempted_allocations = sorted(victim_ids)
+        # later placements must see both the evictions and the new
+        # alloc's usage
+        allocs_by_node[node.id] = remaining + [alloc]
+        for v in victims:
+            self.plan.append_preempted_alloc(v, alloc.id)
+        return True
 
     def _preferred_node(self, m: _Missing, node_by_id):
         if m.previous is None or not m.tg.ephemeral_disk.sticky:

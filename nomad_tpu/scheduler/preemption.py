@@ -121,6 +121,295 @@ def pick_victims(node: Node, proposed: Sequence[Allocation],
     return pruned or None
 
 
+def group_preemptible(job_priority: int, allocs: Sequence[Allocation]
+                      ) -> List[List[Allocation]]:
+    """Victim candidates grouped by job priority, lowest group first
+    (reference: filterAndGroupPreemptibleAllocs :663)."""
+    by_prio: Dict[int, List[Allocation]] = {}
+    for a in allocs:
+        if a.terminal_status() or a.job is None:
+            continue
+        if job_priority - a.job.priority < PRIORITY_DELTA:
+            continue
+        by_prio.setdefault(a.job.priority, []).append(a)
+    return [by_prio[p] for p in sorted(by_prio)]
+
+
+def _first_network(alloc: Allocation):
+    nets = alloc.comparable_resources().networks
+    return nets[0] if nets else None
+
+
+def preempt_for_network(job_priority: int, proposed: Sequence[Allocation],
+                        ask_net, node: Node
+                        ) -> Optional[List[Allocation]]:
+    """Find victims freeing bandwidth / reserved ports for one network
+    ask (reference: PreemptForNetwork :270).  Victims must share the
+    ask's network DEVICE; a needed reserved port held by a
+    non-preemptible alloc disqualifies the whole device.  Within a
+    device, victims are taken lowest-priority-first, closest MBits
+    first (networkResourceDistance :627), until the ask fits; a final
+    pass drops superset victims."""
+    from ..structs.network import NetworkIndex
+
+    if not proposed:
+        return None
+    mbits_needed = int(ask_net.mbits)
+    ports_needed = [p.value for p in ask_net.reserved_ports]
+
+    ni = NetworkIndex()
+    ni.set_node(node)
+    ni.add_allocs(proposed)
+
+    device_allocs: Dict[str, List[Allocation]] = {}
+    filtered_ports: Dict[str, set] = {}
+    for a in proposed:
+        if a.terminal_status() or a.job is None:
+            continue
+        net = _first_network(a)
+        if net is None:
+            continue
+        if job_priority - a.job.priority < PRIORITY_DELTA:
+            for pt in net.reserved_ports:
+                filtered_ports.setdefault(net.device, set()).add(pt.value)
+            continue
+        device_allocs.setdefault(net.device, []).append(a)
+    if not device_allocs:
+        return None
+
+    def net_distance(used_mbits: float) -> float:
+        if mbits_needed <= 0:
+            return float("inf")
+        return abs((mbits_needed - used_mbits) / mbits_needed)
+
+    for device, current in device_allocs.items():
+        total_bw = ni.avail_bandwidth.get(device, 0)
+        if total_bw < mbits_needed:
+            continue
+        free_bw = total_bw - ni.used_bandwidth.get(device, 0)
+        victims: List[Allocation] = []
+        preempted_bw = 0
+
+        if ports_needed:
+            used_port_to_alloc = {}
+            for a in current:
+                for n in a.comparable_resources().networks:
+                    for pt in n.reserved_ports:
+                        used_port_to_alloc[pt.value] = a
+            blocked = False
+            for port in ports_needed:
+                holder = used_port_to_alloc.get(port)
+                if holder is not None:
+                    if holder not in victims:
+                        net = _first_network(holder)
+                        preempted_bw += int(net.mbits) if net else 0
+                        victims.append(holder)
+                elif port in filtered_ports.get(device, ()):
+                    blocked = True        # higher-priority holder
+                    break
+            if blocked:
+                continue
+            current = [a for a in current if a not in victims]
+
+        met = preempted_bw + free_bw >= mbits_needed
+        if not met:
+            for grp in group_preemptible(job_priority, current):
+                grp.sort(key=lambda a: net_distance(
+                    (_first_network(a).mbits if _first_network(a) else 0)))
+                for a in grp:
+                    net = _first_network(a)
+                    preempted_bw += int(net.mbits) if net else 0
+                    victims.append(a)
+                    if preempted_bw + free_bw >= mbits_needed:
+                        met = True
+                        break
+                if met:
+                    break
+        if not met:
+            continue
+        # superset filter: drop victims (largest distance first) whose
+        # bandwidth is not needed once the rest are evicted, keeping
+        # reserved-port holders (their eviction is what frees the port)
+        port_holders = set()
+        for a in victims:
+            net = _first_network(a)
+            if net and any(p.value in ports_needed
+                           for p in net.reserved_ports):
+                port_holders.add(a.id)
+        pruned = list(victims)
+        for a in sorted(victims, key=lambda v: -net_distance(
+                _first_network(v).mbits if _first_network(v) else 0)):
+            if a.id in port_holders:
+                continue
+            trial = [v for v in pruned if v.id != a.id]
+            freed = sum(int(_first_network(v).mbits)
+                        for v in trial if _first_network(v))
+            if freed + free_bw >= mbits_needed:
+                pruned = trial
+        return pruned or None
+    return None
+
+
+def preempt_for_device(job_priority: int, proposed: Sequence[Allocation],
+                       ask, node: Node, extra_needed: int = 0
+                       ) -> Optional[List[Allocation]]:
+    """Find victims freeing device instances for one device ask
+    (reference: PreemptForDevice :472).  Allocations are grouped by the
+    device group they hold instances of; per group, victims accumulate
+    lowest-priority-first until freed + free >= ask.count; across groups
+    the option with the smallest net priority (sum of unique victim
+    priorities) wins (selectBestAllocs :559).  Device-attribute
+    constraints on the ask are not re-checked here (the solver's device
+    dimension already filtered candidate nodes)."""
+    from ..structs.devices import DeviceAccounter
+
+    acct = DeviceAccounter(node)
+    acct.add_allocs(proposed)
+
+    matching = {dev.id_tuple() for dev in node.node_resources.devices
+                if ask.matches(*dev.id_tuple())}
+    if not matching:
+        return None
+
+    # device group -> (allocs using it, instance count per alloc)
+    group_use: Dict[Tuple[str, str, str],
+                    Tuple[List[Allocation], Dict[str, int]]] = {}
+    for a in proposed:
+        if a.terminal_status() or a.job is None:
+            continue
+        for tr in a.allocated_resources.tasks.values():
+            for ad in tr.devices:
+                key = (ad.vendor, ad.type, ad.name)
+                if key not in matching:
+                    continue
+                allocs, counts = group_use.setdefault(key, ([], {}))
+                if a.id not in counts:
+                    allocs.append(a)
+                counts[a.id] = counts.get(a.id, 0) + len(ad.device_ids)
+
+    needed = int(ask.count) + int(extra_needed)
+    options: List[Tuple[List[Allocation], Dict[str, int]]] = []
+    for key, (allocs, counts) in group_use.items():
+        free = len(acct.free_instances(*key))
+        preempted = 0
+        picked: List[Allocation] = []
+        for grp in group_preemptible(job_priority, allocs):
+            for a in grp:
+                preempted += counts[a.id]
+                picked.append(a)
+                if preempted + free >= needed:
+                    break
+            if preempted + free >= needed:
+                break
+        if preempted + free >= needed:
+            options.append((picked, counts))
+    if not options:
+        return None
+
+    # selectBestAllocs: within an option, biggest instance holders
+    # first, trimmed at the needed count; lowest net priority wins
+    best: Optional[List[Allocation]] = None
+    best_prio = float("inf")
+    for allocs, counts in options:
+        allocs = sorted(allocs, key=lambda a: -counts[a.id])
+        picked, prios, got = [], set(), 0
+        for a in allocs:
+            if got >= needed:
+                break
+            got += counts[a.id]
+            picked.append(a)
+            prios.add(a.job.priority)
+        net_priority = sum(prios)
+        if net_priority < best_prio:
+            best_prio = net_priority
+            best = picked
+    return best
+
+
+def free_device_instances_by_group(node: Node,
+                                   allocs: Sequence[Allocation], ask
+                                   ) -> Dict[Tuple[str, str, str],
+                                             List[str]]:
+    """Free matching instance ids per device GROUP given the current
+    allocs — device asks must be satisfied within a single group
+    (solve.py _assign_devices), so callers look at the per-group max,
+    not a cross-group sum."""
+    from ..structs.devices import DeviceAccounter
+    acct = DeviceAccounter(node)
+    acct.add_allocs(allocs)
+    out: Dict[Tuple[str, str, str], List[str]] = {}
+    for dev in node.node_resources.devices:
+        if ask.matches(*dev.id_tuple()):
+            out[dev.id_tuple()] = acct.free_instances(*dev.id_tuple())
+    return out
+
+
+def find_preemption(node: Node, proposed: Sequence[Allocation], job,
+                    tg) -> Optional[List[Allocation]]:
+    """Full preemption pass for one (node, task group): task-group
+    resources first, then network asks, then device asks — each pass
+    only runs when the group actually requests that dimension, and later
+    passes see earlier victims as already evicted (the reference runs
+    the analogous passes inside BinPackIterator as each dimension fails:
+    PreemptForTaskGroup :198, PreemptForNetwork :270,
+    PreemptForDevice :472)."""
+    from ..solver.tensorize import group_resource_vector
+
+    from ..structs import (AllocatedResources, AllocatedTaskResources,
+                           NetworkResource)
+
+    vec = group_resource_vector(tg)
+    victims = list(pick_victims(node, proposed, job.priority,
+                                float(vec[0]), float(vec[1]),
+                                float(vec[2]), float(vec[3])) or [])
+    victim_ids = {v.id for v in victims}
+    remaining = [a for a in proposed if a.id not in victim_ids]
+
+    # The group's OWN earlier asks consume capacity the later passes
+    # must see: modelled as a job-less in-flight alloc (counts toward
+    # usage, never a victim) that grows as asks are processed.
+    pending_nets: List[NetworkResource] = []
+    net_asks = list(tg.networks)
+    for t in tg.tasks:
+        net_asks.extend(t.resources.networks)
+    for net in net_asks:
+        if not (net.mbits or net.reserved_ports):
+            continue
+        probe_pool = list(remaining)
+        if pending_nets:
+            probe_pool.append(Allocation(
+                id="_pending", allocated_resources=AllocatedResources(
+                    tasks={"_pending": AllocatedTaskResources(
+                        networks=list(pending_nets))})))
+        nv = preempt_for_network(job.priority, probe_pool, net, node)
+        if nv:
+            victims.extend(nv)
+            victim_ids |= {v.id for v in nv}
+            remaining = [a for a in remaining if a.id not in victim_ids]
+        pending_nets.append(NetworkResource(
+            device=net.device or "", mbits=net.mbits,
+            reserved_ports=list(net.reserved_ports)))
+
+    pending_dev = 0        # instances asked so far by this group
+    for t in tg.tasks:
+        for d in t.resources.devices:
+            need = int(d.count) + pending_dev
+            free_by_grp = free_device_instances_by_group(
+                node, remaining, d)
+            if any(len(f) >= need for f in free_by_grp.values()):
+                pending_dev += int(d.count)
+                continue
+            dv = preempt_for_device(job.priority, remaining, d, node,
+                                    extra_needed=pending_dev)
+            if dv:
+                victims.extend(dv)
+                victim_ids |= {v.id for v in dv}
+                remaining = [a for a in remaining
+                             if a.id not in victim_ids]
+            pending_dev += int(d.count)
+    return victims or None
+
+
 def preemption_enabled(config, sched_type: str) -> bool:
     if config is None:
         return sched_type == "system"

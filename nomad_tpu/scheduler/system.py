@@ -254,7 +254,7 @@ class SystemScheduler:
                            if not a.terminal_status()
                            and a.id not in stopped]
 
-        from .preemption import pick_victims, preemption_enabled
+        from .preemption import find_preemption, preemption_enabled
         preempt_ok = preemption_enabled(snapshot.scheduler_config(), "system")
 
         now = _time.time()
@@ -272,6 +272,25 @@ class SystemScheduler:
             resources = self.solver._host_commit(
                 node, i, PlacementAsk(job=self.job, tg=tg, count=1),
                 {}, {}, usage)
+            victims = None
+            if resources is None and preempt_ok:
+                # ports / bandwidth / device instances exhausted: try
+                # evicting lower-priority holders and re-commit
+                victims = find_preemption(node, usage[node.id],
+                                          self.job, tg)
+                if victims:
+                    victim_ids = {v.id for v in victims}
+                    trial_usage = dict(usage)
+                    trial_usage[node.id] = [a for a in usage[node.id]
+                                            if a.id not in victim_ids]
+                    resources = self.solver._host_commit(
+                        node, i, PlacementAsk(job=self.job, tg=tg,
+                                              count=1),
+                        {}, {}, trial_usage)
+                    if resources is not None:
+                        usage[node.id] = trial_usage[node.id]
+                    else:
+                        victims = None
             if resources is None:
                 metric.exhausted_node(node.id, node.computed_class, "network")
                 self._record_failure(tg, metric)
@@ -280,14 +299,9 @@ class SystemScheduler:
             probe = Allocation(id="probe", task_group=tg.name,
                                allocated_resources=resources)
             fit, dim, used = allocs_fit(node, usage[node.id] + [probe])
-            victims = None
-            if not fit and preempt_ok:
-                from ..solver.tensorize import group_resource_vector
-                vec = group_resource_vector(tg)
-                victims = pick_victims(node, usage[node.id],
-                                       self.job.priority, float(vec[0]),
-                                       float(vec[1]), float(vec[2]),
-                                       float(vec[3]))
+            if not fit and preempt_ok and victims is None:
+                victims = find_preemption(node, usage[node.id],
+                                          self.job, tg)
                 if victims:
                     victim_ids = {v.id for v in victims}
                     trial = [a for a in usage[node.id]

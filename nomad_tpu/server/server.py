@@ -157,6 +157,12 @@ class Server:
 
     def stop(self) -> None:
         self._revoke_leadership()
+        # join workers so no straggler proposes after stop() returns (a
+        # mid-eval worker would otherwise race the caller's view of the
+        # final state)
+        for w in self.workers:
+            if w.is_alive():
+                w.join(timeout=5.0)
         self.raft.stop()
 
     def _revoke_leadership(self) -> None:

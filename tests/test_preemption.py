@@ -138,3 +138,221 @@ def test_system_preemption_default_on():
     placed = h.store.allocs_by_job("default", sysjob.id)
     assert len(placed) == 1
     assert placed[0].preempted_allocations == [low_alloc.id]
+
+
+# ------------------------- network preemption (preemption.go:270) ----
+
+from nomad_tpu.scheduler.preemption import (find_preemption,
+                                            preempt_for_device,
+                                            preempt_for_network)
+from nomad_tpu.structs import (NetworkResource, NodeDevice,
+                               NodeDeviceResource, Port, RequestedDevice)
+
+
+def net_node(mbits=1000):
+    n = mock.node()
+    n.node_resources.networks = [NetworkResource(
+        device="eth0", ip=n.node_resources.networks[0].ip
+        if n.node_resources.networks else "192.168.0.10", cidr="",
+        mbits=mbits)]
+    return n
+
+
+def net_occupant(node, priority, mbits, ports=()):
+    a = occupant(node, priority)
+    a.allocated_resources.tasks["web"].networks = [NetworkResource(
+        device="eth0", ip="192.168.0.10", mbits=mbits,
+        reserved_ports=[Port(label=f"p{v}", value=v) for v in ports])]
+    return a
+
+
+def test_network_preemption_closest_mbits_victim():
+    node = net_node(mbits=1000)
+    a300 = net_occupant(node, priority=20, mbits=300)
+    a500 = net_occupant(node, priority=20, mbits=500)
+    ask = NetworkResource(mbits=500)
+    victims = preempt_for_network(70, [a300, a500], ask, node)
+    # free = 200; the 500-mbit alloc is distance 0 from the ask and
+    # alone satisfies it — the 300 alloc must not be evicted
+    assert victims is not None
+    assert [v.id for v in victims] == [a500.id]
+
+
+def test_network_preemption_frees_reserved_port_holder():
+    node = net_node(mbits=1000)
+    holder = net_occupant(node, priority=20, mbits=50, ports=(8080,))
+    ask = NetworkResource(mbits=10,
+                          reserved_ports=[Port(label="http", value=8080)])
+    victims = preempt_for_network(70, [holder], ask, node)
+    # bandwidth is plentiful, but the needed reserved port is held —
+    # its holder is the victim
+    assert victims is not None and victims[0].id == holder.id
+
+
+def test_network_preemption_blocked_by_higher_priority_port_holder():
+    node = net_node(mbits=1000)
+    holder = net_occupant(node, priority=65, mbits=50, ports=(8080,))
+    other = net_occupant(node, priority=20, mbits=100)
+    ask = NetworkResource(mbits=10,
+                          reserved_ports=[Port(label="http", value=8080)])
+    # priority delta vs holder is 5 < 10: the port cannot be freed, so
+    # the device (and the whole pass) yields nothing
+    assert preempt_for_network(70, [holder, other], ask, node) is None
+
+
+def test_network_preemption_lowest_priority_first():
+    node = net_node(mbits=1000)
+    lo = net_occupant(node, priority=10, mbits=400)
+    mid = net_occupant(node, priority=40, mbits=400)
+    ask = NetworkResource(mbits=500)
+    victims = preempt_for_network(70, [lo, mid], ask, node)
+    # free = 200; evicting the priority-10 alloc first (400 + 200 >=
+    # 500) suffices; the priority-40 alloc survives
+    assert victims is not None
+    assert [v.id for v in victims] == [lo.id]
+
+
+# ------------------------- device preemption (preemption.go:472) -----
+
+def dev_node(groups):
+    """groups: list of (model, n_instances)."""
+    n = mock.node()
+    n.node_resources.cpu = 100000
+    n.node_resources.memory_mb = 100000
+    n.node_resources.devices = [
+        NodeDeviceResource(vendor="google", type="tpu", name=model,
+                           instances=[NodeDevice(id=f"{model}-{i}",
+                                                 healthy=True)
+                                      for i in range(count)])
+        for model, count in groups]
+    return n
+
+
+def dev_occupant(node, priority, model, instance_ids):
+    a = occupant(node, priority, cpu=100, mem=64)
+    a.allocated_resources.tasks["web"].devices = [
+        structs.AllocatedDeviceResource(
+            vendor="google", type="tpu", name=model,
+            device_ids=list(instance_ids))]
+    return a
+
+
+def test_device_preemption_lowest_priority_until_count():
+    node = dev_node([("v4", 4)])
+    a1 = dev_occupant(node, 20, "v4", ["v4-0", "v4-1"])
+    a2 = dev_occupant(node, 30, "v4", ["v4-2"])
+    a3 = dev_occupant(node, 40, "v4", ["v4-3"])
+    ask = RequestedDevice(name="google/tpu/v4", count=2)
+    victims = preempt_for_device(70, [a1, a2, a3], ask, node)
+    # priority 20 alone frees 2 instances; higher-priority allocs stay
+    assert victims is not None
+    assert [v.id for v in victims] == [a1.id]
+
+
+def test_device_preemption_picks_lowest_net_priority_group():
+    node = dev_node([("v4", 2), ("v5", 2)])
+    # freeing 2 on v4 costs two jobs (prio 20 + 30); on v5 one (prio 10)
+    a1 = dev_occupant(node, 20, "v4", ["v4-0"])
+    a2 = dev_occupant(node, 30, "v4", ["v4-1"])
+    b1 = dev_occupant(node, 10, "v5", ["v5-0", "v5-1"])
+    ask = RequestedDevice(name="google/tpu", count=2)
+    victims = preempt_for_device(70, [a1, a2, b1], ask, node)
+    assert victims is not None
+    assert [v.id for v in victims] == [b1.id]
+
+
+def test_device_preemption_counts_existing_free_instances():
+    node = dev_node([("v4", 4)])
+    a1 = dev_occupant(node, 20, "v4", ["v4-0"])
+    a2 = dev_occupant(node, 30, "v4", ["v4-1"])
+    ask = RequestedDevice(name="google/tpu/v4", count=3)
+    victims = preempt_for_device(70, [a1, a2], ask, node)
+    # 2 instances already free: evicting only the priority-20 alloc
+    # reaches 3
+    assert victims is not None
+    assert [v.id for v in victims] == [a1.id]
+
+
+def test_find_preemption_combines_dimensions():
+    node = dev_node([("v4", 2)])
+    node.node_resources.networks = [NetworkResource(
+        device="eth0", ip="192.168.0.10", mbits=1000)]
+    dv = dev_occupant(node, 20, "v4", ["v4-0", "v4-1"])
+    job = mock.job(priority=70)
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.devices = [
+        RequestedDevice(name="google/tpu/v4", count=1)]
+    victims = find_preemption(node, [dv], job, tg)
+    assert victims is not None and victims[0].id == dv.id
+
+
+# ------------------------- best-node selection ----------------------
+
+def test_generic_preemption_places_on_best_scoring_node():
+    h = Harness()
+    h.store.set_scheduler_config(
+        h.next_index(), SchedulerConfiguration(preemption_service=True))
+    # two identical nodes, both full of low-priority work; node B keeps
+    # a small high-priority filler, so after eviction B is fuller ->
+    # higher bin-pack score; placement must choose B no matter the node
+    # iteration order
+    node_a, node_b = small_node(), small_node()
+    h.store.upsert_node(h.next_index(), node_a)
+    h.store.upsert_node(h.next_index(), node_b)
+    occ_a = occupant(node_a, priority=10, cpu=1100, mem=900)
+    occ_b = occupant(node_b, priority=10, cpu=1000, mem=850)
+    filler_b = occupant(node_b, priority=70, cpu=100, mem=64)
+    h.store.upsert_allocs(h.next_index(), [occ_a, occ_b, filler_b])
+
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 1000
+    job.task_groups[0].tasks[0].resources.memory_mb = 512
+    job.task_groups[0].tasks[0].resources.networks = []
+    h.store.upsert_job(h.next_index(), job)
+    ev = mock.eval_(job_id=job.id, priority=70,
+                    triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER)
+    h.process("service", ev)
+
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 1
+    assert placed[0].node_id == node_b.id
+    assert placed[0].preempted_allocations == [occ_b.id]
+
+
+def test_find_preemption_accounts_own_earlier_network_asks():
+    # eth0: 1000 mbits fully used by four preemptible 250-mbit allocs;
+    # the group has TWO tasks each asking 500 — victims must free 1000,
+    # not 500 (the second pass sees the first ask's pending consumption)
+    node = net_node(mbits=1000)
+    occs = [net_occupant(node, priority=10, mbits=250) for _ in range(4)]
+    job = mock.job(priority=70)
+    tg = job.task_groups[0]
+    t0 = tg.tasks[0]
+    import copy
+    t1 = copy.deepcopy(t0)
+    t1.name = "web2"
+    tg.tasks = [t0, t1]
+    for t in tg.tasks:
+        t.resources.networks = [NetworkResource(mbits=500)]
+        t.resources.devices = []
+    victims = find_preemption(node, occs, job, tg)
+    assert victims is not None
+    assert len(victims) == 4
+
+
+def test_find_preemption_device_free_counted_per_group():
+    # v4 has 1 free + 1 held-by-preemptible; v5 has 1 free. An ask for
+    # 2 'google/tpu' cannot use one from each group (assignment is
+    # single-group) — preemption must still fire and evict the v4 holder
+    node = dev_node([("v4", 2), ("v5", 1)])
+    holder = dev_occupant(node, 10, "v4", ["v4-0"])
+    job = mock.job(priority=70)
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.devices = [
+        RequestedDevice(name="google/tpu", count=2)]
+    victims = find_preemption(node, [holder], job, tg)
+    assert victims is not None
+    assert [v.id for v in victims] == [holder.id]
