@@ -1,0 +1,114 @@
+"""Pooled RPC client.
+
+Reference: helper/pool ConnPool — persistent connections per server,
+reused across requests. One in-flight request per pooled connection;
+concurrent callers draw distinct sockets.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .wire import recv_frame, send_frame
+
+DIAL_TIMEOUT_S = 0.5
+CALL_TIMEOUT_S = 30.0           # > blocking-query timeouts
+
+
+class RpcError(Exception):
+    def __init__(self, kind: str, message: str = "",
+                 data: Optional[Dict[str, Any]] = None):
+        super().__init__(f"{kind}: {message}" if message else kind)
+        self.kind = kind
+        self.message = message
+        self.data = data or {}
+
+
+class RpcClient:
+    def __init__(self, addr: Tuple[str, int], pool_size: int = 4):
+        self.addr = (addr[0], int(addr[1]))
+        self._pool: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._pool_size = pool_size
+
+    def call(self, method: str, params: List[Any],
+             timeout: float = CALL_TIMEOUT_S) -> Any:
+        """One request/response. Raises RpcError for typed application
+        errors and ConnectionError for transport failures."""
+        sock = self._checkout()
+        try:
+            sock.settimeout(timeout)
+            send_frame(sock, {"id": next(self._ids), "method": method,
+                              "params": params})
+            resp = recv_frame(sock)
+        except (OSError, ValueError) as e:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"rpc to {self.addr}: {e}") from e
+        self._checkin(sock)
+        err = resp.get("error")
+        if err is not None:
+            raise RpcError(err.get("kind", "error"),
+                           err.get("message", ""), err.get("data"))
+        return resp.get("result")
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._pool:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._pool.clear()
+
+    # ------------------------------------------------------------------
+    def _checkout(self) -> socket.socket:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        sock = socket.create_connection(self.addr,
+                                        timeout=DIAL_TIMEOUT_S)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class ClientPool:
+    """Keyed RpcClient pool shared by the raft transport and the server
+    endpoints; replacing a key's address closes the old client."""
+
+    def __init__(self):
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str, addr: Tuple[str, int]) -> RpcClient:
+        addr = (addr[0], int(addr[1]))
+        with self._lock:
+            c = self._clients.get(key)
+            if c is None or c.addr != addr:
+                if c is not None:
+                    c.close()
+                c = RpcClient(addr)
+                self._clients[key] = c
+            return c
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()

@@ -1,0 +1,122 @@
+"""Threaded RPC server: dispatches framed requests to named handlers.
+
+Reference: nomad/rpc.go handleConn/handleNomadConn — a goroutine per
+connection decoding requests and dispatching to registered endpoints.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .wire import recv_frame, send_frame
+
+_log = logging.getLogger(__name__)
+
+
+class RpcServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: Dict[str, Callable[[List[Any]], Any]] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.addr: Tuple[str, int] = self._sock.getsockname()
+        self._shutdown = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def register(self, method: str,
+                 fn: Callable[[List[Any]], Any]) -> None:
+        """fn receives the params list and returns a JSON-able result;
+        raising RpcHandlerError sends a typed error frame."""
+        self._handlers[method] = fn
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.addr[1]}")
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    req = recv_frame(conn)
+                except (ConnectionError, ValueError, OSError):
+                    return
+                try:
+                    resp = self._dispatch(req)
+                    send_frame(conn, resp)
+                except OSError:
+                    return
+                except Exception:               # noqa: BLE001
+                    # malformed request shape or unserializable handler
+                    # result: answer with a typed error instead of
+                    # killing the connection
+                    _log.exception("rpc dispatch failed")
+                    try:
+                        rid = req.get("id") if isinstance(req, dict) \
+                            else None
+                        send_frame(conn, {"id": rid, "error": {
+                            "kind": "internal",
+                            "message": "dispatch failed"}})
+                    except OSError:
+                        return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: Any) -> Any:
+        if not isinstance(req, dict):
+            return {"id": None, "error": {"kind": "bad_request",
+                                          "message": "frame is not an object"}}
+        rid = req.get("id")
+        method = req.get("method", "")
+        fn = self._handlers.get(method)
+        if fn is None:
+            return {"id": rid, "error": {"kind": "unknown_method",
+                                         "message": method}}
+        try:
+            return {"id": rid, "result": fn(req.get("params", []))}
+        except RpcHandlerError as e:
+            return {"id": rid, "error": e.wire()}
+        except Exception as e:                      # noqa: BLE001
+            _log.exception("rpc handler %s failed", method)
+            return {"id": rid, "error": {"kind": "internal",
+                                         "message": f"{type(e).__name__}: {e}"}}
+
+
+class RpcHandlerError(Exception):
+    """Typed application error carried over the wire (e.g. not_leader
+    with a forwarding hint)."""
+
+    def __init__(self, kind: str, message: str = "",
+                 data: Optional[Dict[str, Any]] = None):
+        super().__init__(message or kind)
+        self.kind = kind
+        self.message = message
+        self.data = data or {}
+
+    def wire(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "message": self.message,
+                "data": self.data}

@@ -1,0 +1,127 @@
+"""Raft peer transport over the RPC substrate.
+
+Reference: nomad/raft_rpc.go — raft gets its own stream family on the
+shared listener. Here the raft verbs register as `raft.*` methods on
+the server's RpcServer, and `call` dials peers through pooled clients.
+Implements the same surface as raft.node.InProcTransport, so RaftNode
+is transport-agnostic.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Tuple
+
+from ..utils.codec import from_wire, to_wire
+from .client import ClientPool, RpcError
+from .server import RpcHandlerError, RpcServer
+
+_log = logging.getLogger(__name__)
+
+# raft verbs must fail FAST on dead peers: the replication loop is
+# sequential and the election timeout is 150-300ms, so a blocking dial
+# would destabilize the healthy majority. A failed peer backs off
+# exponentially (capped) before the next dial attempt.
+RAFT_CALL_TIMEOUT_S = 2.0
+BACKOFF_BASE_S = 0.25
+BACKOFF_MAX_S = 5.0
+
+
+class TcpRaftTransport:
+    def __init__(self, rpc_server: RpcServer,
+                 peer_addrs: Dict[str, Tuple[str, int]]):
+        """peer_addrs: raft node id -> (host, port) of that peer's
+        RpcServer (including this node's own)."""
+        self.rpc_server = rpc_server
+        self.peer_addrs = dict(peer_addrs)
+        self._pool = ClientPool()
+        self._lock = threading.Lock()
+        self._local: Dict[str, Any] = {}
+        self._backoff: Dict[str, Tuple[float, int]] = {}  # until, fails
+
+    # -- the InProcTransport surface ----------------------------------
+    def register(self, node) -> None:
+        self._local[node.id] = node
+
+        def handler(params, _v, _n):
+            # the InProcTransport contract: a stopped (or replaced) node
+            # is unreachable — it must not vote or ACK appends, or a
+            # leader could count a non-durable ACK toward majority
+            if not _n.running or self._local.get(_n.id) is not _n:
+                raise RpcHandlerError("unreachable",
+                                      f"raft node {_n.id} not running")
+            return _to_jsonable(getattr(_n, _v)(*_decode_args(_v, params)))
+
+        for verb in ("rpc_request_vote", "rpc_append_entries",
+                     "rpc_install_snapshot"):
+            self.rpc_server.register(
+                f"raft.{verb}",
+                lambda params, _v=verb, _n=node: handler(params, _v, _n))
+
+    def unregister(self, node_id: str) -> None:
+        self._local.pop(node_id, None)
+
+    def call(self, target: str, method: str, *args):
+        local = self._local.get(target)
+        if local is not None:
+            if not local.running:
+                raise ConnectionError(f"peer {target} unreachable")
+            return getattr(local, method)(*args)
+        addr = self.peer_addrs.get(target)
+        if addr is None:
+            raise ConnectionError(f"no address for peer {target}")
+        now = time.monotonic()
+        with self._lock:
+            until, fails = self._backoff.get(target, (0.0, 0))
+            if now < until:
+                raise ConnectionError(f"peer {target} backing off")
+        client = self._pool.get(target, addr)
+        try:
+            out = client.call(f"raft.{method}",
+                              _encode_args(method, list(args)),
+                              timeout=RAFT_CALL_TIMEOUT_S)
+        except RpcError as e:
+            raise ConnectionError(f"peer {target}: {e}") from e
+        except ValueError as e:
+            # oversized frame (giant snapshot): every retry will fail the
+            # same way — make the wedge loud instead of silent
+            _log.error("raft %s to %s exceeds the frame limit: %s",
+                       method, target, e)
+            raise ConnectionError(f"peer {target}: {e}") from e
+        except ConnectionError:
+            with self._lock:
+                _until, fails = self._backoff.get(target, (0.0, 0))
+                delay = min(BACKOFF_BASE_S * (2 ** fails), BACKOFF_MAX_S)
+                self._backoff[target] = (time.monotonic() + delay,
+                                         fails + 1)
+            raise
+        with self._lock:
+            self._backoff.pop(target, None)
+        return _decode_result(method, out)
+
+
+# bytes (snapshot payloads) ride the codec's base64 envelope; everything
+# else in the raft verbs is already JSON-able (entries are tuples of
+# JSON payloads)
+def _encode_args(method: str, args):
+    return [to_wire(a) if isinstance(a, bytes) else a for a in args]
+
+
+def _decode_args(method: str, params):
+    return [from_wire(bytes, p)
+            if isinstance(p, dict) and "__b64__" in p else p
+            for p in params]
+
+
+def _to_jsonable(result):
+    if isinstance(result, tuple):
+        return list(result)
+    return result
+
+
+def _decode_result(method: str, out):
+    # callers unpack fixed-arity tuples
+    if isinstance(out, list):
+        return tuple(out)
+    return out

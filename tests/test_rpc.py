@@ -1,0 +1,155 @@
+"""Wire RPC: framing, request/response, TCP raft cluster, agent over
+the wire with leader forwarding and failover (reference: nomad/rpc.go +
+client/servers/ tested against in-process sockets)."""
+import socket
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.rpc import (RpcClient, RpcError, RpcServer,
+                           RpcServerEndpoints)
+from nomad_tpu.rpc.endpoints import serve_cluster
+from nomad_tpu.rpc.server import RpcHandlerError
+from nomad_tpu.rpc.wire import recv_frame, send_frame
+
+
+# ------------------------------------------------------------- wire
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    msg = {"id": 1, "method": "X.Y", "params": [1, "two", {"k": [3]}]}
+    send_frame(a, msg)
+    assert recv_frame(b) == msg
+    a.close(), b.close()
+
+
+# ------------------------------------------------------ client/server
+def test_rpc_call_and_errors():
+    srv = RpcServer()
+    srv.register("Echo.Upper", lambda p: p[0].upper())
+
+    def boom(_p):
+        raise RpcHandlerError("teapot", "short and stout", {"n": 1})
+    srv.register("Echo.Boom", boom)
+    srv.register("Echo.Crash", lambda p: 1 / 0)
+    srv.start()
+    try:
+        c = RpcClient(srv.addr)
+        assert c.call("Echo.Upper", ["hi"]) == "HI"
+        with pytest.raises(RpcError) as ei:
+            c.call("Echo.Boom", [])
+        assert ei.value.kind == "teapot" and ei.value.data == {"n": 1}
+        with pytest.raises(RpcError) as ei:
+            c.call("Echo.Crash", [])
+        assert ei.value.kind == "internal"
+        with pytest.raises(RpcError) as ei:
+            c.call("No.Such", [])
+        assert ei.value.kind == "unknown_method"
+        # pooled connection reuse across calls
+        assert c.call("Echo.Upper", ["again"]) == "AGAIN"
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------- TCP raft cluster
+def rawexec_job(args, count=1):
+    j = mock.job()
+    j.task_groups[0].count = count
+    task = j.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": args}
+    task.resources.networks = []
+    return j
+
+
+def test_tcp_cluster_election_forwarding_agent_failover(tmp_path):
+    servers, rpcs, addrs = serve_cluster(3)
+    try:
+        assert wait_until(
+            lambda: any(s.is_leader() for s in servers), timeout=10)
+        leader_ix = next(i for i, s in enumerate(servers)
+                         if s.is_leader())
+        follower_ix = (leader_ix + 1) % 3
+
+        # a job registered THROUGH A FOLLOWER's RPC lands via forwarding
+        ep_follower = RpcServerEndpoints(
+            [rpcs[follower_ix].rpc.addr])
+        job = rawexec_job(["-c", "sleep 60"])
+        ep_follower.register_job(job)
+        assert wait_until(
+            lambda: servers[leader_ix].store.job_by_id(
+                "default", job.id) is not None, timeout=5)
+
+        # the agent speaks ONLY the wire protocol, to all three servers
+        ep = RpcServerEndpoints([r.rpc.addr for r in rpcs])
+        client = Client(ep, data_dir=str(tmp_path))
+        client.start()
+        try:
+            assert wait_until(lambda: len(
+                [a for a in servers[leader_ix].store.allocs_by_job(
+                    "default", job.id)
+                 if a.client_status == structs.ALLOC_CLIENT_RUNNING]
+            ) == 1, timeout=20), "task did not run over the wire"
+
+            # kill the leader: a follower takes over; the agent keeps
+            # heartbeating and new work still schedules
+            servers[leader_ix].stop()
+            rpcs[leader_ix].rpc.stop()
+            rest = [s for i, s in enumerate(servers) if i != leader_ix]
+            assert wait_until(
+                lambda: any(s.is_leader() for s in rest), timeout=15)
+            new_leader = next(s for s in rest if s.is_leader())
+
+            job2 = rawexec_job(["-c", "sleep 60"])
+            ep.register_job(job2)
+            assert wait_until(lambda: len(
+                [a for a in new_leader.store.allocs_by_job(
+                    "default", job2.id)
+                 if a.client_status == structs.ALLOC_CLIENT_RUNNING]
+            ) == 1, timeout=25), "no placement after failover"
+        finally:
+            client.shutdown(halt_tasks=True)
+    finally:
+        for i, s in enumerate(servers):
+            try:
+                s.stop()
+            except Exception:
+                pass
+            rpcs[i].rpc.stop()
+
+
+def test_wire_blocking_query_fires_on_new_alloc():
+    servers, rpcs, addrs = serve_cluster(1)
+    try:
+        srv = servers[0]
+        assert wait_until(srv.is_leader, timeout=5)
+        ep = RpcServerEndpoints([rpcs[0].rpc.addr])
+        node = mock.node()
+        node.attributes["driver.raw_exec"] = "1"
+        node.compute_class()
+        ep.register_node(node)
+        ttl = ep.node_heartbeat(node.id)
+        assert ttl and ttl > 0
+
+        # long-poll in the background; a placement must wake it
+        import threading
+        got = {}
+
+        def poll():
+            allocs, index = ep.get_client_allocs(node.id, 0, 45.0)
+            got["allocs"], got["index"] = allocs, index
+        t = threading.Thread(target=poll)
+        t.start()
+        job = rawexec_job(["-c", "sleep 5"])
+        ep.register_job(job)
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert got["index"] > 0
+        assert [a.job_id for a in got["allocs"]] == [job.id]
+    finally:
+        for i, s in enumerate(servers):
+            s.stop()
+            rpcs[i].rpc.stop()
