@@ -62,6 +62,11 @@ class RpcServer:
                     req = recv_frame(conn)
                 except (ConnectionError, ValueError, OSError):
                     return
+                # a stopped server must not answer a request that raced
+                # the shutdown (callers probe liveness through these
+                # sockets — e.g. the gossip failure detector)
+                if self._shutdown.is_set():
+                    return
                 try:
                     resp = self._dispatch(req)
                     send_frame(conn, resp)

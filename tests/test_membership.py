@@ -1,0 +1,137 @@
+"""Gossip membership + region routing (reference: nomad/serf.go events,
+memberlist SWIM probe/suspect/refute, rpc.go region forward)."""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.membership import GossipAgent, Member, RegionRouter
+from nomad_tpu.membership.gossip import (STATUS_ALIVE, STATUS_DEAD,
+                                         STATUS_LEFT)
+from nomad_tpu.rpc import RpcServer
+
+
+def make_agent(name, region="global", **kw):
+    rpc = RpcServer()
+    rpc.start()
+    agent = GossipAgent(Member(id=name, addr=rpc.addr, region=region),
+                        rpc, **kw)
+    return agent, rpc
+
+
+def stop_all(pairs):
+    for agent, rpc in pairs:
+        agent.stop()
+        rpc.stop()
+
+
+def test_gossip_converges_to_full_membership():
+    pairs = [make_agent(f"m{i}") for i in range(3)]
+    try:
+        for agent, _ in pairs:
+            agent.start()
+        # join through one seed only; gossip spreads the rest
+        pairs[1][0].join(pairs[0][0].me.addr)
+        pairs[2][0].join(pairs[0][0].me.addr)
+        assert wait_until(lambda: all(
+            len(agent.members(alive_only=True)) == 3
+            for agent, _ in pairs), timeout=10)
+    finally:
+        stop_all(pairs)
+
+
+def test_probe_marks_dead_member_and_fires_event():
+    failed = []
+    pairs = [make_agent(f"f{i}") for i in range(3)]
+    pairs[0][0].on_fail = lambda m: failed.append(m.id)
+    try:
+        for agent, _ in pairs:
+            agent.start()
+        pairs[1][0].join(pairs[0][0].me.addr)
+        pairs[2][0].join(pairs[0][0].me.addr)
+        assert wait_until(lambda: all(
+            len(agent.members(alive_only=True)) == 3
+            for agent, _ in pairs), timeout=10)
+        # hard-kill f2 (no graceful leave)
+        dead_id = pairs[2][0].me.id
+        pairs[2][0].stop()
+        pairs[2][1].stop()
+        assert wait_until(lambda: (
+            pairs[0][0].member(dead_id) is not None
+            and pairs[0][0].member(dead_id).status == STATUS_DEAD),
+            timeout=15)
+        assert dead_id in failed
+    finally:
+        stop_all(pairs)
+
+
+def test_graceful_leave_is_not_a_failure():
+    failed = []
+    pairs = [make_agent(f"l{i}") for i in range(2)]
+    pairs[0][0].on_fail = lambda m: failed.append(m.id)
+    try:
+        for agent, _ in pairs:
+            agent.start()
+        pairs[1][0].join(pairs[0][0].me.addr)
+        assert wait_until(lambda: len(
+            pairs[0][0].members(alive_only=True)) == 2, timeout=10)
+        left_id = pairs[1][0].me.id
+        pairs[1][0].leave()
+        pairs[1][1].stop()
+        assert wait_until(lambda: (
+            pairs[0][0].member(left_id).status == STATUS_LEFT),
+            timeout=10)
+        time.sleep(0.5)
+        assert left_id not in failed
+    finally:
+        stop_all(pairs)
+
+
+def test_refute_own_death():
+    a, rpc_a = make_agent("r0")
+    try:
+        # another member claims we are dead at our current incarnation
+        claim = Member(id="r0", addr=a.me.addr, status=STATUS_DEAD,
+                       incarnation=a.me.incarnation)
+        a._merge(claim)
+        assert a.me.status == STATUS_ALIVE
+        assert a.me.incarnation > claim.incarnation
+    finally:
+        a.stop()
+        rpc_a.stop()
+
+
+def test_region_routing_cross_region_job_register():
+    from nomad_tpu.rpc.endpoints import serve_cluster
+    servers_a, rpcs_a, _ = serve_cluster(1)
+    servers_b, rpcs_b, _ = serve_cluster(1)
+    gossips = []
+    router = None
+    try:
+        # one gossip member per region server, sharing its RpcServer
+        ga = GossipAgent(Member(id="ga", addr=rpcs_a[0].rpc.addr,
+                                region="alpha"), rpcs_a[0].rpc)
+        gb = GossipAgent(Member(id="gb", addr=rpcs_b[0].rpc.addr,
+                                region="beta"), rpcs_b[0].rpc)
+        gossips = [ga, gb]
+        ga.start()
+        gb.start()
+        gb.join(ga.me.addr)
+        assert wait_until(lambda: set(ga.regions()) ==
+                          {"alpha", "beta"}, timeout=10)
+
+        router = RegionRouter(ga)
+        job = mock.job()
+        from nomad_tpu.utils.codec import to_wire
+        router.call_region("beta", "Job.Register", [to_wire(job)])
+        assert wait_until(lambda: servers_b[0].store.job_by_id(
+            "default", job.id) is not None, timeout=5)
+        # and it did NOT land in region alpha
+        assert servers_a[0].store.job_by_id("default", job.id) is None
+    finally:
+        if router is not None:
+            router.close()
+        for g in gossips:
+            g.stop()
+        for s, r in ((servers_a[0], rpcs_a[0]), (servers_b[0], rpcs_b[0])):
+            s.stop()
+            r.rpc.stop()
