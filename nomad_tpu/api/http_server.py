@@ -296,8 +296,13 @@ class HTTPAgentServer:
         ann = None
         if planner.plans and planner.plans[-1].annotations is not None:
             ann = to_wire(planner.plans[-1].annotations)
+        diff = None
+        if body.get("diff", True):
+            from ..structs.diff import job_diff
+            diff = job_diff(current, job)
         return 200, {
             "annotations": ann,
+            "diff": diff,
             "created_evals": [to_wire(e) for e in planner.evals],
             "diff_seen_index": snap_index,
             "error": str(planner_err) if planner_err else "",
@@ -471,6 +476,55 @@ class HTTPAgentServer:
         self.server.force_gc()
         return 200, {}, None
 
+    def search(self, q, body, *groups):
+        """Prefix search over the ID spaces (reference:
+        nomad/search_endpoint.go)."""
+        if not body or "prefix" not in body:
+            raise HTTPError(400, "body must carry 'prefix'")
+        from ..server.search import search as do_search
+        try:
+            matches, truncations = do_search(
+                self.server.store, body["prefix"],
+                body.get("context", "all") or "all",
+                namespace=body.get("namespace", "default"))
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return 200, {"matches": matches,
+                     "truncations": truncations}, \
+            self.server.store.latest_index()
+
+    def volumes_list(self, q, body):
+        ns = q.get("namespace", "default")
+        vols = self.server.store.csi_volumes(ns)
+        return 200, [to_wire(v) for v in vols], \
+            self.server.store.latest_index()
+
+    def volume_get(self, q, body, vol_id):
+        ns = q.get("namespace", "default")
+        v = self.server.store.csi_volume_by_id(ns, vol_id)
+        if v is None:
+            raise HTTPError(404, f"volume {vol_id} not found")
+        return 200, to_wire(v), self.server.store.latest_index()
+
+    def volume_register(self, q, body, vol_id):
+        from ..structs import CSIVolume
+        if not body:
+            raise HTTPError(400, "body must carry the volume")
+        vol = from_wire(CSIVolume, body.get("volume", body))
+        vol.id = vol_id
+        if "namespace" in q:
+            vol.namespace = q["namespace"]
+        index = self.server.register_csi_volume(vol)
+        return 200, {"index": index}, index
+
+    def volume_delete(self, q, body, vol_id):
+        ns = q.get("namespace", "default")
+        try:
+            index = self.server.deregister_csi_volume(ns, vol_id)
+        except ValueError as e:
+            raise HTTPError(409, str(e))
+        return 200, {"index": index}, index
+
     def operator_scheduler_config(self, q, body):
         cfg = self.server.store.scheduler_config()
         return 200, to_wire(cfg), None
@@ -529,4 +583,10 @@ def _build_routes(s: HTTPAgentServer):
                                  "POST": s.system_gc}),
         (R(r"^/v1/operator/scheduler/configuration$"),
          {"GET": s.operator_scheduler_config}),
+        (R(r"^/v1/search$"), {"POST": s.search, "PUT": s.search}),
+        (R(r"^/v1/volumes$"), {"GET": s.volumes_list}),
+        (R(r"^/v1/volume/csi/([^/]+)$"), {"GET": s.volume_get,
+                                          "PUT": s.volume_register,
+                                          "POST": s.volume_register,
+                                          "DELETE": s.volume_delete}),
     ]

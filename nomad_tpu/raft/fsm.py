@@ -126,6 +126,29 @@ class StateFSM:
         self.store.upsert_periodic_launch(index, p["namespace"],
                                           p["job_id"], p["launch"])
 
+    def _ap_csi_volume_upsert(self, index, p):
+        from ..structs import CSIVolume
+        self.store.upsert_csi_volume(index,
+                                     from_wire(CSIVolume, p["volume"]))
+
+    def _ap_csi_volume_delete(self, index, p):
+        try:
+            self.store.delete_csi_volume(index, p["namespace"],
+                                         p["volume_id"])
+        except ValueError:
+            pass    # in-use: deterministic no-op on every replica
+
+    def _ap_csi_volume_claim(self, index, p):
+        try:
+            self.store.claim_csi_volume(
+                index, p["namespace"], p["volume_id"], p["mode"],
+                p["alloc_id"], p["node_id"])
+        except (KeyError, ValueError):
+            pass    # validated by the proposer; tolerate races
+
+    def _ap_csi_claims_release(self, index, p):
+        self.store.release_csi_claims(index, p["alloc_id"])
+
     def _ap_scheduler_config(self, index, p):
         cfg = SchedulerConfiguration()
         cfg.__dict__.update(p["config"])
@@ -137,7 +160,7 @@ class StateFSM:
         "allocs": Allocation, "deployments": Deployment,
     }
     _TUPLE_KEY_TABLES = ("jobs", "job_versions", "job_summaries",
-                         "periodic_launches")
+                         "periodic_launches", "csi_volumes")
 
     def snapshot(self) -> bytes:
         """Serialize every replicated table (fsm.go:1189 Snapshot +
@@ -158,6 +181,9 @@ class StateFSM:
                 for k, v in st._t["job_summaries"].items()]
             tables["periodic_launches"] = [
                 [list(k), v] for k, v in st._t["periodic_launches"].items()]
+            tables["csi_volumes"] = [
+                [list(k), to_wire(v)]
+                for k, v in st._t["csi_volumes"].items()]
             tables["scheduler_config"] = [
                 [k, to_wire(v)] for k, v in st._t["scheduler_config"].items()]
             out["tables"] = tables
@@ -185,6 +211,9 @@ class StateFSM:
                 st._t["job_summaries"][tuple(k)] = s
             for k, launch in t.get("periodic_launches", ()):
                 st._t["periodic_launches"][tuple(k)] = launch
+            from ..structs import CSIVolume
+            for k, wire in t.get("csi_volumes", ()):
+                st._t["csi_volumes"][tuple(k)] = from_wire(CSIVolume, wire)
             for k, wire in t.get("scheduler_config", ()):
                 cfg = SchedulerConfiguration()
                 cfg.__dict__.update(wire)

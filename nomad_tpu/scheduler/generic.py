@@ -347,6 +347,28 @@ class GenericScheduler:
         ask_missing: List[List[_Missing]] = []
         for tg_name, ms in by_tg.items():
             tg = ms[0].tg
+            csi_err, csi_blocked = self._csi_state(snapshot, tg, nodes)
+            if csi_err is not None:
+                # a required CSI volume is missing or unclaimable: the
+                # group cannot place anywhere (reference:
+                # CSIVolumeChecker, feasible.go:194)
+                from ..structs import AllocMetric
+                metric = AllocMetric()
+                metric.constraint_filtered = {csi_err: len(nodes)}
+                metric.coalesced_failures = max(len(ms) - 1, 0)
+                self.failed_tg_allocs[tg.name] = metric
+                # a destructive update whose replacement cannot place
+                # must keep its old alloc running: retract the eager
+                # stops this group queued
+                for m in ms:
+                    if m.stop_previous and m.previous is not None:
+                        lst = self.plan.node_update.get(
+                            m.previous.node_id, [])
+                        self.plan.node_update[m.previous.node_id] = [
+                            a for a in lst if a.id != m.previous.id]
+                        if not self.plan.node_update[m.previous.node_id]:
+                            del self.plan.node_update[m.previous.node_id]
+                continue
             penalty = frozenset(
                 m.previous.node_id for m in ms
                 if m.reschedule and m.previous is not None)
@@ -357,10 +379,41 @@ class GenericScheduler:
             asks.append(PlacementAsk(
                 job=self.job, tg=tg, count=len(ms),
                 penalty_nodes=penalty, existing_by_node=existing,
-                distinct_hosts_blocked=blocked, spread_seed=spread_seed,
+                distinct_hosts_blocked=blocked | csi_blocked,
+                spread_seed=spread_seed,
                 property_limits=prop_limits))
             ask_missing.append(ms)
+        if not asks:
+            return None
         return nodes, by_dc, allocs_by_node, asks, ask_missing
+
+    def _csi_state(self, snapshot, tg, nodes):
+        """CSI volume feasibility (reference: CSIVolumeChecker,
+        feasible.go:194): every requested csi volume must exist, be
+        schedulable, and have write capacity for writable requests;
+        nodes not running the volume's plugin (healthy) are excluded
+        from placement. Returns (fatal_reason | None, blocked_node_ids)."""
+        vols = [(name, v) for name, v in tg.volumes.items()
+                if v.type == "csi"]
+        if not vols:
+            return None, frozenset()
+        blocked = set()
+        for name, req in vols:
+            vol = snapshot.csi_volume_by_id(self.job.namespace,
+                                            req.source)
+            if vol is None:
+                return f"missing CSI volume {req.source}", frozenset()
+            if not vol.schedulable:
+                return f"CSI volume {req.source} unschedulable", \
+                    frozenset()
+            if not req.read_only and not vol.write_free():
+                return (f"CSI volume {req.source} has exhausted its "
+                        "write claims"), frozenset()
+            for n in nodes:
+                info = n.csi_node_plugins.get(vol.plugin_id)
+                if info is None or not info.healthy:
+                    blocked.add(n.id)
+        return None, frozenset(blocked)
 
     def _consume_solve(self, snapshot, out, nodes, allocs_by_node,
                        missing: List[_Missing],

@@ -614,6 +614,36 @@ class Server:
         j.create_index = j.modify_index = j.job_modify_index = 0
         return self.register_job(j)
 
+    # -------------------------------------------------------- CSI volumes
+    def register_csi_volume(self, vol) -> int:
+        """CSIVolume.Register analog (nomad/csi_endpoint.go)."""
+        return self._propose("csi_volume_upsert", {"volume": to_wire(vol)})
+
+    def deregister_csi_volume(self, namespace: str, vol_id: str) -> int:
+        vol = self.store.csi_volume_by_id(namespace, vol_id)
+        if vol is not None and vol.in_use():
+            raise ValueError(f"volume {vol_id} is in use")
+        return self._propose("csi_volume_delete",
+                             {"namespace": namespace, "volume_id": vol_id})
+
+    def claim_csi_volume(self, namespace: str, vol_id: str, mode: str,
+                         alloc_id: str, node_id: str) -> int:
+        """CSIVolume.Claim analog: validated here (the plan applier is
+        the serialization point for placements), applied via raft."""
+        vol = self.store.csi_volume_by_id(namespace, vol_id)
+        if vol is None:
+            raise KeyError(f"volume {vol_id} not found")
+        from ..structs import CLAIM_WRITE
+        if mode == CLAIM_WRITE and not vol.write_free() \
+                and alloc_id not in vol.write_claims:
+            raise ValueError(f"volume {vol_id} has no free write claims")
+        return self._propose("csi_volume_claim", {
+            "namespace": namespace, "volume_id": vol_id, "mode": mode,
+            "alloc_id": alloc_id, "node_id": node_id})
+
+    def release_csi_claims(self, alloc_id: str) -> int:
+        return self._propose("csi_claims_release", {"alloc_id": alloc_id})
+
     # ----------------------------------------------------------- GC reaps
     def reap_evals(self, eval_ids: List[str], alloc_ids: List[str]) -> int:
         """Eval.Reap analog: delete evals + allocs in one apply."""
@@ -642,6 +672,37 @@ class Server:
 
     # ------------------------------------------------------- plan applier
     def _apply_plan(self, plan: Plan, result: PlanResult) -> int:
-        return self._propose("plan_result", {
+        index = self._propose("plan_result", {
             "result": to_wire(result),
             "job": to_wire(plan.job) if plan.job is not None else None})
+        self._claim_csi_for_placements(plan, result)
+        return index
+
+    def _claim_csi_for_placements(self, plan: Plan,
+                                  result: PlanResult) -> None:
+        """Claim CSI volumes for newly committed placements (reference:
+        the csi_hook's Volume.Claim at alloc start; here the serial plan
+        applier is the claim serialization point, so the scheduler's
+        write-capacity gate and this claim see consistent state)."""
+        from ..structs import CLAIM_READ, CLAIM_WRITE
+        job = plan.job
+        if job is None:
+            return
+        tgs = {tg.name: tg for tg in job.task_groups}
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                tg = tgs.get(a.task_group)
+                if tg is None:
+                    continue
+                for req in tg.volumes.values():
+                    if req.type != "csi":
+                        continue
+                    mode = CLAIM_READ if req.read_only else CLAIM_WRITE
+                    try:
+                        self.claim_csi_volume(job.namespace, req.source,
+                                              mode, a.id, a.node_id)
+                    except (KeyError, ValueError):
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "csi claim failed for alloc %s volume %s",
+                            a.id, req.source)

@@ -105,6 +105,10 @@ class StateSnapshot:
             by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
         return out, by_dc
 
+    # -- csi volumes --
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        return self._t["csi_volumes"].get((namespace, vol_id))
+
     # -- jobs --
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
         return self._t["jobs"].get((namespace, job_id))
@@ -440,7 +444,7 @@ class StateStore(StateSnapshot):
             for eid in eval_ids:
                 self._t["evals"].pop(eid, None)
             for aid in alloc_ids:
-                self._remove_alloc(aid)
+                self._remove_alloc(aid, index)
             self._bump("evals", index)
             if alloc_ids:
                 self._bump("allocs", index)
@@ -578,7 +582,7 @@ class StateStore(StateSnapshot):
             state.placed_canaries.append(a.id)
         self._t["deployments"][d2.id] = d2
 
-    def _remove_alloc(self, alloc_id: str) -> None:
+    def _remove_alloc(self, alloc_id: str, index: int = 0) -> None:
         a = self._t["allocs"].pop(alloc_id, None)
         if a is None:
             return
@@ -588,6 +592,10 @@ class StateStore(StateSnapshot):
         s = self._t["_allocs_by_job"].get((a.namespace, a.job_id))
         if s:
             s.discard(alloc_id)
+        # a reaped alloc releases its CSI claims even if it never
+        # reported client-terminal (lost node, forced GC) — otherwise
+        # the volume is stuck in-use forever
+        self._release_csi_claims_locked(index or self.index, alloc_id)
 
     def update_allocs_from_client(self, index: int,
                                   updates: List[Allocation]) -> None:
@@ -608,10 +616,86 @@ class StateStore(StateSnapshot):
                 a.modify_time = upd.modify_time or a.modify_time
                 self._update_deployment_with_alloc_locked(index, a, existing)
                 self._update_summary_with_alloc_locked(index, a, existing)
+                if (a.client_terminal_status()
+                        and not existing.client_terminal_status()):
+                    # terminal allocs release their CSI volume claims
+                    # (reference: csi_hook postrun -> Volume.Unpublish)
+                    self._release_csi_claims_locked(index, a.id)
                 self._t["allocs"][a.id] = a
             for key in {(u.namespace, u.job_id) for u in updates}:
                 self._refresh_job_status(index, *key)
             self._bump("allocs", index)
+
+    # -- CSI volumes (reference: state_store.go CSIVolumeRegister/Claim) --
+    def upsert_csi_volume(self, index: int, vol) -> None:
+        with self._lock:
+            import copy as _copy
+            v = _copy.copy(vol)
+            existing = self._t["csi_volumes"].get((v.namespace, v.id))
+            if existing is not None:
+                # re-registration must not wipe live claims (a cleared
+                # write_claims would re-admit a second writer on a
+                # single-writer volume)
+                v.read_claims = dict(existing.read_claims)
+                v.write_claims = dict(existing.write_claims)
+                v.create_index = existing.create_index
+            v.modify_index = index
+            self._t["csi_volumes"][(v.namespace, v.id)] = v
+            self._bump("csi_volumes", index)
+
+    def delete_csi_volume(self, index: int, namespace: str,
+                          vol_id: str) -> None:
+        with self._lock:
+            v = self._t["csi_volumes"].get((namespace, vol_id))
+            if v is not None and v.in_use():
+                raise ValueError(f"volume {vol_id} is in use")
+            self._t["csi_volumes"].pop((namespace, vol_id), None)
+            self._bump("csi_volumes", index)
+
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        with self._lock:
+            return self._t["csi_volumes"].get((namespace, vol_id))
+
+    def csi_volumes(self, namespace: Optional[str] = None):
+        with self._lock:
+            return [v for (ns, _vid), v in
+                    sorted(self._t["csi_volumes"].items())
+                    if namespace is None or ns == namespace]
+
+    def claim_csi_volume(self, index: int, namespace: str, vol_id: str,
+                         mode: str, alloc_id: str, node_id: str) -> None:
+        with self._lock:
+            v = self._t["csi_volumes"].get((namespace, vol_id))
+            if v is None:
+                raise KeyError(f"volume {vol_id} not found")
+            import copy as _copy
+            v2 = _copy.copy(v)
+            v2.read_claims = dict(v.read_claims)
+            v2.write_claims = dict(v.write_claims)
+            v2.claim(mode, alloc_id, node_id)
+            v2.modify_index = index
+            self._t["csi_volumes"][(namespace, vol_id)] = v2
+            self._bump("csi_volumes", index)
+
+    def release_csi_claims(self, index: int, alloc_id: str) -> None:
+        with self._lock:
+            self._release_csi_claims_locked(index, alloc_id)
+
+    def _release_csi_claims_locked(self, index: int,
+                                   alloc_id: str) -> None:
+        changed = False
+        import copy as _copy
+        for key, v in list(self._t["csi_volumes"].items()):
+            if alloc_id in v.read_claims or alloc_id in v.write_claims:
+                v2 = _copy.copy(v)
+                v2.read_claims = dict(v.read_claims)
+                v2.write_claims = dict(v.write_claims)
+                v2.release(alloc_id)
+                v2.modify_index = index
+                self._t["csi_volumes"][key] = v2
+                changed = True
+        if changed:
+            self._bump("csi_volumes", index)
 
     def update_alloc_desired_transition(self, index: int, alloc_ids: List[str],
                                         transition) -> None:

@@ -26,3 +26,9 @@ from .funcs import (BINPACK_MAX_FIT_SCORE, allocs_fit, filter_terminal_allocs,
                     score_fit)
 from .network import NetworkIndex
 from .devices import DeviceAccounter
+
+from .csi import (ACCESS_MULTI_NODE_MULTI_WRITER, ACCESS_MULTI_NODE_READER,
+                  ACCESS_MULTI_NODE_SINGLE_WRITER, ACCESS_SINGLE_NODE_READER,
+                  ACCESS_SINGLE_NODE_WRITER, ATTACH_BLOCK_DEVICE,
+                  ATTACH_FILE_SYSTEM, CLAIM_READ, CLAIM_WRITE, CSIPlugin,
+                  CSIPluginNodeInfo, CSIVolume, aggregate_plugins)

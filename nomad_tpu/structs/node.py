@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .consts import (NODE_SCHED_ELIGIBLE, NODE_STATUS_DOWN, NODE_STATUS_READY)
+from .csi import CSIPluginNodeInfo
 from .resources import NodeReservedResources, NodeResources, ComparableResources
 
 UNIQUE_NAMESPACE = "unique."
@@ -66,6 +67,10 @@ class Node:
     links: Dict[str, str] = field(default_factory=dict)
     drivers: Dict[str, DriverInfo] = field(default_factory=dict)
     host_volumes: Dict[str, HostVolumeConfig] = field(default_factory=dict)
+    # plugin id -> node-side CSI plugin info (reference:
+    # structs.Node.CSINodePlugins, fingerprinted by the client)
+    csi_node_plugins: Dict[str, CSIPluginNodeInfo] = field(
+        default_factory=dict)
     status: str = NODE_STATUS_READY
     status_description: str = ""
     scheduling_eligibility: str = NODE_SCHED_ELIGIBLE

@@ -1,0 +1,179 @@
+"""CSI volume model + claim lifecycle (reference: nomad/structs/csi.go
+claim admission, state_store.go CSIVolume*, feasible.go:194
+CSIVolumeChecker)."""
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.server.server import Server
+from nomad_tpu.structs import (ACCESS_MULTI_NODE_MULTI_WRITER,
+                               ACCESS_MULTI_NODE_READER,
+                               ACCESS_SINGLE_NODE_WRITER, CLAIM_READ,
+                               CLAIM_WRITE, CSIPluginNodeInfo, CSIVolume)
+from nomad_tpu.structs.job import VolumeRequest
+
+
+def test_claim_admission_matrix():
+    v = CSIVolume(id="v1", access_mode=ACCESS_SINGLE_NODE_WRITER)
+    v.claim(CLAIM_WRITE, "a1", "n1")
+    with pytest.raises(ValueError):
+        v.claim(CLAIM_WRITE, "a2", "n2")    # single writer
+    v.release("a1")
+    v.claim(CLAIM_WRITE, "a2", "n2")        # freed
+
+    mw = CSIVolume(id="v2", access_mode=ACCESS_MULTI_NODE_MULTI_WRITER)
+    mw.claim(CLAIM_WRITE, "a1", "n1")
+    mw.claim(CLAIM_WRITE, "a2", "n2")       # multi-writer ok
+
+    ro = CSIVolume(id="v3", access_mode=ACCESS_MULTI_NODE_READER)
+    with pytest.raises(ValueError):
+        ro.claim(CLAIM_WRITE, "a1", "n1")   # reader-only volume
+    ro.claim(CLAIM_READ, "a1", "n1")
+
+
+def test_server_volume_lifecycle_and_release_on_terminal():
+    srv = Server(num_workers=0)
+    srv.start()
+    try:
+        vol = CSIVolume(id="data", namespace="default",
+                        plugin_id="ebs",
+                        access_mode=ACCESS_SINGLE_NODE_WRITER)
+        srv.register_csi_volume(vol)
+        assert srv.store.csi_volume_by_id("default", "data") is not None
+
+        node = mock.node()
+        srv.register_node(node)
+        job = mock.job()
+        alloc = mock.alloc(job=job, node_id=node.id)
+        srv.store.upsert_allocs(srv.store.latest_index() + 1, [alloc])
+
+        srv.claim_csi_volume("default", "data", CLAIM_WRITE,
+                             alloc.id, node.id)
+        v = srv.store.csi_volume_by_id("default", "data")
+        assert v.write_claims == {alloc.id: node.id}
+        # second writer rejected at the server (validation before raft)
+        with pytest.raises(ValueError):
+            srv.claim_csi_volume("default", "data", CLAIM_WRITE,
+                                 "other", node.id)
+        # in-use volumes cannot be deregistered
+        with pytest.raises(ValueError):
+            srv.deregister_csi_volume("default", "data")
+
+        # terminal client status releases the claim
+        import copy
+        upd = copy.copy(alloc)
+        upd.client_status = structs.ALLOC_CLIENT_COMPLETE
+        srv.update_allocs_from_client([upd])
+        v = srv.store.csi_volume_by_id("default", "data")
+        assert v.write_claims == {}
+        srv.deregister_csi_volume("default", "data")
+        assert srv.store.csi_volume_by_id("default", "data") is None
+    finally:
+        srv.stop()
+
+
+def csi_job(source, read_only=False):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = []
+    tg.volumes = {"vol": VolumeRequest(name="vol", type="csi",
+                                       source=source,
+                                       read_only=read_only)}
+    return job
+
+
+def test_scheduler_blocks_on_missing_volume():
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.node())
+    job = csi_job("nope")
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_(
+        job_id=job.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    assert not h.store.allocs_by_job("default", job.id)
+
+
+def test_scheduler_places_only_on_plugin_nodes():
+    h = Harness()
+    h.store.upsert_csi_volume(h.next_index(), CSIVolume(
+        id="data", namespace="default", plugin_id="ebs",
+        access_mode=ACCESS_SINGLE_NODE_WRITER))
+    plain = mock.node()
+    plugin_node = mock.node()
+    plugin_node.csi_node_plugins = {"ebs": CSIPluginNodeInfo(
+        plugin_id="ebs", healthy=True)}
+    plugin_node.compute_class()
+    h.store.upsert_node(h.next_index(), plain)
+    h.store.upsert_node(h.next_index(), plugin_node)
+
+    job = csi_job("data")
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_(
+        job_id=job.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 1
+    assert placed[0].node_id == plugin_node.id
+
+
+def test_scheduler_blocks_on_exhausted_write_claims():
+    h = Harness()
+    vol = CSIVolume(id="data", namespace="default", plugin_id="ebs",
+                    access_mode=ACCESS_SINGLE_NODE_WRITER)
+    vol.write_claims = {"someone": "elsewhere"}
+    h.store.upsert_csi_volume(h.next_index(), vol)
+    node = mock.node()
+    node.csi_node_plugins = {"ebs": CSIPluginNodeInfo(plugin_id="ebs")}
+    node.compute_class()
+    h.store.upsert_node(h.next_index(), node)
+    job = csi_job("data", read_only=False)
+    h.store.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_(
+        job_id=job.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    assert not h.store.allocs_by_job("default", job.id)
+
+    # a read-only request against the same volume still places
+    ro = csi_job("data", read_only=True)
+    ro.id = "ro-job"
+    h.store.upsert_job(h.next_index(), ro)
+    h.process("service", mock.eval_(
+        job_id=ro.id, triggered_by=structs.EVAL_TRIGGER_JOB_REGISTER))
+    assert len(h.store.allocs_by_job("default", ro.id)) == 1
+
+
+def test_plugin_aggregation():
+    from nomad_tpu.structs import aggregate_plugins
+    n1 = mock.node()
+    n1.csi_node_plugins = {"ebs": CSIPluginNodeInfo(plugin_id="ebs",
+                                                    healthy=True)}
+    n2 = mock.node()
+    n2.csi_node_plugins = {"ebs": CSIPluginNodeInfo(plugin_id="ebs",
+                                                    healthy=False)}
+    plugins = aggregate_plugins([n1, n2])
+    assert plugins["ebs"].nodes_expected == 2
+    assert plugins["ebs"].nodes_healthy == 1
+    assert plugins["ebs"].healthy
+
+
+def test_placement_claims_volume_through_plan_applier():
+    srv = Server(num_workers=1)
+    srv.start()
+    try:
+        srv.register_csi_volume(CSIVolume(
+            id="data", namespace="default", plugin_id="ebs",
+            access_mode=ACCESS_SINGLE_NODE_WRITER))
+        node = mock.node()
+        node.csi_node_plugins = {"ebs": CSIPluginNodeInfo(
+            plugin_id="ebs", healthy=True)}
+        node.compute_class()
+        srv.register_node(node)
+        job = csi_job("data")
+        srv.register_job(job)
+        from nomad_tpu.client.sim import wait_until
+        assert wait_until(lambda: len(
+            srv.store.allocs_by_job("default", job.id)) == 1, timeout=20)
+        alloc = srv.store.allocs_by_job("default", job.id)[0]
+        assert wait_until(lambda: srv.store.csi_volume_by_id(
+            "default", "data").write_claims == {alloc.id: node.id},
+            timeout=5)
+    finally:
+        srv.stop()

@@ -1,0 +1,139 @@
+"""Prefix search (nomad/search_endpoint.go) and field-level job diff
+(nomad/structs/diff.go) behaviors."""
+import copy
+
+from nomad_tpu import mock
+from nomad_tpu.server.search import TRUNCATE_LIMIT, search
+from nomad_tpu.state.store import StateStore
+from nomad_tpu.structs.diff import (DIFF_ADDED, DIFF_DELETED, DIFF_EDITED,
+                                    DIFF_NONE, job_diff)
+
+
+def seeded_store():
+    st = StateStore()
+    ix = 0
+    for i in range(3):
+        j = mock.job()
+        j.id = f"web-{i}"
+        ix += 1
+        st.upsert_job(ix, j)
+    n = mock.node()
+    n.id = "aaaa-node"
+    ix += 1
+    st.upsert_node(ix, n)
+    return st
+
+
+def test_search_prefix_and_contexts():
+    st = seeded_store()
+    matches, trunc = search(st, "web-")
+    assert matches["jobs"] == ["web-0", "web-1", "web-2"]
+    assert matches["nodes"] == []
+    assert not trunc["jobs"]
+    matches, _ = search(st, "aaaa", context="nodes")
+    assert matches == {"nodes": ["aaaa-node"]}
+
+
+def test_search_truncates_per_context():
+    st = StateStore()
+    for i in range(TRUNCATE_LIMIT + 5):
+        j = mock.job()
+        j.id = f"batch-{i:03}"
+        st.upsert_job(i + 1, j)
+    matches, trunc = search(st, "batch-", context="jobs")
+    assert len(matches["jobs"]) == TRUNCATE_LIMIT
+    assert trunc["jobs"]
+
+
+def test_job_diff_none_for_identical():
+    j = mock.job()
+    assert job_diff(j, copy.deepcopy(j))["Type"] == DIFF_NONE
+
+
+def test_job_diff_added_job():
+    d = job_diff(None, mock.job())
+    assert d["Type"] == DIFF_ADDED
+    assert d["TaskGroups"] and d["TaskGroups"][0]["Type"] == DIFF_ADDED
+
+
+def test_job_diff_edited_fields_and_tasks():
+    old = mock.job()
+    new = copy.deepcopy(old)
+    new.priority = old.priority + 10
+    new.task_groups[0].count = old.task_groups[0].count + 2
+    new.task_groups[0].tasks[0].resources.cpu += 500
+    d = job_diff(old, new)
+    assert d["Type"] == DIFF_EDITED
+    assert any(f["Name"] == "priority" and f["Type"] == DIFF_EDITED
+               for f in d["Fields"])
+    tg = d["TaskGroups"][0]
+    assert any(f["Name"] == "count" for f in tg["Fields"])
+    task = tg["Tasks"][0]
+    res = next(o for o in task["Objects"] if o["Name"] == "Resources")
+    assert any(f["Name"] == "cpu" for f in res["Fields"])
+
+
+def test_job_diff_task_added_and_deleted():
+    old = mock.job()
+    new = copy.deepcopy(old)
+    extra = copy.deepcopy(new.task_groups[0].tasks[0])
+    extra.name = "sidecar"
+    new.task_groups[0].tasks.append(extra)
+    d = job_diff(old, new)
+    tasks = d["TaskGroups"][0]["Tasks"]
+    assert [t["Name"] for t in tasks] == ["sidecar"]
+    assert tasks[0]["Type"] == DIFF_ADDED
+
+    d2 = job_diff(new, old)
+    tasks2 = d2["TaskGroups"][0]["Tasks"]
+    assert tasks2[0]["Type"] == DIFF_DELETED
+
+
+def test_job_diff_constraint_set_changes():
+    from nomad_tpu.structs import Constraint
+    old = mock.job()
+    new = copy.deepcopy(old)
+    new.constraints = list(new.constraints) + [
+        Constraint("${attr.rack}", "r1", "=")]
+    d = job_diff(old, new)
+    cons = [o for o in d["Objects"] if o["Name"] == "Constraint"]
+    assert len(cons) == 1 and cons[0]["Type"] == DIFF_ADDED
+
+
+def test_http_search_and_plan_diff():
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    from nomad_tpu.server.server import Server
+    from nomad_tpu.utils.codec import to_wire
+    import json
+    import urllib.request
+
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv)
+    http.start()
+    try:
+        job = mock.job()
+        srv.register_job(job)
+
+        def post(path, body):
+            req = urllib.request.Request(
+                http.address + path, method="POST",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        out = post("/v1/search", {"prefix": job.id[:4],
+                                  "context": "jobs"})
+        assert job.id in out["matches"]["jobs"]
+
+        new = copy.deepcopy(job)
+        new.task_groups[0].count += 1
+        out = post(f"/v1/job/{job.id}/plan", {"job": to_wire(new),
+                                              "diff": True})
+        assert out["diff"]["Type"] == DIFF_EDITED
+        assert any(f["Name"] == "count"
+                   for f in out["diff"]["TaskGroups"][0]["Fields"])
+    finally:
+        http.stop()
+        srv.stop()
