@@ -1,0 +1,14 @@
+"""ACL: tokens, policies, capability checks.
+
+Reference: acl/acl.go (compiled ACL object + capability checks),
+acl/policy.go (policy schema), nomad/acl.go (token resolution),
+nomad/acl_endpoint.go (bootstrap/upsert verbs). Policies here are
+JSON-shaped rather than HCL1 — the jobspec layer already made that
+trade (SURVEY §5.6) — with the same namespace/node/agent/operator rule
+classes, coarse policy levels and fine-grained capabilities.
+"""
+from .acl import (CAPABILITIES, ACL, ACLPolicy, ACLToken, NamespaceRule,
+                  compile_acl, management_acl)
+
+__all__ = ["ACL", "ACLPolicy", "ACLToken", "CAPABILITIES",
+           "NamespaceRule", "compile_acl", "management_acl"]

@@ -63,9 +63,10 @@ class HTTPAgentServer:
     `client` (optional) the local node agent for agent-local routes."""
 
     def __init__(self, server, client=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, acl_enabled: bool = False):
         self.server = server
         self.client = client
+        self.acl_enabled = acl_enabled
         self._routes = _build_routes(self)
         outer = self
 
@@ -77,8 +78,9 @@ class HTTPAgentServer:
 
             def _handle(self, method: str):
                 try:
-                    code, body, index = outer.dispatch(method, self.path,
-                                                       self._read_body())
+                    token = self.headers.get("X-Nomad-Token", "")
+                    code, body, index = outer.dispatch(
+                        method, self.path, self._read_body(), token)
                 except HTTPError as e:
                     code, body, index = e.code, {"error": e.msg}, None
                 except Exception as e:
@@ -136,7 +138,7 @@ class HTTPAgentServer:
             self._thread.join(timeout=2.0)
 
     # ----------------------------------------------------------- dispatch
-    def dispatch(self, method: str, path: str, body):
+    def dispatch(self, method: str, path: str, body, token: str = ""):
         url = urlparse(path)
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         for pattern, methods in self._routes:
@@ -146,8 +148,68 @@ class HTTPAgentServer:
             fn = methods.get(method)
             if fn is None:
                 raise HTTPError(405, f"method {method} not allowed")
+            self._enforce_acl(method, url.path, q, body, token)
             return fn(q, body, *m.groups())
         raise HTTPError(404, f"no handler for {url.path}")
+
+    def _enforce_acl(self, method: str, path: str, q, body,
+                     token: str) -> None:
+        """Route-class capability checks (reference: each agent endpoint
+        resolves the token and asserts one capability — e.g.
+        job_endpoint.go requires submit-job to register, read-job to
+        get). Disabled servers skip enforcement entirely."""
+        if not self.acl_enabled or path == "/v1/acl/bootstrap":
+            return
+        from ..acl import acl as aclmod
+        a = self.server.resolve_token(token) if token else None
+        if a is None:
+            raise HTTPError(403, "token required" if not token
+                            else "invalid token")
+        # the namespace the request ACTUALLY operates on: a submitted
+        # job's body namespace overrides the query parameter (otherwise
+        # ?namespace=dev would launder a prod-namespace body past the
+        # check)
+        ns = q.get("namespace", "default")
+        if isinstance(body, dict):
+            job_body = body.get("job") if isinstance(body.get("job"),
+                                                     dict) else None
+            if job_body and job_body.get("namespace"):
+                ns = job_body["namespace"]
+            elif body.get("namespace"):
+                ns = body["namespace"]
+        if path.startswith("/v1/acl"):
+            # token/policy management is management-only (reference:
+            # acl_endpoint.go IsManagement checks) — operator scope
+            # must NOT mint tokens or read secrets
+            if not a.management:
+                raise HTTPError(403, "management token required")
+            return
+        write = (method in ("POST", "PUT", "DELETE")
+                 and path != "/v1/search")
+        if path.startswith(("/v1/jobs", "/v1/job/", "/v1/allocation",
+                            "/v1/evaluation", "/v1/deployment",
+                            "/v1/search", "/v1/volume")):
+            cap = (aclmod.CAP_SUBMIT_JOB if write
+                   else aclmod.CAP_READ_JOB)
+            if not a.allow_namespace_op(ns, cap):
+                raise HTTPError(403, f"missing capability {cap}")
+            return
+        if path.startswith("/v1/node"):
+            ok = a.allow_node_write() if write else a.allow_node_read()
+            if not ok:
+                raise HTTPError(403, "node permission denied")
+            return
+        if path.startswith("/v1/agent") or path == "/v1/metrics":
+            ok = a.allow_agent_write() if write else a.allow_agent_read()
+            if not ok:
+                raise HTTPError(403, "agent permission denied")
+            return
+        if path.startswith(("/v1/operator", "/v1/system")):
+            ok = (a.allow_operator_write() if write
+                  else a.allow_operator_read())
+            if not ok:
+                raise HTTPError(403, "operator permission denied")
+            return
 
     # ------------------------------------------------------- blocking wait
     def _block(self, q: Dict[str, str], table: str) -> int:
@@ -493,6 +555,62 @@ class HTTPAgentServer:
                      "truncations": truncations}, \
             self.server.store.latest_index()
 
+    def acl_bootstrap(self, q, body):
+        try:
+            token = self.server.bootstrap_acl()
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return 200, to_wire(token), self.server.store.latest_index()
+
+    def acl_policies_list(self, q, body):
+        return 200, [to_wire(p) for p in self.server.store.acl_policies()], \
+            self.server.store.latest_index()
+
+    def acl_policy_get(self, q, body, name):
+        p = self.server.store.acl_policy_by_name(name)
+        if p is None:
+            raise HTTPError(404, f"policy {name} not found")
+        return 200, to_wire(p), self.server.store.latest_index()
+
+    def acl_policy_upsert(self, q, body, name):
+        from ..acl import ACLPolicy
+        if not body:
+            raise HTTPError(400, "body must carry the policy")
+        policy = from_wire(ACLPolicy, body)
+        policy.name = name
+        index = self.server.upsert_acl_policy(policy)
+        return 200, {"index": index}, index
+
+    def acl_policy_delete(self, q, body, name):
+        index = self.server.delete_acl_policy(name)
+        return 200, {"index": index}, index
+
+    def acl_tokens_list(self, q, body):
+        out = []
+        for t in self.server.store.acl_tokens():
+            w = to_wire(t)
+            w.pop("secret_id", None)       # listings never leak secrets
+            out.append(w)
+        return 200, out, self.server.store.latest_index()
+
+    def acl_token_upsert(self, q, body):
+        from ..acl import ACLToken
+        if not body:
+            raise HTTPError(400, "body must carry the token")
+        token = from_wire(ACLToken, body)
+        index = self.server.upsert_acl_token(token)
+        return 200, to_wire(token), index
+
+    def acl_token_get(self, q, body, accessor):
+        t = self.server.store.acl_token_by_accessor(accessor)
+        if t is None:
+            raise HTTPError(404, f"token {accessor} not found")
+        return 200, to_wire(t), self.server.store.latest_index()
+
+    def acl_token_delete(self, q, body, accessor):
+        index = self.server.delete_acl_token(accessor)
+        return 200, {"index": index}, index
+
     def volumes_list(self, q, body):
         ns = q.get("namespace", "default")
         vols = self.server.store.csi_volumes(ns)
@@ -589,4 +707,16 @@ def _build_routes(s: HTTPAgentServer):
                                           "PUT": s.volume_register,
                                           "POST": s.volume_register,
                                           "DELETE": s.volume_delete}),
+        (R(r"^/v1/acl/bootstrap$"), {"POST": s.acl_bootstrap,
+                                     "PUT": s.acl_bootstrap}),
+        (R(r"^/v1/acl/policies$"), {"GET": s.acl_policies_list}),
+        (R(r"^/v1/acl/policy/([^/]+)$"), {"GET": s.acl_policy_get,
+                                          "POST": s.acl_policy_upsert,
+                                          "PUT": s.acl_policy_upsert,
+                                          "DELETE": s.acl_policy_delete}),
+        (R(r"^/v1/acl/tokens$"), {"GET": s.acl_tokens_list,
+                                  "POST": s.acl_token_upsert,
+                                  "PUT": s.acl_token_upsert}),
+        (R(r"^/v1/acl/token/([^/]+)$"), {"GET": s.acl_token_get,
+                                         "DELETE": s.acl_token_delete}),
     ]

@@ -50,7 +50,8 @@ def cmd_agent(args) -> int:
     if not args.server_only:
         client = Client(server, data_dir=args.data_dir)
         client.start()
-    http = HTTPAgentServer(server, client, host=args.bind, port=args.port)
+    http = HTTPAgentServer(server, client, host=args.bind, port=args.port,
+                           acl_enabled=args.acl_enabled)
     http.start()
     print(f"==> nomad-tpu agent started (dev mode)")
     print(f"    HTTP: {http.address}")
@@ -321,6 +322,9 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-workers", type=int, default=2)
     ag.add_argument("-server-only", dest="server_only",
                     action="store_true")
+    ag.add_argument("-acl-enabled", dest="acl_enabled",
+                    action="store_true",
+                    help="enforce ACLs on the HTTP API")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -75,13 +75,18 @@ class Client:
                  node: Optional[Node] = None, registry=None,
                  datacenter: str = "dc1",
                  meta: Optional[Dict[str, str]] = None,
-                 state_db=None, dev_mode: bool = False):
+                 state_db=None, dev_mode: bool = False,
+                 device_registry=None):
         self.servers = (InProcServer(servers)
                         if not isinstance(servers, ServerEndpoints)
                         else servers)
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.registry = registry or default_registry()
+        if device_registry is None:
+            from ..plugins.device import default_device_registry
+            device_registry = default_device_registry()
+        self.device_registry = device_registry
         self.state_db = state_db if state_db is not None else (
             MemDB() if dev_mode
             else StateDB(os.path.join(data_dir, "client", "state.db")))
@@ -101,7 +106,8 @@ class Client:
         under <data_dir>/client)."""
         import json
         node = fingerprint_node(self.data_dir, self.registry,
-                                datacenter=datacenter, meta=meta)
+                                datacenter=datacenter, meta=meta,
+                                device_registry=self.device_registry)
         ident_path = os.path.join(self.data_dir, "client", "node.json")
         try:
             with open(ident_path) as f:
@@ -229,7 +235,8 @@ class Client:
 
     def _new_runner(self, alloc: Allocation) -> AllocRunner:
         return AllocRunner(alloc, self.data_dir, self.registry, self.node,
-                           self._queue_update, state_db=self.state_db)
+                           self._queue_update, state_db=self.state_db,
+                           device_registry=self.device_registry)
 
     def _fail_alloc(self, alloc: Allocation, reason: str) -> None:
         import copy

@@ -48,9 +48,10 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, data_dir: str, registry,
                  node: Optional[Node],
                  on_alloc_update: Callable[[Allocation], None],
-                 state_db=None):
+                 state_db=None, device_registry=None):
         self.alloc = alloc
         self.registry = registry
+        self.device_registry = device_registry
         self.node = node
         self.on_alloc_update = on_alloc_update
         self.state_db = state_db
@@ -78,7 +79,8 @@ class AllocRunner:
                                  f"for task {task.name}")
             self.task_runners.append(TaskRunner(
                 self.alloc, task, self.alloc_dir, driver, self.node,
-                self._on_task_state_change, state_db=self.state_db))
+                self._on_task_state_change, state_db=self.state_db,
+                device_registry=self.device_registry))
 
     # ---------------------------------------------------------- lifecycle
     def run(self) -> None:

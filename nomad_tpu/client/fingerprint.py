@@ -57,7 +57,8 @@ def fingerprint_node(data_dir: str = "/tmp",
                      registry=None,
                      datacenter: str = "dc1",
                      node_class: str = "",
-                     meta: Optional[Dict[str, str]] = None) -> Node:
+                     meta: Optional[Dict[str, str]] = None,
+                     device_registry=None) -> Node:
     """Run all fingerprinters and assemble the Node
     (reference: fingerprint.go:31-51 registry + client.go:1295 setup)."""
     attrs: Dict[str, str] = {
@@ -88,7 +89,9 @@ def fingerprint_node(data_dir: str = "/tmp",
             memory_mb=_memory_mb(),
             disk_mb=_disk_mb(data_dir),
             networks=[NetworkResource(device="lo", cidr="127.0.0.1/32",
-                                      ip="127.0.0.1", mbits=1000)]),
+                                      ip="127.0.0.1", mbits=1000)],
+            devices=(device_registry.fingerprint_all()
+                     if device_registry is not None else [])),
         reserved_resources=NodeReservedResources(),
         status="initializing",
     )

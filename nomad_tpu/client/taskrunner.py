@@ -84,7 +84,7 @@ class TaskRunner:
     def __init__(self, alloc: Allocation, task: Task, alloc_dir: AllocDir,
                  driver: DriverPlugin, node: Optional[Node],
                  on_state_change: Callable[["TaskRunner"], None],
-                 state_db=None):
+                 state_db=None, device_registry=None):
         self.alloc = alloc
         self.task = task
         self.alloc_dir = alloc_dir
@@ -92,6 +92,7 @@ class TaskRunner:
         self.node = node
         self.on_state_change = on_state_change
         self.state_db = state_db
+        self.device_registry = device_registry
         self.task_id = f"{alloc.id}/{task.name}"
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
@@ -216,12 +217,36 @@ class TaskRunner:
         self._persist()
         self.on_state_change(self)
 
+    def _device_envs(self) -> dict:
+        """Reserve this task's assigned device instances through their
+        owning plugins; their env recipe joins the task environment
+        (reference: devicemanager Reserve at task start, devicehook)."""
+        if self.device_registry is None:
+            return {}
+        tr = self.alloc.allocated_resources.tasks.get(self.task.name)
+        if tr is None:
+            return {}
+        envs: dict = {}
+        for ad in tr.devices:
+            res = self.device_registry.reserve(
+                ad.vendor, ad.type, ad.name, list(ad.device_ids))
+            if res is None:
+                # launching without the device recipe would hand the
+                # task every host device (or crash it later) — fail at
+                # setup like the reference devicehook does
+                raise RuntimeError(
+                    f"no device plugin owns {ad.vendor}/{ad.type}/"
+                    f"{ad.name}; cannot reserve {ad.device_ids}")
+            envs.update(res.envs)
+        return envs
+
     def _task_config(self) -> TaskConfig:
         task_dir = self.alloc_dir.task_dir(self.task.name)
         env = build_task_env(
             self.alloc, self.task, self.node, task_dir=task_dir,
             alloc_dir=self.alloc_dir.shared,
             secrets_dir=self.alloc_dir.secrets_dir(self.task.name))
+        env.update(self._device_envs())
         vars_ = dict(node_vars(self.node))
         vars_.update({f"env.{k}": v for k, v in env.items()})
         vars_.update(env)

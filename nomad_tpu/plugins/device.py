@@ -1,0 +1,145 @@
+"""Device plugin interface — the TPU-relevant plugin class.
+
+Reference: plugins/device/ — the gRPC protocol every device plugin
+speaks: `Fingerprint` streams the device inventory (groups of
+instances with attributes), `Reserve` returns the container access
+recipe (env vars, mounts) for specific instance ids, `Stats` reports
+per-instance usage. devices/gpu/nvidia is the built-in blueprint; the
+TPU build's first-party plugin introspects the JAX runtime instead of
+NVML.
+
+In-process plugins here follow the same registry pattern as the task
+drivers (plugins/drivers.py); the wire protocol for OUT-of-process
+plugins is the rpc package's framed JSON, not gRPC.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import NodeDevice, NodeDeviceResource
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass
+class ContainerReservation:
+    """How a task gets access to reserved instances (reference:
+    plugins/device/device.go ContainerReservation)."""
+    envs: Dict[str, str] = field(default_factory=dict)
+    mounts: List[Dict[str, str]] = field(default_factory=list)
+    devices: List[str] = field(default_factory=list)
+
+
+class DevicePlugin:
+    """Base protocol (reference: plugins/device/device.go:31-44)."""
+
+    name = "device"
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        """The device inventory this node offers."""
+        raise NotImplementedError
+
+    def reserve(self, device_ids: List[str]) -> ContainerReservation:
+        """Access recipe for specific instance ids at task start."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        """instance id -> stats gauges."""
+        return {}
+
+
+class TPUDevicePlugin(DevicePlugin):
+    """First-party TPU inventory via the JAX runtime (the nvidia/NVML
+    analog, devices/gpu/nvidia/device.go). Fingerprinting is fully
+    failure-tolerant: hosts without a TPU (or without jax importable in
+    the agent's environment) simply offer no devices."""
+
+    name = "tpu"
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        try:
+            import jax
+            devices = [d for d in jax.devices()
+                       if "tpu" in d.platform.lower()
+                       or "TPU" in getattr(d, "device_kind", "")]
+        except Exception:                   # noqa: BLE001
+            return []
+        if not devices:
+            return []
+        by_kind: Dict[str, List] = {}
+        for d in devices:
+            by_kind.setdefault(
+                getattr(d, "device_kind", "tpu") or "tpu", []).append(d)
+        out = []
+        for kind, devs in sorted(by_kind.items()):
+            out.append(NodeDeviceResource(
+                vendor="google", type="tpu", name=kind,
+                instances=[NodeDevice(id=f"tpu-{d.id}", healthy=True)
+                           for d in devs],
+                attributes={"device_kind": kind,
+                            "count": len(devs)}))
+        return out
+
+    def reserve(self, device_ids: List[str]) -> ContainerReservation:
+        ordinals = ",".join(i.rsplit("-", 1)[-1] for i in device_ids)
+        return ContainerReservation(
+            envs={"TPU_VISIBLE_DEVICES": ordinals,
+                  "NOMAD_DEVICE_TPU": ",".join(device_ids)},
+            devices=list(device_ids))
+
+
+class MockDevicePlugin(DevicePlugin):
+    """Scriptable inventory for tests (the drivers/mock analog)."""
+
+    name = "mock_device"
+
+    def __init__(self, groups: Optional[List[NodeDeviceResource]] = None,
+                 env_key: str = "MOCK_DEVICES"):
+        self.groups = groups or []
+        self.env_key = env_key
+        self.reserved: List[List[str]] = []
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        return list(self.groups)
+
+    def reserve(self, device_ids: List[str]) -> ContainerReservation:
+        self.reserved.append(list(device_ids))
+        return ContainerReservation(
+            envs={self.env_key: ",".join(device_ids)},
+            devices=list(device_ids))
+
+
+class DevicePluginRegistry:
+    """vendor/type/name pattern -> owning plugin (reference:
+    client/devicemanager routing by DeviceIdTuple)."""
+
+    def __init__(self, plugins: Optional[List[DevicePlugin]] = None):
+        self.plugins = list(plugins or [])
+        self._owner: Dict[tuple, DevicePlugin] = {}
+
+    def fingerprint_all(self) -> List[NodeDeviceResource]:
+        out = []
+        for plugin in self.plugins:
+            try:
+                groups = plugin.fingerprint()
+            except Exception:               # noqa: BLE001
+                _log.exception("device plugin %s fingerprint failed",
+                               plugin.name)
+                continue
+            for g in groups:
+                self._owner[g.id_tuple()] = plugin
+                out.append(g)
+        return out
+
+    def reserve(self, vendor: str, typ: str, model: str,
+                device_ids: List[str]) -> Optional[ContainerReservation]:
+        plugin = self._owner.get((vendor, typ, model))
+        if plugin is None:
+            return None
+        return plugin.reserve(device_ids)
+
+
+def default_device_registry() -> DevicePluginRegistry:
+    return DevicePluginRegistry([TPUDevicePlugin()])

@@ -126,6 +126,24 @@ class StateFSM:
         self.store.upsert_periodic_launch(index, p["namespace"],
                                           p["job_id"], p["launch"])
 
+    def _ap_acl_policy_upsert(self, index, p):
+        from ..acl import ACLPolicy
+        self.store.upsert_acl_policy(index,
+                                     from_wire(ACLPolicy, p["policy"]))
+
+    def _ap_acl_policy_delete(self, index, p):
+        self.store.delete_acl_policy(index, p["name"])
+
+    def _ap_acl_token_upsert(self, index, p):
+        from ..acl import ACLToken
+        self.store.upsert_acl_token(index,
+                                    from_wire(ACLToken, p["token"]))
+        if p.get("bootstrap"):
+            self.store.set_acl_bootstrapped(index)
+
+    def _ap_acl_token_delete(self, index, p):
+        self.store.delete_acl_token(index, p["accessor_id"])
+
     def _ap_csi_volume_upsert(self, index, p):
         from ..structs import CSIVolume
         self.store.upsert_csi_volume(index,
@@ -184,6 +202,12 @@ class StateFSM:
             tables["csi_volumes"] = [
                 [list(k), to_wire(v)]
                 for k, v in st._t["csi_volumes"].items()]
+            tables["acl_policies"] = [
+                [k, to_wire(v)] for k, v in st._t["acl_policies"].items()]
+            tables["acl_tokens"] = [
+                [k, to_wire(v)] for k, v in st._t["acl_tokens"].items()]
+            tables["cluster_meta"] = [
+                [k, v] for k, v in st._t["cluster_meta"].items()]
             tables["scheduler_config"] = [
                 [k, to_wire(v)] for k, v in st._t["scheduler_config"].items()]
             out["tables"] = tables
@@ -214,6 +238,13 @@ class StateFSM:
             from ..structs import CSIVolume
             for k, wire in t.get("csi_volumes", ()):
                 st._t["csi_volumes"][tuple(k)] = from_wire(CSIVolume, wire)
+            from ..acl import ACLPolicy, ACLToken
+            for k, wire in t.get("acl_policies", ()):
+                st._t["acl_policies"][k] = from_wire(ACLPolicy, wire)
+            for k, wire in t.get("acl_tokens", ()):
+                st._t["acl_tokens"][k] = from_wire(ACLToken, wire)
+            for k, v in t.get("cluster_meta", ()):
+                st._t["cluster_meta"][k] = v
             for k, wire in t.get("scheduler_config", ()):
                 cfg = SchedulerConfiguration()
                 cfg.__dict__.update(wire)

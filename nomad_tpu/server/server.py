@@ -614,6 +614,58 @@ class Server:
         j.create_index = j.modify_index = j.job_modify_index = 0
         return self.register_job(j)
 
+    # --------------------------------------------------------------- ACL
+    def bootstrap_acl(self):
+        """One-time creation of the initial management token
+        (reference: acl_endpoint.go Bootstrap)."""
+        from ..acl import ACLToken
+        if self.store.acl_bootstrapped():
+            # the flag persists even if every management token is later
+            # deleted — a re-opened anonymous bootstrap would be a
+            # privilege escalation (reference: the raft-persisted
+            # bootstrap index, acl_endpoint.go Bootstrap)
+            raise ValueError("ACL already bootstrapped")
+        token = ACLToken(accessor_id=generate_uuid(),
+                         secret_id=generate_uuid(),
+                         name="Bootstrap Token", type="management",
+                         global_=True)
+        self._propose("acl_token_upsert", {"token": to_wire(token),
+                                           "bootstrap": True})
+        return token
+
+    def upsert_acl_policy(self, policy) -> int:
+        return self._propose("acl_policy_upsert",
+                             {"policy": to_wire(policy)})
+
+    def delete_acl_policy(self, name: str) -> int:
+        return self._propose("acl_policy_delete", {"name": name})
+
+    def upsert_acl_token(self, token) -> int:
+        if not token.accessor_id:
+            token.accessor_id = generate_uuid()
+        if not token.secret_id:
+            token.secret_id = generate_uuid()
+        return self._propose("acl_token_upsert",
+                             {"token": to_wire(token)})
+
+    def delete_acl_token(self, accessor_id: str) -> int:
+        return self._propose("acl_token_delete",
+                             {"accessor_id": accessor_id})
+
+    def resolve_token(self, secret_id: str):
+        """Secret -> compiled ACL (reference: nomad/acl.go ResolveToken;
+        the reference caches compiled ACLs in an LRU — policy sets here
+        are small enough to compile per call)."""
+        from ..acl import compile_acl, management_acl
+        token = self.store.acl_token_by_secret(secret_id)
+        if token is None:
+            return None
+        if token.is_management():
+            return management_acl()
+        policies = [p for p in (self.store.acl_policy_by_name(n)
+                                for n in token.policies) if p is not None]
+        return compile_acl(policies)
+
     # -------------------------------------------------------- CSI volumes
     def register_csi_volume(self, vol) -> int:
         """CSIVolume.Register analog (nomad/csi_endpoint.go)."""

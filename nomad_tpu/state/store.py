@@ -626,6 +626,71 @@ class StateStore(StateSnapshot):
                 self._refresh_job_status(index, *key)
             self._bump("allocs", index)
 
+    # -- ACL (reference: state_store.go ACLPolicy/ACLToken tables) --
+    def set_acl_bootstrapped(self, index: int) -> None:
+        with self._lock:
+            self._t["cluster_meta"]["acl_bootstrapped"] = True
+            self._bump("cluster_meta", index)
+
+    def acl_bootstrapped(self) -> bool:
+        with self._lock:
+            return bool(self._t["cluster_meta"].get("acl_bootstrapped"))
+
+    def upsert_acl_policy(self, index: int, policy) -> None:
+        with self._lock:
+            import copy as _copy
+            p = _copy.copy(policy)
+            existing = self._t["acl_policies"].get(p.name)
+            p.create_index = existing.create_index if existing else index
+            p.modify_index = index
+            self._t["acl_policies"][p.name] = p
+            self._bump("acl_policies", index)
+
+    def delete_acl_policy(self, index: int, name: str) -> None:
+        with self._lock:
+            self._t["acl_policies"].pop(name, None)
+            self._bump("acl_policies", index)
+
+    def acl_policy_by_name(self, name: str):
+        with self._lock:
+            return self._t["acl_policies"].get(name)
+
+    def acl_policies(self):
+        with self._lock:
+            return sorted(self._t["acl_policies"].values(),
+                          key=lambda p: p.name)
+
+    def upsert_acl_token(self, index: int, token) -> None:
+        with self._lock:
+            import copy as _copy
+            t = _copy.copy(token)
+            existing = self._t["acl_tokens"].get(t.accessor_id)
+            t.create_index = existing.create_index if existing else index
+            t.modify_index = index
+            self._t["acl_tokens"][t.accessor_id] = t
+            self._bump("acl_tokens", index)
+
+    def delete_acl_token(self, index: int, accessor_id: str) -> None:
+        with self._lock:
+            self._t["acl_tokens"].pop(accessor_id, None)
+            self._bump("acl_tokens", index)
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        with self._lock:
+            return self._t["acl_tokens"].get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        with self._lock:
+            for t in self._t["acl_tokens"].values():
+                if t.secret_id == secret_id:
+                    return t
+            return None
+
+    def acl_tokens(self):
+        with self._lock:
+            return sorted(self._t["acl_tokens"].values(),
+                          key=lambda t: t.accessor_id)
+
     # -- CSI volumes (reference: state_store.go CSIVolumeRegister/Claim) --
     def upsert_csi_volume(self, index: int, vol) -> None:
         with self._lock:

@@ -1,0 +1,207 @@
+"""ACL: capability compilation, token resolution, HTTP enforcement
+(reference: acl/acl_test.go capability matrix, nomad/acl_endpoint.go
+bootstrap, command/agent HTTP token checks)."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl import (ACLPolicy, ACLToken, NamespaceRule, compile_acl,
+                           management_acl)
+from nomad_tpu.acl.acl import (CAP_DENY, CAP_LIST_JOBS, CAP_READ_JOB,
+                               CAP_SUBMIT_JOB)
+from nomad_tpu.api.http_server import HTTPAgentServer
+from nomad_tpu.server.server import Server
+from nomad_tpu.utils.codec import to_wire
+
+
+def test_policy_levels_expand_to_capabilities():
+    read = compile_acl([ACLPolicy(name="r", namespaces=[
+        NamespaceRule(name="default", policy="read")])])
+    assert read.allow_namespace_op("default", CAP_READ_JOB)
+    assert not read.allow_namespace_op("default", CAP_SUBMIT_JOB)
+
+    write = compile_acl([ACLPolicy(name="w", namespaces=[
+        NamespaceRule(name="default", policy="write")])])
+    assert write.allow_namespace_op("default", CAP_SUBMIT_JOB)
+    # other namespaces stay closed
+    assert not write.allow_namespace_op("prod", CAP_READ_JOB)
+
+
+def test_deny_dominates_merge():
+    a = ACLPolicy(name="a", namespaces=[
+        NamespaceRule(name="default", policy="write")])
+    b = ACLPolicy(name="b", namespaces=[
+        NamespaceRule(name="default", policy="deny")])
+    acl = compile_acl([a, b])
+    assert not acl.allow_namespace_op("default", CAP_READ_JOB)
+    assert not acl.allow_namespace("default")
+
+
+def test_glob_longest_match_wins():
+    acl = compile_acl([ACLPolicy(name="g", namespaces=[
+        NamespaceRule(name="*", policy="read"),
+        NamespaceRule(name="prod-*", policy="deny"),
+        NamespaceRule(name="prod-web", policy="write"),
+    ])])
+    assert acl.allow_namespace_op("anything", CAP_LIST_JOBS)
+    assert not acl.allow_namespace("prod-db")
+    assert acl.allow_namespace_op("prod-web", CAP_SUBMIT_JOB)
+
+
+def test_coarse_scopes_and_management():
+    acl = compile_acl([ACLPolicy(name="n", node="read", agent="write")])
+    assert acl.allow_node_read() and not acl.allow_node_write()
+    assert acl.allow_agent_write()
+    assert not acl.allow_operator_read()
+    assert management_acl().allow_namespace_op("x", CAP_SUBMIT_JOB)
+    assert management_acl().allow_operator_write()
+
+
+def test_server_bootstrap_and_resolution():
+    srv = Server(num_workers=0)
+    srv.start()
+    try:
+        boot = srv.bootstrap_acl()
+        assert boot.is_management()
+        with pytest.raises(ValueError):
+            srv.bootstrap_acl()             # once only
+        srv.upsert_acl_policy(ACLPolicy(name="readonly", namespaces=[
+            NamespaceRule(name="default", policy="read")]))
+        tok = ACLToken(name="ro", policies=["readonly"])
+        srv.upsert_acl_token(tok)
+        acl = srv.resolve_token(tok.secret_id)
+        assert acl.allow_namespace_op("default", CAP_READ_JOB)
+        assert not acl.allow_namespace_op("default", CAP_SUBMIT_JOB)
+        assert srv.resolve_token("bogus") is None
+        assert srv.resolve_token(boot.secret_id).management
+    finally:
+        srv.stop()
+
+
+def _req(base, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        base + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json",
+                 **({"X-Nomad-Token": token} if token else {})})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_http_enforcement():
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv, acl_enabled=True)
+    http.start()
+    base = http.address
+    try:
+        # bootstrap is reachable without a token
+        boot = _req(base, "POST", "/v1/acl/bootstrap")
+        mgmt = boot["secret_id"]
+        # no token -> 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(base, "GET", "/v1/jobs")
+        assert ei.value.code == 403
+        # management token passes everywhere
+        assert _req(base, "GET", "/v1/jobs", token=mgmt) == []
+
+        # read-only client token: GET ok, POST rejected
+        _req(base, "PUT", "/v1/acl/policy/readonly", {
+            "name": "readonly",
+            "namespaces": [{"name": "default", "policy": "read"}]},
+            token=mgmt)
+        tok = _req(base, "POST", "/v1/acl/tokens",
+                   {"name": "ro", "policies": ["readonly"]}, token=mgmt)
+        ro = tok["secret_id"]
+        assert _req(base, "GET", "/v1/jobs", token=ro) == []
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(base, "POST", "/v1/jobs",
+                 {"job": to_wire(mock.job())}, token=ro)
+        assert ei.value.code == 403
+        # and the management token can register
+        out = _req(base, "POST", "/v1/jobs",
+                   {"job": to_wire(mock.job())}, token=mgmt)
+        assert out["eval_id"]
+        # token listing never leaks secrets
+        toks = _req(base, "GET", "/v1/acl/tokens", token=mgmt)
+        assert all("secret_id" not in t for t in toks)
+    finally:
+        http.stop()
+        srv.stop()
+
+
+def test_acl_routes_require_management_and_bootstrap_stays_closed():
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv, acl_enabled=True)
+    http.start()
+    base = http.address
+    try:
+        boot = _req(base, "POST", "/v1/acl/bootstrap")
+        mgmt = boot["secret_id"]
+        _req(base, "PUT", "/v1/acl/policy/op", {
+            "name": "op", "operator": "write",
+            "namespaces": [{"name": "default", "policy": "read"}]},
+            token=mgmt)
+        tok = _req(base, "POST", "/v1/acl/tokens",
+                   {"name": "op", "policies": ["op"]}, token=mgmt)
+        op = tok["secret_id"]
+        # operator-write may touch /v1/system but NOT mint tokens or
+        # read token secrets
+        for method, path, body in (
+                ("POST", "/v1/acl/tokens", {"type": "management"}),
+                ("GET", f"/v1/acl/token/{boot['accessor_id']}", None),
+                ("GET", "/v1/acl/policies", None)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(base, method, path, body, token=op)
+            assert ei.value.code == 403
+
+        # deleting the bootstrap token must NOT reopen bootstrap
+        _req(base, "DELETE", f"/v1/acl/token/{boot['accessor_id']}",
+             token=mgmt)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(base, "POST", "/v1/acl/bootstrap")
+        assert ei.value.code == 400
+    finally:
+        http.stop()
+        srv.stop()
+
+
+def test_body_namespace_cannot_launder_past_query_namespace():
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv, acl_enabled=True)
+    http.start()
+    base = http.address
+    try:
+        mgmt = _req(base, "POST", "/v1/acl/bootstrap")["secret_id"]
+        _req(base, "PUT", "/v1/acl/policy/dev-only", {
+            "name": "dev-only",
+            "namespaces": [{"name": "dev", "policy": "write"}]},
+            token=mgmt)
+        dev = _req(base, "POST", "/v1/acl/tokens",
+                   {"name": "d", "policies": ["dev-only"]},
+                   token=mgmt)["secret_id"]
+        job = mock.job()
+        job.namespace = "prod"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(base, "POST", "/v1/jobs?namespace=dev",
+                 {"job": to_wire(job)}, token=dev)
+        assert ei.value.code == 403
+        # read-only search stays readable for read tokens
+        _req(base, "PUT", "/v1/acl/policy/reader", {
+            "name": "reader",
+            "namespaces": [{"name": "default", "policy": "read"}]},
+            token=mgmt)
+        ro = _req(base, "POST", "/v1/acl/tokens",
+                  {"name": "r", "policies": ["reader"]},
+                  token=mgmt)["secret_id"]
+        out = _req(base, "POST", "/v1/search",
+                   {"prefix": "x", "context": "jobs"}, token=ro)
+        assert out["matches"]["jobs"] == []
+    finally:
+        http.stop()
+        srv.stop()

@@ -1,0 +1,76 @@
+"""Device plugin interface (reference: plugins/device protocol,
+devices/gpu/nvidia blueprint, client devicemanager wiring)."""
+import os
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.plugins.device import (DevicePluginRegistry,
+                                      MockDevicePlugin, TPUDevicePlugin,
+                                      default_device_registry)
+from nomad_tpu.server.server import Server
+from nomad_tpu.structs import NodeDevice, NodeDeviceResource, RequestedDevice
+
+
+def fake_group(model="v4", count=2):
+    return NodeDeviceResource(
+        vendor="acme", type="fpga", name=model,
+        instances=[NodeDevice(id=f"{model}-{i}", healthy=True)
+                   for i in range(count)])
+
+
+def test_registry_fingerprint_and_reserve_routing():
+    p1 = MockDevicePlugin([fake_group("a", 2)], env_key="DEV_A")
+    p2 = MockDevicePlugin([fake_group("b", 1)], env_key="DEV_B")
+    reg = DevicePluginRegistry([p1, p2])
+    groups = reg.fingerprint_all()
+    assert [g.name for g in groups] == ["a", "b"]
+    res = reg.reserve("acme", "fpga", "b", ["b-0"])
+    assert res.envs == {"DEV_B": "b-0"}
+    assert p2.reserved == [["b-0"]]
+    assert reg.reserve("acme", "fpga", "zzz", ["x"]) is None
+
+
+def test_tpu_plugin_is_failure_tolerant():
+    # on the CPU test platform jax reports no TPUs; the plugin must
+    # return an empty inventory, never raise
+    assert TPUDevicePlugin().fingerprint() == []
+    assert default_device_registry().fingerprint_all() == []
+
+
+def test_device_ask_e2e_env_injection(tmp_path):
+    """A job asking for device instances gets them assigned by the
+    solver AND its task env carries the plugin's reservation recipe."""
+    srv = Server(num_workers=2)
+    srv.start()
+    plugin = MockDevicePlugin([fake_group("v9", 2)], env_key="ACME_VISIBLE")
+    reg = DevicePluginRegistry([plugin])
+    client = Client(srv, data_dir=str(tmp_path), device_registry=reg)
+    try:
+        client.start()
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        out_file = str(tmp_path / "envdump")
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", f"env > {out_file}; sleep 30"]}
+        task.resources.networks = []
+        task.resources.devices = [RequestedDevice(name="acme/fpga/v9",
+                                                  count=2)]
+        srv.register_job(job)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_RUNNING
+            for a in srv.store.allocs_by_job("default", job.id)),
+            timeout=25)
+        assert wait_until(lambda: os.path.exists(out_file), timeout=5)
+        env = dict(line.split("=", 1)
+                   for line in open(out_file).read().splitlines()
+                   if "=" in line)
+        assert sorted(env["ACME_VISIBLE"].split(",")) == ["v9-0", "v9-1"]
+        assert plugin.reserved and sorted(plugin.reserved[0]) == \
+            ["v9-0", "v9-1"]
+    finally:
+        client.shutdown(halt_tasks=True)
+        srv.stop()
