@@ -60,6 +60,11 @@ _APPROX_MIN_NP = 4096
 # value-vocabulary size up to which spread lookups unroll as select-sums
 # (gather-free); larger vocabularies fall back to take_along_axis
 _SELECT_SUM_MAX_V = 16
+# group-count at or below which a batch is treated as "merged few-group"
+# (throughput-mode ask dedup): the wave-width cap widens since top-k
+# over so few rows is cheap. Shared by resident._group_count_hint and
+# merged-mode callers sizing gp.
+MERGED_GP_MAX = 16
 
 
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
@@ -127,7 +132,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # (direct callers), fall back to the conservative K-based bound so
     # skewed batches still converge.
     per_group = group_count_hint if group_count_hint > 0 else K // 8
-    TK = min(max(WAVE_K, min(2 * per_group, 256)) + TOP_K, Np)
+    # merged few-group batches (throughput-mode ask dedup) carry far
+    # more placements per group; with tiny Gp the top-k cost of a wider
+    # window is negligible, so let W grow
+    w_cap = 1024 if Gp <= MERGED_GP_MAX else 256
+    TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, Np)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..structs import Node
-from .kernel import NEG_INF, TOP_K, solve_kernel
+from .kernel import MERGED_GP_MAX, NEG_INF, TOP_K, solve_kernel
 from .tensorize import PackedBatch, PlacementAsk, Tensorizer
 
 # per-placement outcome in the packed result's last column
@@ -214,16 +214,59 @@ class ResidentSolver:
                                          bool)
         self._default_host_ok[:, :t.n_real] = True
 
-    def pack_batch(self, asks: Sequence[PlacementAsk]
+    def pack_batch(self, asks: Sequence[PlacementAsk],
+                   job_keys: Optional[set] = None
                    ) -> Optional[PackedBatch]:
-        """Ask-side-only pack against the resident universe."""
+        """Ask-side-only pack against the resident universe. job_keys
+        overrides the same-job stream guard's key set — merge_asks
+        callers pass the PRE-merge keys so absorbed jobs still count."""
         pb = self._tz.repack_asks(self.nodes, asks, self.template,
                                   gp=self.gp, kp=self.kp,
                                   drv_cache=self._drv_cache,
                                   row_cache=self._row_cache)
         if pb is not None:
-            pb.job_keys = {(a.job.namespace, a.job.id) for a in asks}
+            pb.job_keys = (job_keys if job_keys is not None else
+                           {(a.job.namespace, a.job.id) for a in asks})
         return pb
+
+    def merge_asks(self, asks: Sequence[PlacementAsk]
+                   ) -> Tuple[List[PlacementAsk], set]:
+        """Throughput-mode ask dedup: asks with the SAME spec signature
+        and no per-eval state collapse into one group row with the
+        summed count, shrinking the [G, N] wave work by the workload's
+        duplication factor — the columnar payoff of coalescing evals.
+        Job-scoped soft scoring (anti-affinity, spread progress) is then
+        computed over the merged population rather than per job; the
+        hard commit quotas stay exact, and distinct_hosts (at ANY level,
+        incl. per-task) / stateful asks never merge. Returns (merged
+        asks, job keys of EVERY original ask — pass to pack_batch so the
+        stream guard still sees absorbed jobs). Exact-mode callers
+        (tests, quality comparisons) skip this entirely."""
+        import dataclasses
+        from ..scheduler import feasible as hostfeas
+        from ..structs import CONSTRAINT_DISTINCT_HOSTS
+        merged: Dict = {}
+        out: List[PlacementAsk] = []
+        order: List = []
+        keys = {(a.job.namespace, a.job.id) for a in asks}
+        for a in asks:
+            stateful = (a.penalty_nodes or a.existing_by_node
+                        or a.distinct_hosts_blocked or a.spread_seed
+                        or a.property_limits)
+            distinct = any(
+                c.operand == CONSTRAINT_DISTINCT_HOSTS
+                for c in hostfeas.merged_constraints(a.job, a.tg))
+            if stateful or distinct:
+                out.append(a)
+                continue
+            sig = self._tz.ask_signature(a)
+            if sig in merged:
+                merged[sig] = dataclasses.replace(
+                    merged[sig], count=merged[sig].count + a.count)
+            else:
+                merged[sig] = a
+                order.append(sig)
+        return [merged[sig] for sig in order] + out, keys
 
     def solve_stream(self, batches: Sequence[PackedBatch],
                      seeds: Optional[Sequence[int]] = None
@@ -291,10 +334,13 @@ class ResidentSolver:
                     pb.p_ask[:pb.n_place]).max()))
         # floor at 64: one compiled variant covers all small counts
         # (reduced drain/retry batches would otherwise each compile
-        # their own bucket). Ceil at 128: the kernel clamps the wave
-        # width at 2*128, so larger hints would compile byte-identical
-        # programs.
-        return min(1 << max(6, (m - 1).bit_length()), 128)
+        # their own bucket). The ceiling mirrors the kernel's wave-width
+        # clamp (2*128 for wide batches, 2*512 for merged few-group
+        # batches <= MERGED_GP_MAX rows) — larger hints would compile
+        # byte-identical programs.
+        gp = max((pb.ask_res.shape[0] for pb in batches), default=0)
+        cap = 512 if gp <= MERGED_GP_MAX else 128
+        return min(1 << max(6, (m - 1).bit_length()), cap)
 
     @staticmethod
     def _unpack(out) -> Tuple[np.ndarray, np.ndarray, np.ndarray,

@@ -170,3 +170,39 @@ def test_solve_stream_capacity_exhaustion_fails_late_batches():
     assert ok[0, :4, 0].all()
     assert not ok[1, :4, 0].any()       # cluster is full
     assert (status[1, :4] == 0).all()   # terminal failure, not retry
+
+
+def test_merge_asks_semantics():
+    """Throughput-mode dedup: identical fresh asks merge with summed
+    counts and ALL job keys kept; stateful and distinct_hosts asks
+    (even task-level) never merge."""
+    from nomad_tpu import mock
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+    from nomad_tpu.structs import CONSTRAINT_DISTINCT_HOSTS, Constraint
+
+    nodes = [mock.node() for _ in range(8)]
+    def ask(job_id, count=2, task_distinct=False, stateful=False):
+        j = mock.job()
+        j.id = job_id
+        tg = j.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.networks = []
+        if task_distinct:
+            tg.tasks[0].constraints = [
+                Constraint(operand=CONSTRAINT_DISTINCT_HOSTS)]
+        kw = {}
+        if stateful:
+            kw["penalty_nodes"] = frozenset({nodes[0].id})
+        return PlacementAsk(job=j, tg=tg, count=count, **kw)
+
+    rs = ResidentSolver(nodes, [ask("probe")], gp=16, kp=64)
+    merged, keys = rs.merge_asks([
+        ask("j1"), ask("j2"), ask("j3", task_distinct=True),
+        ask("j4", stateful=True)])
+    # j1+j2 merged (count 4); distinct + stateful stay separate
+    assert len(merged) == 3
+    assert merged[0].count == 4
+    assert keys == {("default", f"j{i}") for i in range(1, 5)}
+    pb = rs.pack_batch(merged, job_keys=keys)
+    assert pb.job_keys == keys
