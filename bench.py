@@ -379,17 +379,17 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
 
 def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
                        evals_per_call=128):
-    """Config 5: one ResidentSolver per region (each region its own node
-    universe, as a per-region TPU would own it); all regions' fused
-    streams DISPATCH before any result is fetched, so the transport
-    round trips overlap — the single-chip stand-in for per-region
-    devices solving concurrently."""
+    """Config 5: one ResidentSolver per region (each region its own
+    node universe, as a per-region TPU would own it); one THREAD per
+    region packs, dispatches and fetches its stream concurrently — the
+    single-chip stand-in for per-region control planes driving their
+    own devices."""
     from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
 
     t0 = time.perf_counter()
     epc = min(evals_per_call, n_evals)
     NB = -(-n_evals // epc)
-    solvers, all_batches = [], []
+    solvers = []
     for r in range(n_regions):
         nodes = make_nodes(n_nodes)
         probe_job = make_job(5, 0, count)
@@ -410,8 +410,14 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
     startup_s = time.perf_counter() - t0
 
     t_start = time.perf_counter()
-    outs = []
-    for r, rs in enumerate(solvers):
+    # one thread per region: pack + dispatch + fetch run concurrently,
+    # as per-region control planes would (numpy packing and jax
+    # dispatch/transfer release the GIL for most of their time)
+    from concurrent.futures import ThreadPoolExecutor
+    all_batches = [None] * n_regions
+
+    def region_run(r):
+        rs = solvers[r]
         jobs = [make_job(5, r * n_evals + e, count)
                 for e in range(n_evals)]
         batches = []
@@ -420,12 +426,16 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
                 sum((asks_for(j) for j in jobs[i:i + epc]), []))
             pb = rs.pack_batch(masks, job_keys=mkeys)
             batches.append(pb)
-        all_batches.append(batches)
-        outs.append(rs.solve_stream_async(
-            batches, seeds=[r * NB + b + 1 for b in range(NB)]))
+        all_batches[r] = batches
+        out = rs.solve_stream_async(
+            batches, seeds=[r * NB + b + 1 for b in range(NB)])
+        return rs.finish_stream(out)
+
+    with ThreadPoolExecutor(max_workers=n_regions) as pool:
+        results = list(pool.map(region_run, range(n_regions)))
     placed = failed = unresolved = 0
-    for r, rs in enumerate(solvers):
-        _, ok, _, status = rs.finish_stream(outs[r])
+    for r in range(n_regions):
+        _, ok, _, status = results[r]
         for b, pb in enumerate(all_batches[r]):
             placed += int(ok[b, :pb.n_place, 0].sum())
             failed += int((status[b, :pb.n_place] == 0).sum())
