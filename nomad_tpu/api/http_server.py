@@ -165,18 +165,18 @@ class HTTPAgentServer:
         if a is None:
             raise HTTPError(403, "token required" if not token
                             else "invalid token")
-        # the namespace the request ACTUALLY operates on: a submitted
-        # job's body namespace overrides the query parameter (otherwise
-        # ?namespace=dev would launder a prod-namespace body past the
-        # check)
+        # the namespace the request ACTUALLY operates on must match
+        # what the handler will use: job handlers take the submitted
+        # job's body namespace (otherwise ?namespace=dev would launder a
+        # prod-namespace body past the check); every other handler reads
+        # the query parameter, so the check does too
         ns = q.get("namespace", "default")
-        if isinstance(body, dict):
+        if path.startswith(("/v1/jobs", "/v1/job/")) \
+                and isinstance(body, dict):
             job_body = body.get("job") if isinstance(body.get("job"),
                                                      dict) else None
             if job_body and job_body.get("namespace"):
                 ns = job_body["namespace"]
-            elif body.get("namespace"):
-                ns = body["namespace"]
         if path.startswith("/v1/acl"):
             # token/policy management is management-only (reference:
             # acl_endpoint.go IsManagement checks) — operator scope
@@ -186,9 +186,15 @@ class HTTPAgentServer:
             return
         write = (method in ("POST", "PUT", "DELETE")
                  and path != "/v1/search")
+        if path.startswith("/v1/secret"):
+            # secrets are write-class EVEN TO READ: a read-only job
+            # token must not exfiltrate raw secret values
+            if not a.allow_namespace_op(ns, aclmod.CAP_SUBMIT_JOB):
+                raise HTTPError(403, "secrets require namespace write")
+            return
         if path.startswith(("/v1/jobs", "/v1/job/", "/v1/allocation",
                             "/v1/evaluation", "/v1/deployment",
-                            "/v1/search", "/v1/volume")):
+                            "/v1/search", "/v1/volume", "/v1/service")):
             cap = (aclmod.CAP_SUBMIT_JOB if write
                    else aclmod.CAP_READ_JOB)
             if not a.allow_namespace_op(ns, cap):
@@ -555,6 +561,43 @@ class HTTPAgentServer:
                      "truncations": truncations}, \
             self.server.store.latest_index()
 
+    def services_list(self, q, body):
+        ns = q.get("namespace", "default")
+        index = self._block(q, "services")
+        return 200, self.server.store.service_names(ns), index
+
+    def service_get(self, q, body, name):
+        ns = q.get("namespace", "default")
+        index = self._block(q, "services")
+        regs = self.server.store.services_by_name(ns, name)
+        return 200, [to_wire(r) for r in regs], index
+
+    def secrets_list(self, q, body):
+        ns = q.get("namespace", "default")
+        return 200, self.server.store.secret_paths(ns), \
+            self.server.store.table_index("secrets")
+
+    def secret_get(self, q, body, path):
+        ns = q.get("namespace", "default")
+        d = self.server.store.secret_by_path(ns, path)
+        if d is None:
+            raise HTTPError(404, f"secret {path} not found")
+        return 200, {"path": path, "data": d}, \
+            self.server.store.table_index("secrets")
+
+    def secret_put(self, q, body, path):
+        ns = q.get("namespace", "default")
+        if (not body or "data" not in body
+                or not isinstance(body["data"], dict)):
+            raise HTTPError(400, "body must carry a 'data' object")
+        index = self.server.upsert_secret(ns, path, body["data"])
+        return 200, {"index": index}, index
+
+    def secret_delete(self, q, body, path):
+        ns = q.get("namespace", "default")
+        index = self.server.delete_secret(ns, path)
+        return 200, {"index": index}, index
+
     def acl_bootstrap(self, q, body):
         try:
             token = self.server.bootstrap_acl()
@@ -719,4 +762,11 @@ def _build_routes(s: HTTPAgentServer):
                                   "PUT": s.acl_token_upsert}),
         (R(r"^/v1/acl/token/([^/]+)$"), {"GET": s.acl_token_get,
                                          "DELETE": s.acl_token_delete}),
+        (R(r"^/v1/services$"), {"GET": s.services_list}),
+        (R(r"^/v1/service/([^/]+)$"), {"GET": s.service_get}),
+        (R(r"^/v1/secrets$"), {"GET": s.secrets_list}),
+        (R(r"^/v1/secret/(.+)$"), {"GET": s.secret_get,
+                                   "PUT": s.secret_put,
+                                   "POST": s.secret_put,
+                                   "DELETE": s.secret_delete}),
     ]

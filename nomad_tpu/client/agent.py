@@ -50,6 +50,12 @@ class ServerEndpoints:
     def update_allocs(self, updates: List[Allocation]) -> None:
         raise NotImplementedError
 
+    def get_secret(self, namespace: str, path: str):
+        """Fetch one secret's data dict (None if missing) — the task
+        runner resolves ${secret...} references through this at task
+        start (the Vault-token fetch analog)."""
+        raise NotImplementedError
+
 
 class InProcServer(ServerEndpoints):
     """Direct adapter over nomad_tpu.server.server.Server."""
@@ -68,6 +74,9 @@ class InProcServer(ServerEndpoints):
 
     def update_allocs(self, updates: List[Allocation]) -> None:
         self.server.update_allocs_from_client(updates)
+
+    def get_secret(self, namespace: str, path: str):
+        return self.server.store.secret_by_path(namespace, path)
 
 
 class Client:
@@ -236,7 +245,8 @@ class Client:
     def _new_runner(self, alloc: Allocation) -> AllocRunner:
         return AllocRunner(alloc, self.data_dir, self.registry, self.node,
                            self._queue_update, state_db=self.state_db,
-                           device_registry=self.device_registry)
+                           device_registry=self.device_registry,
+                           secrets_fetcher=self.servers.get_secret)
 
     def _fail_alloc(self, alloc: Allocation, reason: str) -> None:
         import copy

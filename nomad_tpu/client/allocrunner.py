@@ -48,10 +48,12 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, data_dir: str, registry,
                  node: Optional[Node],
                  on_alloc_update: Callable[[Allocation], None],
-                 state_db=None, device_registry=None):
+                 state_db=None, device_registry=None,
+                 secrets_fetcher=None):
         self.alloc = alloc
         self.registry = registry
         self.device_registry = device_registry
+        self.secrets_fetcher = secrets_fetcher
         self.node = node
         self.on_alloc_update = on_alloc_update
         self.state_db = state_db
@@ -80,7 +82,8 @@ class AllocRunner:
             self.task_runners.append(TaskRunner(
                 self.alloc, task, self.alloc_dir, driver, self.node,
                 self._on_task_state_change, state_db=self.state_db,
-                device_registry=self.device_registry))
+                device_registry=self.device_registry,
+                secrets_fetcher=self.secrets_fetcher))
 
     # ---------------------------------------------------------- lifecycle
     def run(self) -> None:

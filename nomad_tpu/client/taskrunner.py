@@ -84,7 +84,8 @@ class TaskRunner:
     def __init__(self, alloc: Allocation, task: Task, alloc_dir: AllocDir,
                  driver: DriverPlugin, node: Optional[Node],
                  on_state_change: Callable[["TaskRunner"], None],
-                 state_db=None, device_registry=None):
+                 state_db=None, device_registry=None,
+                 secrets_fetcher=None):
         self.alloc = alloc
         self.task = task
         self.alloc_dir = alloc_dir
@@ -93,6 +94,7 @@ class TaskRunner:
         self.on_state_change = on_state_change
         self.state_db = state_db
         self.device_registry = device_registry
+        self.secrets_fetcher = secrets_fetcher
         self.task_id = f"{alloc.id}/{task.name}"
         self.state = TaskState(state=TASK_STATE_PENDING)
         self.handle: Optional[TaskHandle] = None
@@ -217,6 +219,38 @@ class TaskRunner:
         self._persist()
         self.on_state_change(self)
 
+    def _resolve_secrets(self, env: dict) -> dict:
+        """Resolve ${secret.<path>.<key>} references in task env values
+        against the server's native secret store (the Vault template
+        analog: secrets reach the task as env, never touch server-side
+        job state). An unresolvable reference fails the task at setup."""
+        import re
+        pat = re.compile(r"\$\{secret\.([A-Za-z0-9_\-/]+)\.([A-Za-z0-9_\-]+)\}")
+        if self.secrets_fetcher is None:
+            return env
+        out = {}
+        cache: dict = {}
+        for k, v in env.items():
+            def sub(m):
+                path, key = m.group(1), m.group(2)
+                if path not in cache:
+                    try:
+                        cache[path] = self.secrets_fetcher(
+                            self.alloc.namespace, path)
+                    except Exception as e:     # noqa: BLE001
+                        # transport blip (leader election, network):
+                        # recoverable — let the restart policy retry
+                        # instead of permanently failing the task
+                        raise DriverError(
+                            f"secret fetch failed: {e}") from e
+                data = cache[path]
+                if data is None or key not in data:
+                    raise RuntimeError(
+                        f"unresolvable secret ${{secret.{path}.{key}}}")
+                return data[key]
+            out[k] = pat.sub(sub, v) if isinstance(v, str) else v
+        return out
+
     def _device_envs(self) -> dict:
         """Reserve this task's assigned device instances through their
         owning plugins; their env recipe joins the task environment
@@ -247,6 +281,7 @@ class TaskRunner:
             alloc_dir=self.alloc_dir.shared,
             secrets_dir=self.alloc_dir.secrets_dir(self.task.name))
         env.update(self._device_envs())
+        env = self._resolve_secrets(env)
         vars_ = dict(node_vars(self.node))
         vars_.update({f"env.{k}": v for k, v in env.items()})
         vars_.update(env)

@@ -126,6 +126,13 @@ class StateFSM:
         self.store.upsert_periodic_launch(index, p["namespace"],
                                           p["job_id"], p["launch"])
 
+    def _ap_secret_upsert(self, index, p):
+        self.store.upsert_secret(index, p["namespace"], p["path"],
+                                 p["data"])
+
+    def _ap_secret_delete(self, index, p):
+        self.store.delete_secret(index, p["namespace"], p["path"])
+
     def _ap_acl_policy_upsert(self, index, p):
         from ..acl import ACLPolicy
         self.store.upsert_acl_policy(index,
@@ -208,6 +215,10 @@ class StateFSM:
                 [k, to_wire(v)] for k, v in st._t["acl_tokens"].items()]
             tables["cluster_meta"] = [
                 [k, v] for k, v in st._t["cluster_meta"].items()]
+            tables["services"] = [
+                [k, to_wire(v)] for k, v in st._t["services"].items()]
+            tables["secrets"] = [
+                [list(k), v] for k, v in st._t["secrets"].items()]
             tables["scheduler_config"] = [
                 [k, to_wire(v)] for k, v in st._t["scheduler_config"].items()]
             out["tables"] = tables
@@ -245,6 +256,12 @@ class StateFSM:
                 st._t["acl_tokens"][k] = from_wire(ACLToken, wire)
             for k, v in t.get("cluster_meta", ()):
                 st._t["cluster_meta"][k] = v
+            from ..structs.services import ServiceRegistration
+            for k, wire in t.get("services", ()):
+                st._t["services"][k] = from_wire(ServiceRegistration,
+                                                 wire)
+            for k, v in t.get("secrets", ()):
+                st._t["secrets"][tuple(k)] = v
             for k, wire in t.get("scheduler_config", ()):
                 cfg = SchedulerConfiguration()
                 cfg.__dict__.update(wire)

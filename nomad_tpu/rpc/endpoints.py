@@ -43,6 +43,7 @@ class ServerRpc:
             ("Node.Heartbeat", self._node_heartbeat, True),
             ("Node.GetClientAllocs", self._get_client_allocs, False),
             ("Node.UpdateAlloc", self._update_alloc, True),
+            ("Secret.Get", self._secret_get, False),
             ("Job.Register", self._job_register, True),
             ("Job.Deregister", self._job_deregister, True),
             ("Status.Leader", self._status_leader, False),
@@ -68,6 +69,10 @@ class ServerRpc:
     def _update_alloc(self, params):
         updates = [from_wire(Allocation, u) for u in params[0]]
         return self.server.update_allocs_from_client(updates)
+
+    def _secret_get(self, params):
+        namespace, path = params
+        return self.server.store.secret_by_path(namespace, path)
 
     def _job_register(self, params):
         job = from_wire(Job, params[0])
@@ -161,6 +166,9 @@ class RpcServerEndpoints(ServerEndpoints):
     def update_allocs(self, updates: List[Allocation]) -> None:
         self._call("Node.UpdateAlloc",
                    [[to_wire(u) for u in updates]])
+
+    def get_secret(self, namespace: str, path: str):
+        return self._call("Secret.Get", [namespace, path])
 
     # convenience for tests / CLI parity over the wire
     def register_job(self, job: Job):

@@ -614,6 +614,18 @@ class Server:
         j.create_index = j.modify_index = j.job_modify_index = 0
         return self.register_job(j)
 
+    # ------------------------------------------------------------ secrets
+    def upsert_secret(self, namespace: str, path: str,
+                      data: Dict[str, str]) -> int:
+        """Native secret KV write (the Vault-analog store; raft-
+        replicated like every other table)."""
+        return self._propose("secret_upsert", {
+            "namespace": namespace, "path": path, "data": dict(data)})
+
+    def delete_secret(self, namespace: str, path: str) -> int:
+        return self._propose("secret_delete",
+                             {"namespace": namespace, "path": path})
+
     # --------------------------------------------------------------- ACL
     def bootstrap_acl(self):
         """One-time creation of the initial management token

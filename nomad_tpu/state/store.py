@@ -26,7 +26,8 @@ from ..structs.consts import (EVAL_STATUS_BLOCKED, EVAL_STATUS_COMPLETE,
 TABLES = ("nodes", "jobs", "job_versions", "job_summaries", "evals", "allocs",
           "deployments", "periodic_launches", "scheduler_config", "indexes",
           "acl_policies", "acl_tokens", "scaling_policies", "scaling_events",
-          "vault_accessors", "csi_volumes", "csi_plugins", "cluster_meta")
+          "vault_accessors", "csi_volumes", "csi_plugins", "cluster_meta",
+          "services", "secrets")
 
 
 class JobSummary:
@@ -507,6 +508,10 @@ class StateStore(StateSnapshot):
         self._t["_allocs_by_node"].setdefault(a.node_id, set()).add(a.id)
         self._t["_allocs_by_job"].setdefault(
             (a.namespace, a.job_id), set()).add(a.id)
+        # server-side terminal transitions (lost nodes, evictions) must
+        # drop the alloc's service registrations too — the dead client
+        # will never send the update that would
+        self._sync_services_locked(index, a)
 
     _SUMMARY_BUCKETS = {"pending": "starting", "running": "running",
                         "complete": "complete", "failed": "failed",
@@ -596,6 +601,7 @@ class StateStore(StateSnapshot):
         # reported client-terminal (lost node, forced GC) — otherwise
         # the volume is stuck in-use forever
         self._release_csi_claims_locked(index or self.index, alloc_id)
+        self._drop_services_locked(index or self.index, alloc_id)
 
     def update_allocs_from_client(self, index: int,
                                   updates: List[Allocation]) -> None:
@@ -622,9 +628,119 @@ class StateStore(StateSnapshot):
                     # (reference: csi_hook postrun -> Volume.Unpublish)
                     self._release_csi_claims_locked(index, a.id)
                 self._t["allocs"][a.id] = a
+                self._sync_services_locked(index, a)
             for key in {(u.namespace, u.job_id) for u in updates}:
                 self._refresh_job_status(index, *key)
             self._bump("allocs", index)
+
+    # -- native service discovery (derived from task liveness) --
+    def _sync_services_locked(self, index: int, alloc) -> None:
+        """Recompute the alloc's registrations from its task states
+        (reference: the consul service hook register/deregister on task
+        start/stop; here the catalog is native, FSM-deterministic).
+        Idempotent: the table index only bumps when the registration set
+        actually changes, so blocking-query watchers don't wake on
+        unrelated alloc updates."""
+        from ..structs.services import ServiceRegistration
+        from ..structs import TASK_STATE_RUNNING
+        job = alloc.job or self._t["jobs"].get(
+            (alloc.namespace, alloc.job_id))
+        current = {k: r for k, r in self._t["services"].items()
+                   if r.alloc_id == alloc.id}
+        desired = {}
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if (tg is not None and not alloc.client_terminal_status()
+                and not alloc.server_terminal_status()):
+            node = self._t["nodes"].get(alloc.node_id)
+            address = ""
+            if node is not None and node.node_resources.networks:
+                address = node.node_resources.networks[0].ip
+            for task in tg.tasks:
+                st = alloc.task_states.get(task.name)
+                if st is None or st.state != TASK_STATE_RUNNING:
+                    continue
+                tr = alloc.allocated_resources.tasks.get(task.name)
+                for svc in task.services:
+                    port = 0
+                    if tr is not None and svc.port_label:
+                        for net in tr.networks:
+                            for p in (list(net.reserved_ports)
+                                      + list(net.dynamic_ports)):
+                                if p.label == svc.port_label:
+                                    port = p.value
+                    rid = f"{alloc.id}-{task.name}-{svc.name}"
+                    desired[rid] = ServiceRegistration(
+                        id=rid, service_name=svc.name,
+                        namespace=alloc.namespace,
+                        job_id=alloc.job_id, alloc_id=alloc.id,
+                        node_id=alloc.node_id, task=task.name,
+                        address=address, port=port,
+                        tags=list(svc.tags),
+                        create_index=index, modify_index=index)
+        same = (current.keys() == desired.keys() and all(
+            (current[k].address, current[k].port, current[k].tags)
+            == (desired[k].address, desired[k].port, desired[k].tags)
+            for k in desired))
+        if same:
+            return
+        for k in current.keys() - desired.keys():
+            del self._t["services"][k]
+        for k, reg in desired.items():
+            old = current.get(k)
+            if old is not None:
+                reg.create_index = old.create_index
+            self._t["services"][k] = reg
+        self._bump("services", index)
+
+    def _drop_services_locked(self, index: int, alloc_id: str,
+                              bump: bool = True) -> bool:
+        doomed = [k for k, r in self._t["services"].items()
+                  if r.alloc_id == alloc_id]
+        for k in doomed:
+            del self._t["services"][k]
+        if doomed and bump:
+            self._bump("services", index)
+        return bool(doomed)
+
+    def service_names(self, namespace: str = "default"):
+        with self._lock:
+            out = {}
+            for r in self._t["services"].values():
+                if r.namespace != namespace:
+                    continue
+                out.setdefault(r.service_name, set()).update(r.tags)
+            return [{"ServiceName": name, "Tags": sorted(tags)}
+                    for name, tags in sorted(out.items())]
+
+    def services_by_name(self, namespace: str, name: str):
+        with self._lock:
+            return sorted((r for r in self._t["services"].values()
+                           if r.namespace == namespace
+                           and r.service_name == name),
+                          key=lambda r: r.id)
+
+    # -- secrets (native KV; the Vault-analog secret store) --
+    def upsert_secret(self, index: int, namespace: str, path: str,
+                      data: Dict[str, str]) -> None:
+        with self._lock:
+            self._t["secrets"][(namespace, path)] = dict(data)
+            self._bump("secrets", index)
+
+    def delete_secret(self, index: int, namespace: str,
+                      path: str) -> None:
+        with self._lock:
+            self._t["secrets"].pop((namespace, path), None)
+            self._bump("secrets", index)
+
+    def secret_by_path(self, namespace: str, path: str):
+        with self._lock:
+            d = self._t["secrets"].get((namespace, path))
+            return dict(d) if d is not None else None
+
+    def secret_paths(self, namespace: str = "default"):
+        with self._lock:
+            return sorted(p for (ns, p) in self._t["secrets"]
+                          if ns == namespace)
 
     # -- ACL (reference: state_store.go ACLPolicy/ACLToken tables) --
     def set_acl_bootstrapped(self, index: int) -> None:

@@ -1,0 +1,140 @@
+"""Native service discovery + the secret store (reference: the consul
+service hook's register/deregister lifecycle and Vault's task-secret
+delivery, both recast as native raft-backed tables)."""
+import json
+import urllib.request
+
+from nomad_tpu import mock, structs
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.server.server import Server
+from nomad_tpu.structs.job import Service
+
+
+def http_job(tmp_path=None, env=None):
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+    task.resources.networks = []
+    if env:
+        task.env = dict(env)
+    task.services = [Service(name="web", port_label="http",
+                             tags=["frontend", "v1"])]
+    return j
+
+
+def test_services_follow_task_lifecycle(tmp_path):
+    srv = Server(num_workers=2)
+    srv.start()
+    client = Client(srv, data_dir=str(tmp_path))
+    try:
+        client.start()
+        job = http_job()
+        srv.register_job(job)
+        assert wait_until(lambda: srv.store.services_by_name(
+            "default", "web"), timeout=25), "service never registered"
+        regs = srv.store.services_by_name("default", "web")
+        assert len(regs) == 1
+        reg = regs[0]
+        assert reg.job_id == job.id and reg.task == "web"
+        assert sorted(reg.tags) == ["frontend", "v1"]
+        names = srv.store.service_names()
+        assert names == [{"ServiceName": "web",
+                          "Tags": ["frontend", "v1"]}]
+
+        # stopping the job deregisters
+        srv.deregister_job("default", job.id)
+        assert wait_until(lambda: not srv.store.services_by_name(
+            "default", "web"), timeout=20), "service never deregistered"
+    finally:
+        client.shutdown(halt_tasks=True)
+        srv.stop()
+
+
+def test_secret_store_crud_and_http():
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv)
+    http.start()
+    try:
+        srv.upsert_secret("default", "db/creds",
+                          {"user": "app", "pass": "hunter2"})
+        assert srv.store.secret_by_path("default", "db/creds") == {
+            "user": "app", "pass": "hunter2"}
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                http.address + path, method=method,
+                data=json.dumps(body).encode() if body else None,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        call("PUT", "/v1/secret/api/key", {"data": {"token": "t0k"}})
+        assert call("GET", "/v1/secrets") == ["api/key", "db/creds"]
+        assert call("GET", "/v1/secret/api/key")["data"] == {
+            "token": "t0k"}
+        call("DELETE", "/v1/secret/api/key")
+        assert call("GET", "/v1/secrets") == ["db/creds"]
+    finally:
+        http.stop()
+        srv.stop()
+
+
+def test_task_env_resolves_secret_references(tmp_path):
+    srv = Server(num_workers=2)
+    srv.start()
+    srv.upsert_secret("default", "db/creds", {"pass": "hunter2"})
+    client = Client(srv, data_dir=str(tmp_path))
+    try:
+        client.start()
+        out_file = str(tmp_path / "envdump")
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", f"env > {out_file}; sleep 30"]}
+        task.resources.networks = []
+        task.env = {"DB_PASS": "${secret.db/creds.pass}",
+                    "PLAIN": "asis"}
+        srv.register_job(j)
+        assert wait_until(lambda: __import__("os").path.exists(out_file),
+                          timeout=25)
+        env = dict(line.split("=", 1)
+                   for line in open(out_file).read().splitlines()
+                   if "=" in line)
+        assert env["DB_PASS"] == "hunter2"
+        assert env["PLAIN"] == "asis"
+    finally:
+        client.shutdown(halt_tasks=True)
+        srv.stop()
+
+
+def test_unresolvable_secret_fails_task(tmp_path):
+    srv = Server(num_workers=2)
+    srv.start()
+    client = Client(srv, data_dir=str(tmp_path))
+    try:
+        client.start()
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 5"]}
+        task.resources.networks = []
+        task.env = {"X": "${secret.missing/path.key}"}
+        srv.register_job(j)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_FAILED
+            for a in srv.store.allocs_by_job("default", j.id)),
+            timeout=25), "task with missing secret must fail"
+    finally:
+        client.shutdown(halt_tasks=True)
+        srv.stop()
