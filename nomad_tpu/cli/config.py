@@ -1,0 +1,97 @@
+"""Agent configuration files.
+
+Reference: command/agent/config.go + config_parse.go — HCL/JSON agent
+config files merged with CLI flags (flags win). The subset here covers
+the stanzas the dev agent honors: top-level knobs, `server`, `client`,
+`acl`, and `ports`.
+
+    bind_addr = "0.0.0.0"
+    data_dir  = "/var/lib/nomad-tpu"
+    ports { http = 4646 }
+    server {
+      enabled          = true
+      num_schedulers   = 2
+    }
+    client {
+      enabled    = true
+      datacenter = "dc1"
+      meta { rack = "r1" }
+    }
+    acl { enabled = true }
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..jobspec.hcl import parse_hcl
+
+
+@dataclass
+class AgentConfig:
+    bind_addr: str = "127.0.0.1"
+    data_dir: str = "/tmp/nomad-tpu-dev"
+    http_port: int = 4646
+    server_enabled: bool = True
+    num_schedulers: int = 2
+    client_enabled: bool = True
+    datacenter: str = "dc1"
+    meta: Dict[str, str] = field(default_factory=dict)
+    acl_enabled: bool = False
+
+
+class AgentConfigError(ValueError):
+    pass
+
+
+def parse_agent_config(text: str, path: str = "<config>") -> AgentConfig:
+    """HCL or JSON by content (config_parse.go sniffs the same way).
+    Both formats lower to one nested dict before the merge, so every
+    knob exists in exactly one place."""
+    try:
+        stripped = text.lstrip()
+        if stripped.startswith("{"):
+            d = json.loads(text)
+        else:
+            d = _hcl_to_dict(parse_hcl(text))
+    except (ValueError, KeyError) as e:
+        raise AgentConfigError(f"{path}: {e}") from e
+    return _from_dict(d)
+
+
+def _hcl_to_dict(body) -> dict:
+    """Lower a parsed HCL Body (attrs + one level of named blocks, with
+    the client.meta sub-block folded in) to the JSON config shape."""
+    d = dict(body.attrs)
+    for name in ("ports", "server", "client", "acl"):
+        for _labels, blk in body.blocks_named(name):
+            sub = d.setdefault(name, {})
+            sub.update(blk.attrs)
+            for _ml, meta in blk.blocks_named("meta"):
+                sub.setdefault("meta", {}).update(meta.attrs)
+    return d
+
+
+def _from_dict(d: dict) -> AgentConfig:
+    cfg = AgentConfig()
+    cfg.bind_addr = d.get("bind_addr", cfg.bind_addr)
+    cfg.data_dir = d.get("data_dir", cfg.data_dir)
+    cfg.http_port = int((d.get("ports") or {}).get("http",
+                                                   cfg.http_port))
+    srv = d.get("server") or {}
+    cfg.server_enabled = bool(srv.get("enabled", cfg.server_enabled))
+    cfg.num_schedulers = int(srv.get("num_schedulers",
+                                     cfg.num_schedulers))
+    cl = d.get("client") or {}
+    cfg.client_enabled = bool(cl.get("enabled", cfg.client_enabled))
+    cfg.datacenter = cl.get("datacenter", cfg.datacenter)
+    cfg.meta.update({k: str(v) for k, v in (cl.get("meta") or {}).items()})
+    cfg.acl_enabled = bool((d.get("acl") or {}).get("enabled",
+                                                    cfg.acl_enabled))
+    return cfg
+
+
+def load_agent_config(path: str) -> AgentConfig:
+    with open(path, encoding="utf-8") as f:
+        return parse_agent_config(f.read(), path)

@@ -40,18 +40,40 @@ def cmd_agent(args) -> int:
     from ..api.http_server import HTTPAgentServer
     from ..client.agent import Client
     from ..server.server import Server
+    from .config import AgentConfig, load_agent_config
 
     if not args.dev:
         print("only -dev mode is supported", file=sys.stderr)
         return 1
-    server = Server(num_workers=args.workers)
+    # config file first, explicit CLI flags override
+    # (command/agent/config.go merge order)
+    try:
+        cfg = (load_agent_config(args.config) if args.config
+               else AgentConfig())
+    except (OSError, ValueError) as e:
+        print(f"error loading config: {e}", file=sys.stderr)
+        return 1
+    if not cfg.server_enabled:
+        print("server.enabled = false is not supported by the dev "
+              "agent (it always embeds a server)", file=sys.stderr)
+        return 1
+    bind = args.bind if args.bind is not None else cfg.bind_addr
+    port = args.port if args.port is not None else cfg.http_port
+    data_dir = (args.data_dir if args.data_dir is not None
+                else cfg.data_dir)
+    workers = (args.workers if args.workers is not None
+               else cfg.num_schedulers)
+    acl_enabled = args.acl_enabled or cfg.acl_enabled
+    server = Server(num_workers=workers)
     server.start()
     client = None
-    if not args.server_only:
-        client = Client(server, data_dir=args.data_dir)
+    if not args.server_only and cfg.client_enabled:
+        client = Client(server, data_dir=data_dir,
+                        datacenter=cfg.datacenter,
+                        meta=cfg.meta or None)
         client.start()
-    http = HTTPAgentServer(server, client, host=args.bind, port=args.port,
-                           acl_enabled=args.acl_enabled)
+    http = HTTPAgentServer(server, client, host=bind, port=port,
+                           acl_enabled=acl_enabled)
     http.start()
     print(f"==> nomad-tpu agent started (dev mode)")
     print(f"    HTTP: {http.address}")
@@ -315,11 +337,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     ag = sub.add_parser("agent", help="run an agent")
     ag.add_argument("-dev", action="store_true")
-    ag.add_argument("-bind", default="127.0.0.1")
-    ag.add_argument("-port", type=int, default=4646)
-    ag.add_argument("-data-dir", dest="data_dir",
-                    default="/tmp/nomad-tpu-dev")
-    ag.add_argument("-workers", type=int, default=2)
+    ag.add_argument("-config", default=None,
+                    help="agent config file (HCL or JSON)")
+    ag.add_argument("-bind", default=None)
+    ag.add_argument("-port", type=int, default=None)
+    ag.add_argument("-data-dir", dest="data_dir", default=None)
+    ag.add_argument("-workers", type=int, default=None)
     ag.add_argument("-server-only", dest="server_only",
                     action="store_true")
     ag.add_argument("-acl-enabled", dest="acl_enabled",

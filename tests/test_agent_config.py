@@ -1,0 +1,47 @@
+"""Agent config files (reference: command/agent/config.go HCL/JSON
+parse + flag merge)."""
+from nomad_tpu.cli.config import AgentConfig, parse_agent_config
+
+HCL = '''
+bind_addr = "0.0.0.0"
+data_dir  = "/var/lib/nt"
+ports { http = 5646 }
+server {
+  enabled        = true
+  num_schedulers = 4
+}
+client {
+  enabled    = true
+  datacenter = "us-west"
+  meta { rack = "r9" }
+}
+acl { enabled = true }
+'''
+
+
+def test_hcl_agent_config():
+    cfg = parse_agent_config(HCL)
+    assert cfg.bind_addr == "0.0.0.0"
+    assert cfg.data_dir == "/var/lib/nt"
+    assert cfg.http_port == 5646
+    assert cfg.num_schedulers == 4
+    assert cfg.datacenter == "us-west"
+    assert cfg.meta == {"rack": "r9"}
+    assert cfg.acl_enabled
+
+
+def test_json_agent_config():
+    cfg = parse_agent_config(
+        '{"bind_addr": "10.0.0.1", "ports": {"http": 7000},'
+        ' "client": {"datacenter": "eu", "meta": {"zone": "a"}},'
+        ' "acl": {"enabled": true}}')
+    assert cfg.bind_addr == "10.0.0.1"
+    assert cfg.http_port == 7000
+    assert cfg.datacenter == "eu"
+    assert cfg.meta == {"zone": "a"}
+    assert cfg.acl_enabled
+
+
+def test_defaults():
+    cfg = parse_agent_config("# empty\n")
+    assert cfg == AgentConfig()
