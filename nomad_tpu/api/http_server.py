@@ -77,6 +77,18 @@ class HTTPAgentServer:
                 pass
 
             def _handle(self, method: str):
+                if method == "GET" and (self.path == "/ui"
+                                        or self.path.startswith("/ui/")
+                                        or self.path == "/"):
+                    from .ui import UI_HTML
+                    data = UI_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 try:
                     token = self.headers.get("X-Nomad-Token", "")
                     code, body, index = outer.dispatch(
@@ -186,6 +198,19 @@ class HTTPAgentServer:
             return
         write = (method in ("POST", "PUT", "DELETE")
                  and path != "/v1/search")
+        if path.startswith("/v1/client/fs/logs/"):
+            # task logs often carry secrets: require read-logs in the
+            # ALLOC's namespace (resolved server-side, not caller-said)
+            alloc_prefix = path.rsplit("/", 1)[-1]
+            target_ns = ns
+            for al in self.server.store.allocs():
+                if al.id.startswith(alloc_prefix):
+                    target_ns = al.namespace
+                    break
+            if not a.allow_namespace_op(target_ns,
+                                        aclmod.CAP_READ_LOGS):
+                raise HTTPError(403, "missing capability read-logs")
+            return
         if path.startswith("/v1/secret"):
             # secrets are write-class EVEN TO READ: a read-only job
             # token must not exfiltrate raw secret values
@@ -561,6 +586,57 @@ class HTTPAgentServer:
                      "truncations": truncations}, \
             self.server.store.latest_index()
 
+    def client_logs(self, q, body, alloc_id):
+        """Task log contents from the local agent (reference:
+        client/fs_endpoint.go logs; plain read of the alloc dir's
+        rotated log files, ?task= and ?type=stdout|stderr, tail via
+        ?offset/?limit or ?tail_lines)."""
+        if self.client is None:
+            raise HTTPError(400, "no client agent on this node")
+        runner = self.client.get_alloc_runner(alloc_id)
+        if runner is None:
+            # allow prefix match like the other id endpoints
+            matches = [r for aid, r in
+                       list(self.client.runners.items())
+                       if aid.startswith(alloc_id)]
+            if len(matches) != 1:
+                raise HTTPError(404, f"alloc {alloc_id} not on node")
+            runner = matches[0]
+        names = [t.name for t in
+                 (runner.alloc.job.lookup_task_group(
+                     runner.alloc.task_group).tasks
+                  if runner.alloc.job else [])]
+        task = q.get("task")
+        if not task:
+            if len(names) != 1:
+                raise HTTPError(400, "specify ?task= (multiple tasks)")
+            task = names[0]
+        elif task not in names:
+            # also forecloses path traversal through the task name
+            raise HTTPError(404, f"unknown task {task!r}")
+        kind = q.get("type", "stdout")
+        if kind not in ("stdout", "stderr"):
+            raise HTTPError(400, "type must be stdout|stderr")
+        path = (runner.alloc_dir.stdout_path(task) if kind == "stdout"
+                else runner.alloc_dir.stderr_path(task))
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            data = b""
+        tail = q.get("tail_lines")
+        if tail:
+            try:
+                n = int(tail)
+                if n <= 0:
+                    raise ValueError
+            except ValueError:
+                raise HTTPError(400, "tail_lines must be a positive int")
+            data = b"\n".join(data.splitlines()[-n:])
+        text = data.decode("utf-8", errors="replace")
+        return 200, {"task": task, "type": kind, "data": text,
+                     "size": len(data)}, None
+
     def services_list(self, q, body):
         ns = q.get("namespace", "default")
         index = self._block(q, "services")
@@ -762,6 +838,7 @@ def _build_routes(s: HTTPAgentServer):
                                   "PUT": s.acl_token_upsert}),
         (R(r"^/v1/acl/token/([^/]+)$"), {"GET": s.acl_token_get,
                                          "DELETE": s.acl_token_delete}),
+        (R(r"^/v1/client/fs/logs/([^/]+)$"), {"GET": s.client_logs}),
         (R(r"^/v1/services$"), {"GET": s.services_list}),
         (R(r"^/v1/service/([^/]+)$"), {"GET": s.service_get}),
         (R(r"^/v1/secrets$"), {"GET": s.secrets_list}),

@@ -1,0 +1,104 @@
+"""Minimal web UI.
+
+Reference: ui/ — a full Ember app consuming /v1/* with live updates.
+This build ships a deliberately small single-page dashboard (no build
+step, no dependencies) served at /ui: jobs with summary counts, nodes,
+deployments and the service catalog, auto-refreshing against the same
+/v1 endpoints the CLI and SDK use.
+"""
+
+UI_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  body { font: 13px/1.5 system-ui, sans-serif; margin: 0; color: #222; }
+  header { background: #1f2d3d; color: #fff; padding: 10px 20px; }
+  header h1 { font-size: 16px; margin: 0; display: inline-block; }
+  header span { opacity: .7; margin-left: 12px; font-size: 12px; }
+  main { padding: 16px 20px; max-width: 1100px; }
+  h2 { font-size: 14px; border-bottom: 1px solid #ddd;
+       padding-bottom: 4px; margin: 22px 0 8px; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 4px 10px 4px 0;
+           border-bottom: 1px solid #f0f0f0; font-size: 12.5px; }
+  th { color: #888; font-weight: 600; }
+  .ok { color: #1a7f37; } .bad { color: #c62828; }
+  .dim { color: #999; }
+  code { background: #f5f5f5; padding: 1px 4px; border-radius: 3px; }
+</style>
+</head>
+<body>
+<header><h1>nomad-tpu</h1><span id="stamp"></span></header>
+<main>
+  <h2>Jobs</h2><table id="jobs"></table>
+  <h2>Deployments</h2><table id="deps"></table>
+  <h2>Nodes</h2><table id="nodes"></table>
+  <h2>Services</h2><table id="services"></table>
+</main>
+<script>
+async function j(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path + ": " + r.status);
+  return r.json();
+}
+function esc(v) {
+  return String(v ?? "").replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;"}[c]));
+}
+function row(cells, header) {
+  const tag = header ? "th" : "td";
+  return "<tr>" + cells.map(c => `<${tag}>${c}</${tag}>`).join("") +
+         "</tr>";
+}
+function setTable(id, header, rows) {
+  document.getElementById(id).innerHTML =
+    row(header, true) +
+    (rows.length ? rows.map(r => row(r)).join("")
+                 : row(["<span class=dim>none</span>"]));
+}
+function statusCell(s, goodSet) {
+  const cls = goodSet.includes(s) ? "ok" : "bad";
+  return `<span class="${cls}">${esc(s)}</span>`;
+}
+async function refresh() {
+  try {
+    const [jobs, nodes, deps, services] = await Promise.all([
+      j("/v1/jobs"), j("/v1/nodes"), j("/v1/deployments"),
+      j("/v1/services")]);
+    setTable("jobs", ["ID", "Type", "Priority", "Status", "Summary"],
+      jobs.map(x => [
+        `<code>${esc(x.id)}</code>`, esc(x.type), esc(x.priority),
+        statusCell(x.status, ["running"]),
+        esc(x.summary || "")]));
+    setTable("nodes", ["ID", "Name", "DC", "Class", "Eligibility",
+                       "Status"],
+      nodes.map(n => [
+        `<code>${esc(n.id.slice(0, 8))}</code>`, esc(n.name),
+        esc(n.datacenter),
+        n.node_class ? esc(n.node_class) : "<span class=dim>-</span>",
+        esc(n.scheduling_eligibility),
+        statusCell(n.status, ["ready"])]));
+    setTable("deps", ["ID", "Job", "Status", "Description"],
+      deps.map(d => [
+        `<code>${esc(d.id.slice(0, 8))}</code>`, esc(d.job_id),
+        statusCell(d.status, ["successful", "running"]),
+        esc(d.status_description || "")]));
+    setTable("services", ["Service", "Tags"],
+      services.map(s => [
+        `<code>${esc(s.ServiceName)}</code>`,
+        esc((s.Tags || []).join(", "))]));
+    document.getElementById("stamp").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("stamp").textContent = "error: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -267,6 +267,20 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    api = _client(args)
+    params = {"type": "stderr" if args.stderr else "stdout"}
+    if args.task:
+        params["task"] = args.task
+    if args.tail:
+        params["tail_lines"] = str(args.tail)
+    out = api.get(f"/v1/client/fs/logs/{args.alloc_id}", **params)
+    sys.stdout.write(out["data"])
+    if out["data"] and not out["data"].endswith("\n"):
+        sys.stdout.write("\n")
+    return 0
+
+
 def cmd_alloc_stop(args) -> int:
     api = _client(args)
     resp = api.allocations.stop(args.alloc_id)
@@ -402,6 +416,12 @@ def build_parser() -> argparse.ArgumentParser:
     ast = alloc.add_parser("stop")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_stop)
+    al = alloc.add_parser("logs")
+    al.add_argument("alloc_id")
+    al.add_argument("-task", default=None)
+    al.add_argument("-stderr", action="store_true")
+    al.add_argument("-tail", type=int, default=None)
+    al.set_defaults(fn=cmd_alloc_logs)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="eval_cmd", required=True)

@@ -136,6 +136,83 @@ class TaskRunner:
         self._persist()
         self.on_state_change(self)
 
+    # ------------------------------------------------------ service checks
+    def _start_checks(self) -> None:
+        """Run each service's checks on their intervals (reference: the
+        consul agent runs registered checks; here the client runs them
+        natively and the results ride task-state sync into the service
+        catalog). Threads exit with the task; started at most once per
+        runner (restarts reuse the running loops)."""
+        with self._lock:
+            if getattr(self, "_checks_started", False):
+                return
+            self._checks_started = True
+        for svc in self.task.services:
+            for check in svc.checks:
+                t = threading.Thread(
+                    target=self._check_loop, args=(svc, check),
+                    daemon=True,
+                    name=f"check-{self.task_id}-{check.name}")
+                t.start()
+
+    def _check_loop(self, svc, check) -> None:
+        key = f"{svc.name}/{check.name or check.type}"
+        # a check-level port_label overrides the service's (reference:
+        # the check stanza's own port wins)
+        port = self._service_port(check.port_label or svc.port_label)
+        while not self._kill.is_set():
+            ok = self._run_check(check, port)
+            changed = False
+            with self._lock:
+                if self.state.checks.get(key) != ok:
+                    self.state.checks[key] = ok
+                    changed = True
+            if changed:
+                self._persist()
+                self.on_state_change(self)
+            if self._kill.wait(max(check.interval_s, 0.1)):
+                return
+
+    def _service_port(self, label: str):
+        tr = self.alloc.allocated_resources.tasks.get(self.task.name)
+        if tr is None or not label:
+            return None
+        for net in tr.networks:
+            for p in (list(net.reserved_ports)
+                      + list(net.dynamic_ports)):
+                if p.label == label:
+                    return p.value
+        return None
+
+    def _run_check(self, check, port) -> bool:
+        import socket as _socket
+        import subprocess as _subprocess
+        try:
+            if check.type == "tcp":
+                if port is None:
+                    return False
+                with _socket.create_connection(
+                        ("127.0.0.1", port),
+                        timeout=max(check.timeout_s, 0.1)):
+                    return True
+            if check.type == "http":
+                if port is None:
+                    return False
+                import urllib.request
+                url = f"http://127.0.0.1:{port}{check.path or '/'}"
+                with urllib.request.urlopen(
+                        url, timeout=max(check.timeout_s, 0.1)) as r:
+                    return 200 <= r.status < 400
+            if check.type == "script":
+                out = _subprocess.run(
+                    [check.command] + list(check.args),
+                    capture_output=True,
+                    timeout=max(check.timeout_s, 0.1))
+                return out.returncode == 0
+        except Exception:               # noqa: BLE001
+            return False
+        return False                    # unknown check type: fail safe
+
     def _persist(self) -> None:
         if self.state_db is not None:
             with self._lock:
@@ -170,7 +247,10 @@ class TaskRunner:
         while not self._kill.is_set():
             if self._restored and self.handle is not None:
                 # re-attached to a live task: skip straight to wait
+                # (checks must resume too — health would otherwise
+                # freeze at the last persisted value)
                 self._restored = False
+                self._start_checks()
             else:
                 self._restored = False
                 try:
@@ -303,6 +383,7 @@ class TaskRunner:
         self._persist()
         self._emit(EVENT_STARTED)
         self._set_state(TASK_STATE_RUNNING)
+        self._start_checks()
 
     def _wait_driver(self) -> Optional[ExitResult]:
         while not self._kill.is_set():

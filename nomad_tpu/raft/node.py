@@ -98,6 +98,7 @@ class RaftNode:
         self._next: Dict[str, int] = {}
         self._match: Dict[str, int] = {}
         self.running = False
+        self._closed = False
         self._threads: List[threading.Thread] = []
         self._deadline = 0.0
         self._meta_saved_commit = 0
@@ -167,6 +168,7 @@ class RaftNode:
     def stop(self) -> None:
         with self._lock:
             self.running = False
+            self._closed = True
             self._save_meta()
             self._cv.notify_all()
         for t in self._threads:
@@ -310,6 +312,8 @@ class RaftNode:
         """Append + replicate + wait for local apply. Raises
         NotLeaderError from followers (callers forward to the leader)."""
         with self._lock:
+            if self._closed:
+                raise NotLeaderError(None)
             if self.role != ROLE_LEADER:
                 raise NotLeaderError(self.leader_id)
             index = self._append_locked(etype, payload)

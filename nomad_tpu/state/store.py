@@ -669,17 +669,23 @@ class StateStore(StateSnapshot):
                                 if p.label == svc.port_label:
                                     port = p.value
                     rid = f"{alloc.id}-{task.name}-{svc.name}"
+                    healthy = all(
+                        st.checks.get(
+                            f"{svc.name}/{c.name or c.type}", False)
+                        for c in svc.checks) if svc.checks else True
                     desired[rid] = ServiceRegistration(
                         id=rid, service_name=svc.name,
                         namespace=alloc.namespace,
                         job_id=alloc.job_id, alloc_id=alloc.id,
                         node_id=alloc.node_id, task=task.name,
                         address=address, port=port,
-                        tags=list(svc.tags),
+                        tags=list(svc.tags), healthy=healthy,
                         create_index=index, modify_index=index)
         same = (current.keys() == desired.keys() and all(
-            (current[k].address, current[k].port, current[k].tags)
-            == (desired[k].address, desired[k].port, desired[k].tags)
+            (current[k].address, current[k].port, current[k].tags,
+             current[k].healthy)
+            == (desired[k].address, desired[k].port, desired[k].tags,
+                desired[k].healthy)
             for k in desired))
         if same:
             return

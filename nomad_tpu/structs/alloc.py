@@ -39,6 +39,9 @@ class TaskState:
     started_at: float = 0.0
     finished_at: float = 0.0
     events: List[TaskEvent] = field(default_factory=list)
+    # "<service>/<check>" -> passing (client-side check runner results;
+    # reference: consul check status consumed by the service catalog)
+    checks: Dict[str, bool] = field(default_factory=dict)
 
     def successful(self) -> bool:
         return self.state == TASK_STATE_DEAD and not self.failed

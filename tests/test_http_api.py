@@ -208,3 +208,76 @@ def test_cli_drain_via_http(agent):
     rc, out = _run_cli(api, "node", "drain", node_id, "-disable")
     assert rc == 0
     assert server.store.node_by_id(node_id).drain_strategy is None
+
+
+def test_client_logs_endpoint(tmp_path):
+    """Alloc log retrieval from the local agent (reference:
+    client/fs_endpoint.go logs)."""
+    import json
+    import urllib.request
+    from nomad_tpu.client.agent import Client
+    from nomad_tpu.client.sim import wait_until
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    from nomad_tpu.server.server import Server
+    from nomad_tpu import mock, structs
+
+    srv = Server(num_workers=2)
+    srv.start()
+    client = Client(srv, data_dir=str(tmp_path))
+    http = HTTPAgentServer(srv, client)
+    http.start()
+    try:
+        client.start()
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh",
+                       "args": ["-c", "echo hello-logs; sleep 30"]}
+        task.resources.networks = []
+        srv.register_job(j)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_RUNNING
+            for a in srv.store.allocs_by_job("default", j.id)),
+            timeout=25)
+        alloc = srv.store.allocs_by_job("default", j.id)[0]
+
+        def logs(**params):
+            from urllib.parse import urlencode
+            url = (f"{http.address}/v1/client/fs/logs/{alloc.id}"
+                   + ("?" + urlencode(params) if params else ""))
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+
+        assert wait_until(
+            lambda: "hello-logs" in logs()["data"], timeout=10)
+        out = logs(type="stderr")
+        assert out["type"] == "stderr"
+        out = logs(tail_lines=1)
+        assert out["data"].strip() == "hello-logs"
+    finally:
+        client.shutdown(halt_tasks=True)
+        http.stop()
+        srv.stop()
+
+
+def test_ui_served():
+    import urllib.request
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    from nomad_tpu.server.server import Server
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv)
+    http.start()
+    try:
+        for path in ("/ui", "/"):
+            with urllib.request.urlopen(http.address + path,
+                                        timeout=5) as r:
+                assert r.status == 200
+                assert "text/html" in r.headers["Content-Type"]
+                page = r.read().decode()
+            assert "nomad-tpu" in page and "/v1/jobs" in page
+    finally:
+        http.stop()
+        srv.stop()

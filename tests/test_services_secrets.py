@@ -138,3 +138,37 @@ def test_unresolvable_secret_fails_task(tmp_path):
     finally:
         client.shutdown(halt_tasks=True)
         srv.stop()
+
+
+def test_service_checks_drive_registration_health(tmp_path):
+    from nomad_tpu.structs.job import ServiceCheck
+    srv = Server(num_workers=2)
+    srv.start()
+    client = Client(srv, data_dir=str(tmp_path))
+    flag = str(tmp_path / "healthy-flag")
+    try:
+        client.start()
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+        task.resources.networks = []
+        task.services = [Service(name="api", checks=[ServiceCheck(
+            name="flag", type="script", command="/bin/sh",
+            args=["-c", f"test -f {flag}"], interval_s=0.3,
+            timeout_s=2.0)])]
+        srv.register_job(j)
+        # registered but UNHEALTHY while the check fails
+        assert wait_until(lambda: srv.store.services_by_name(
+            "default", "api"), timeout=25)
+        assert wait_until(lambda: srv.store.services_by_name(
+            "default", "api")[0].healthy is False, timeout=10)
+        # flip the check -> healthy propagates through task-state sync
+        open(flag, "w").write("ok")
+        assert wait_until(lambda: srv.store.services_by_name(
+            "default", "api")[0].healthy, timeout=15)
+    finally:
+        client.shutdown(halt_tasks=True)
+        srv.stop()
