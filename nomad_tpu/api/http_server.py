@@ -164,6 +164,17 @@ class HTTPAgentServer:
             return fn(q, body, *m.groups())
         raise HTTPError(404, f"no handler for {url.path}")
 
+    def _alloc_namespace(self, prefix: str) -> str:
+        """Namespace of the alloc a client endpoint will act on; an
+        AMBIGUOUS prefix is rejected here so the capability check can
+        never authorize against a different alloc than the handler
+        resolves (both layers demand uniqueness)."""
+        matches = {al.namespace for al in self.server.store.allocs()
+                   if al.id.startswith(prefix)}
+        if len(matches) > 1:
+            raise HTTPError(400, f"ambiguous alloc prefix {prefix!r}")
+        return next(iter(matches), "default")
+
     def _enforce_acl(self, method: str, path: str, q, body,
                      token: str) -> None:
         """Route-class capability checks (reference: each agent endpoint
@@ -198,15 +209,16 @@ class HTTPAgentServer:
             return
         write = (method in ("POST", "PUT", "DELETE")
                  and path != "/v1/search")
+        if "/exec" in path and path.startswith("/v1/client/allocation/"):
+            target_ns = self._alloc_namespace(path.split("/")[4])
+            if not a.allow_namespace_op(target_ns,
+                                        aclmod.CAP_ALLOC_EXEC):
+                raise HTTPError(403, "missing capability alloc-exec")
+            return
         if path.startswith("/v1/client/fs/logs/"):
             # task logs often carry secrets: require read-logs in the
             # ALLOC's namespace (resolved server-side, not caller-said)
-            alloc_prefix = path.rsplit("/", 1)[-1]
-            target_ns = ns
-            for al in self.server.store.allocs():
-                if al.id.startswith(alloc_prefix):
-                    target_ns = al.namespace
-                    break
+            target_ns = self._alloc_namespace(path.rsplit("/", 1)[-1])
             if not a.allow_namespace_op(target_ns,
                                         aclmod.CAP_READ_LOGS):
                 raise HTTPError(403, "missing capability read-logs")
@@ -637,6 +649,66 @@ class HTTPAgentServer:
         return 200, {"task": task, "type": kind, "data": text,
                      "size": len(data)}, None
 
+    def client_exec(self, q, body, alloc_id):
+        """One-shot command execution inside a task's context
+        (reference: alloc exec, plugins/drivers ExecTask — the one-shot
+        form; interactive pty streaming is not implemented)."""
+        if self.client is None:
+            raise HTTPError(400, "no client agent on this node")
+        if not body or not body.get("cmd"):
+            raise HTTPError(400, "body must carry 'cmd' (list)")
+        runner = self.client.get_alloc_runner(alloc_id)
+        if runner is None:
+            matches = [r for aid, r in list(self.client.runners.items())
+                       if aid.startswith(alloc_id)]
+            if len(matches) != 1:
+                raise HTTPError(404, f"alloc {alloc_id} not on node")
+            runner = matches[0]
+        task = body.get("task")
+        trs = runner.task_runners
+        if task:
+            trs = [tr for tr in trs if tr.task.name == task]
+        if len(trs) != 1:
+            raise HTTPError(400, "specify 'task' (multiple tasks)"
+                            if not task else f"unknown task {task!r}")
+        tr = trs[0]
+        if tr.handle is None:
+            raise HTTPError(409, "task is not running")
+        try:
+            timeout_s = float(body.get("timeout_s", 30.0))
+        except (TypeError, ValueError):
+            raise HTTPError(400, "timeout_s must be a number")
+        out, code = tr.driver.exec_task(
+            tr.task_id, list(body["cmd"]), timeout_s=timeout_s)
+        return 200, {"output": out.decode("utf-8", errors="replace"),
+                     "exit_code": code}, None
+
+    def job_scale(self, q, body, job_id):
+        """Adjust a task group's count (reference: Job.Scale,
+        nomad/job_endpoint.go ScaleStatus/Scale — registers the updated
+        job and evaluates it with the scaling trigger)."""
+        if not body or "group" not in body or "count" not in body:
+            raise HTTPError(400, "body must carry 'group' and 'count'")
+        ns = q.get("namespace", "default")
+        job = self.server.store.job_by_id(ns, job_id)
+        if job is None:
+            raise HTTPError(404, f"job {job_id} not found")
+        try:
+            count = int(body["count"])
+        except (TypeError, ValueError):
+            raise HTTPError(400, "count must be an integer")
+        if count < 0:
+            raise HTTPError(400, "count must be >= 0")
+        import copy as _copy
+        j2 = _copy.deepcopy(job)
+        tg = j2.lookup_task_group(body["group"])
+        if tg is None:
+            raise HTTPError(400, f"unknown group {body['group']!r}")
+        tg.count = count
+        ev = self.server.register_job(j2)
+        return 200, {"eval_id": ev.id if ev else "",
+                     "index": self.server.store.latest_index()}, None
+
     def services_list(self, q, body):
         ns = q.get("namespace", "default")
         index = self._block(q, "services")
@@ -839,6 +911,10 @@ def _build_routes(s: HTTPAgentServer):
         (R(r"^/v1/acl/token/([^/]+)$"), {"GET": s.acl_token_get,
                                          "DELETE": s.acl_token_delete}),
         (R(r"^/v1/client/fs/logs/([^/]+)$"), {"GET": s.client_logs}),
+        (R(r"^/v1/client/allocation/([^/]+)/exec$"),
+         {"POST": s.client_exec, "PUT": s.client_exec}),
+        (R(r"^/v1/job/([^/]+)/scale$"), {"POST": s.job_scale,
+                                         "PUT": s.job_scale}),
         (R(r"^/v1/services$"), {"GET": s.services_list}),
         (R(r"^/v1/service/([^/]+)$"), {"GET": s.service_get}),
         (R(r"^/v1/secrets$"), {"GET": s.secrets_list}),

@@ -274,10 +274,30 @@ def cmd_alloc_logs(args) -> int:
         params["task"] = args.task
     if args.tail:
         params["tail_lines"] = str(args.tail)
-    out = api.get(f"/v1/client/fs/logs/{args.alloc_id}", **params)
+    out, _ix = api.get(f"/v1/client/fs/logs/{args.alloc_id}", **params)
     sys.stdout.write(out["data"])
     if out["data"] and not out["data"].endswith("\n"):
         sys.stdout.write("\n")
+    return 0
+
+
+def cmd_alloc_exec(args) -> int:
+    api = _client(args)
+    body = {"cmd": args.cmd}
+    if args.task:
+        body["task"] = args.task
+    out, _ix = api.post(
+        f"/v1/client/allocation/{args.alloc_id}/exec", body)
+    sys.stdout.write(out["output"])
+    return out["exit_code"]
+
+
+def cmd_job_scale(args) -> int:
+    api = _client(args)
+    out, _ix = api.post(f"/v1/job/{args.job_id}/scale",
+                        {"group": args.group, "count": args.count})
+    print(f"==> Scaled {args.job_id}/{args.group} to {args.count} "
+          f"(eval {_short(out['eval_id'])})")
     return 0
 
 
@@ -408,6 +428,12 @@ def build_parser() -> argparse.ArgumentParser:
     grp.add_argument("-disable", dest="enable", action="store_false")
     ne.set_defaults(fn=cmd_node_eligibility)
 
+    jsc = job.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.set_defaults(fn=cmd_job_scale)
+
     alloc = sub.add_parser("alloc", help="alloc commands").add_subparsers(
         dest="alloc_cmd", required=True)
     as_ = alloc.add_parser("status")
@@ -416,6 +442,13 @@ def build_parser() -> argparse.ArgumentParser:
     ast = alloc.add_parser("stop")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_stop)
+    ax = alloc.add_parser("exec")
+    ax.add_argument("alloc_id")
+    ax.add_argument("-task", default=None)
+    # REMAINDER: everything after the alloc id (incl. dash flags like
+    # `/bin/sh -c ...`) belongs to the command
+    ax.add_argument("cmd", nargs=argparse.REMAINDER)
+    ax.set_defaults(fn=cmd_alloc_exec)
     al = alloc.add_parser("logs")
     al.add_argument("alloc_id")
     al.add_argument("-task", default=None)

@@ -281,3 +281,61 @@ def test_ui_served():
     finally:
         http.stop()
         srv.stop()
+
+
+def test_client_exec_and_job_scale(tmp_path):
+    import json
+    import urllib.request
+    from nomad_tpu.client.agent import Client
+    from nomad_tpu.client.sim import wait_until
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    from nomad_tpu.server.server import Server
+    from nomad_tpu import mock, structs
+
+    srv = Server(num_workers=2)
+    srv.start()
+    client = Client(srv, data_dir=str(tmp_path))
+    http = HTTPAgentServer(srv, client)
+    http.start()
+    try:
+        client.start()
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+        task.resources.networks = []
+        srv.register_job(j)
+        assert wait_until(lambda: any(
+            a.client_status == structs.ALLOC_CLIENT_RUNNING
+            for a in srv.store.allocs_by_job("default", j.id)),
+            timeout=25)
+        alloc = srv.store.allocs_by_job("default", j.id)[0]
+
+        def post(path, body):
+            req = urllib.request.Request(
+                http.address + path, method="POST",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        # one-shot exec inside the task context
+        out = post(f"/v1/client/allocation/{alloc.id}/exec",
+                   {"cmd": ["/bin/sh", "-c", "echo from-exec; exit 3"]})
+        assert out["output"].strip() == "from-exec"
+        assert out["exit_code"] == 3
+
+        # scale the group up; a new alloc appears
+        out = post(f"/v1/job/{j.id}/scale",
+                   {"group": tg.name, "count": 2})
+        assert out["eval_id"]
+        assert wait_until(lambda: len(
+            [a for a in srv.store.allocs_by_job("default", j.id)
+             if a.client_status == structs.ALLOC_CLIENT_RUNNING]) == 2,
+            timeout=25)
+    finally:
+        client.shutdown(halt_tasks=True)
+        http.stop()
+        srv.stop()
