@@ -25,6 +25,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .fsm import NOOP, StateFSM
 from .log import LogEntry, RaftLog
 
+# membership-change entry, applied by the raft layer itself (not the
+# state FSM): payload = the full new peer list (one-at-a-time changes,
+# raft §6 single-server membership change)
+CONFIG = "::config"
+
 ROLE_FOLLOWER = "follower"
 ROLE_CANDIDATE = "candidate"
 ROLE_LEADER = "leader"
@@ -45,6 +50,11 @@ class RaftConfig:
     heartbeat_interval_s: float = 0.05
     snapshot_threshold: int = 8192      # log entries before compaction
     fsync: bool = False
+    # an empty-log member waits this long for an existing leader to
+    # contact it before campaigning: a freshly ADDED server would
+    # otherwise inflate its term pre-join and depose a healthy leader
+    # on first contact (fresh full-cluster bootstraps just wait it out)
+    join_grace_s: float = 1.0
 
 
 class InProcTransport:
@@ -102,6 +112,7 @@ class RaftNode:
         self._threads: List[threading.Thread] = []
         self._deadline = 0.0
         self._meta_saved_commit = 0
+        self._last_leader_contact = 0.0
         self._role_events: List[str] = []    # deferred callbacks
 
         self._meta_path = (os.path.join(config.data_dir, "raft.meta")
@@ -121,7 +132,8 @@ class RaftNode:
             json.dump({"term": self.term, "voted_for": self.voted_for,
                        "commit_index": self.commit_index,
                        "snapshot_index": self.snapshot_index,
-                       "snapshot_term": self.snapshot_term}, f)
+                       "snapshot_term": self.snapshot_term,
+                       "peers": list(self.cfg.peers)}, f)
         os.replace(tmp, self._meta_path)
 
     def _restore_from_disk(self) -> None:
@@ -133,6 +145,10 @@ class RaftNode:
             self.commit_index = meta.get("commit_index", 0)
             self.snapshot_index = meta.get("snapshot_index", 0)
             self.snapshot_term = meta.get("snapshot_term", 0)
+            # membership survives log compaction through the metadata
+            # (a config entry behind the snapshot point is gone)
+            if meta.get("peers"):
+                self.cfg.peers = list(meta["peers"])
         if self._snap_path and os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
                 self.fsm.restore(f.read())
@@ -148,7 +164,10 @@ class RaftNode:
                                      limit=1 << 30):
             if e.index > replay_to:
                 break
-            self.fsm.apply(e.index, e.etype, e.payload)
+            if e.etype == CONFIG:
+                self.cfg.peers = list(e.payload)
+            else:
+                self.fsm.apply(e.index, e.etype, e.payload)
             self.last_applied = e.index
         if single:
             self.commit_index = max(self.commit_index, self.last_applied)
@@ -160,6 +179,8 @@ class RaftNode:
                 return
             self.running = True
             self._reset_election_deadline()
+            if self.log.last_index() == 0 and self.term == 0:
+                self._deadline += self.cfg.join_grace_s
         t = threading.Thread(target=self._run, daemon=True,
                              name=f"raft-{self.id}")
         t.start()
@@ -318,6 +339,10 @@ class RaftNode:
                 raise NotLeaderError(self.leader_id)
             index = self._append_locked(etype, payload)
             term = self.term
+        return self._wait_applied(index, term, timeout)
+
+    def _wait_applied(self, index: int, term: int,
+                      timeout: float) -> int:
         single = len([p for p in self.cfg.peers or [self.id]]) <= 1
         if single:
             with self._lock:
@@ -417,12 +442,66 @@ class RaftNode:
             e = self.log.get(self.last_applied + 1)
             if e is None:
                 break
-            self.fsm.apply(e.index, e.etype, e.payload)
+            if e.etype == CONFIG:
+                self._adopt_config_locked(list(e.payload))
+            else:
+                self.fsm.apply(e.index, e.etype, e.payload)
             self.last_applied = e.index
         self._cv.notify_all()
         if (self.log.last_index() - self.log.offset
                 > self.cfg.snapshot_threshold):
             self._compact_locked()
+
+    def _adopt_config_locked(self, peers: List[str]) -> None:
+        """Adopt a committed membership change. Additions start
+        replication from the leader's snapshot/backlog; removals stop
+        counting toward quorum immediately (a removed self keeps
+        applying until stopped — it simply never wins elections under
+        the stickiness guard)."""
+        old = set(self.cfg.peers)
+        self.cfg.peers = list(peers)
+        self._save_meta()
+        if self.role == ROLE_LEADER:
+            if self.id not in peers:
+                # a leader that committed its own removal steps down
+                # (raft §6) — staying leader would let the stickiness
+                # guard pin the cluster to a non-member forever
+                self.role = ROLE_FOLLOWER
+                self._reset_election_deadline()
+                self._role_events.append("follower")
+                return
+            for p in peers:
+                if p not in old and p != self.id:
+                    self._next[p] = self.log.last_index() + 1
+                    self._match[p] = 0
+            for p in old - set(peers):
+                self._next.pop(p, None)
+                self._match.pop(p, None)
+
+    def propose_config(self, peers: List[str],
+                       timeout: float = 10.0) -> int:
+        """Propose a new peer set. One-at-a-time changes only (so old
+        and new quorums always overlap, raft §6): the set may differ
+        from the current config by a single server, and a previous
+        membership change must be COMMITTED before the next — both
+        checked under the same lock as the append, so concurrent
+        callers cannot interleave conflicting configs into the log."""
+        with self._lock:
+            if self._closed:
+                raise NotLeaderError(None)
+            if self.role != ROLE_LEADER:
+                raise NotLeaderError(self.leader_id)
+            for e in self.log.slice_from(self.commit_index + 1):
+                if e.etype == CONFIG:
+                    raise ValueError(
+                        "a membership change is already in flight")
+            cur = set(self.cfg.peers)
+            if len(cur.symmetric_difference(peers)) > 1:
+                raise ValueError(
+                    "membership changes must add or remove one server")
+            index = self._append_locked(CONFIG, list(peers))
+            term = self.term
+        return self._wait_applied(index, term, timeout)
 
     # --------------------------------------------------------- snapshots
     def _compact_locked(self) -> None:
@@ -448,6 +527,15 @@ class RaftNode:
                          last_log_index: int, last_log_term: int):
         with self._lock:
             if term < self.term:
+                return self.term, False
+            # leader stickiness (raft §6 disruptive-server guard, the
+            # reference's CheckQuorum/pre-vote analog): while appends
+            # from a live leader are arriving, refuse votes — a removed
+            # server with a stale config cannot depose the leader
+            lo, _hi = self.cfg.election_timeout_s
+            if (self.role == ROLE_FOLLOWER
+                    and time.monotonic() - self._last_leader_contact < lo
+                    and candidate != self.voted_for):
                 return self.term, False
             if term > self.term:
                 self._step_down_locked(term)
@@ -481,6 +569,7 @@ class RaftNode:
                     self._role_events.append("follower")
                     events = True
             self.leader_id = leader
+            self._last_leader_contact = time.monotonic()
             self._reset_election_deadline()
             # consistency check
             if prev_index > self.snapshot_index:
@@ -518,6 +607,7 @@ class RaftNode:
             self.term = term
             self.role = ROLE_FOLLOWER
             self.leader_id = leader
+            self._last_leader_contact = time.monotonic()
             self._reset_election_deadline()
             if snap_index <= self.last_applied:
                 return self.term

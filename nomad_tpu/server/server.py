@@ -184,7 +184,11 @@ class Server:
         (reference: leader.go:625 reapDupBlockedEvaluations)."""
         import copy
         from ..structs import EVAL_STATUS_CANCELLED
+        ticks = 0
         while not self._stop_reapers.is_set():
+            ticks += 1
+            if ticks % 10 == 0:
+                self._autopilot_reconcile()
             dups = self.blocked_evals.get_duplicates(timeout=0.2)
             if not dups:
                 continue
@@ -613,6 +617,63 @@ class Server:
         j = _copy.deepcopy(stable_job)
         j.create_index = j.modify_index = j.job_modify_index = 0
         return self.register_job(j)
+
+    # --------------------------------------------------- raft membership
+    def add_server_peer(self, peer_id: str, addr=None) -> int:
+        """One-at-a-time raft membership add (reference: raft
+        AddVoter via nomad/leader.go addRaftPeer on serf join). `addr`
+        updates the transport's peer map when it routes by address."""
+        if addr is not None and hasattr(self.raft.transport,
+                                        "peer_addrs"):
+            self.raft.transport.peer_addrs[peer_id] = addr
+        peers = list(self.raft.cfg.peers)
+        if peer_id in peers:
+            return self.store.latest_index()
+        return self.raft.propose_config(peers + [peer_id])
+
+    def remove_server_peer(self, peer_id: str) -> int:
+        """Membership removal (reference: removeRaftPeer; autopilot's
+        dead-server cleanup calls this when gossip marks a server
+        failed)."""
+        peers = [p for p in self.raft.cfg.peers if p != peer_id]
+        if len(peers) == len(self.raft.cfg.peers):
+            return self.store.latest_index()
+        return self.raft.propose_config(peers)
+
+    def attach_gossip(self, gossip) -> None:
+        """Autopilot wiring (reference: nomad/autopilot.go dead-server
+        cleanup + serf.go nodeFailed -> removeRaftPeer): when gossip
+        declares a SERVER member dead, the leader removes it from the
+        raft peer set so quorum shrinks to the live members. The
+        edge-triggered on_fail is backed by a periodic leader-side
+        reconcile (the reference reconciles from the leader loop), so a
+        death that fires while no stable leader exists is still cleaned
+        up."""
+        self.gossip = gossip
+        prev = gossip.on_fail
+
+        def on_fail(member):
+            if prev is not None:
+                prev(member)
+            self._autopilot_reconcile()
+        gossip.on_fail = on_fail
+
+    def _autopilot_reconcile(self) -> None:
+        gossip = getattr(self, "gossip", None)
+        if gossip is None or not self.is_leader():
+            return
+        from ..membership.gossip import STATUS_DEAD, STATUS_LEFT
+        for peer in list(self.raft.cfg.peers):
+            m = gossip.member(peer)
+            if m is not None and m.status in (STATUS_DEAD, STATUS_LEFT):
+                try:
+                    self.remove_server_peer(peer)
+                except (ValueError, Exception) as e:  # noqa: BLE001
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "autopilot: removal of %s deferred: %s",
+                        peer, e)
+                return    # one at a time; the next tick continues
 
     # ------------------------------------------------------------ secrets
     def upsert_secret(self, namespace: str, path: str,

@@ -237,3 +237,101 @@ def test_lagging_follower_catches_up_via_snapshot(tmp_path):
                 n.stop()
             except Exception:
                 pass
+
+
+# -------------------------------------------- dynamic membership
+def test_add_peer_then_new_member_joins_quorum(tmp_path):
+    transport, servers = _cluster(tmp_path)
+    try:
+        leader = _leader(servers)
+        job = mock.job()
+        leader.register_job(job)
+
+        # boot a fourth member knowing the full (new) peer set
+        peers4 = [s.raft.id for s in servers] + ["s3"]
+        s3 = Server(num_workers=1, raft_config=RaftConfig(
+            node_id="s3", peers=list(peers4),
+            election_timeout_s=(0.10, 0.25), heartbeat_interval_s=0.03),
+            raft_transport=transport)
+        s3.start()
+        leader.add_server_peer("s3")
+        # existing members adopt the 4-peer config and replicate to s3
+        assert wait_until(lambda: all(
+            set(s.raft.cfg.peers) == set(peers4)
+            for s in servers), timeout=10)
+        assert wait_until(lambda: s3.store.job_by_id(
+            job.namespace, job.id) is not None, timeout=10)
+
+        # the new member is a real voter: kill the leader; the
+        # remaining THREE (incl. s3) elect a successor
+        old = _leader(servers)
+        old.stop()
+        rest = [s for s in servers + [s3] if s is not old]
+        assert wait_until(lambda: sum(s.is_leader() for s in rest) == 1,
+                          timeout=10)
+        nl = _leader(rest)
+        job2 = mock.job()
+        nl.register_job(job2)
+        assert wait_until(lambda: all(
+            s.store.job_by_id(job2.namespace, job2.id) is not None
+            for s in rest), timeout=10)
+    finally:
+        for s in servers + [s3]:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_autopilot_removes_dead_server_and_quorum_shrinks(tmp_path):
+    from nomad_tpu.membership import GossipAgent, Member
+    from nomad_tpu.rpc import RpcServer
+
+    transport, servers = _cluster(tmp_path)
+    rpcs, gossips = [], []
+    try:
+        # one gossip member per server, suspicion tuned fast
+        for s in servers:
+            rpc = RpcServer()
+            rpc.start()
+            g = GossipAgent(Member(id=s.raft.id, addr=rpc.addr),
+                            rpc, suspicion_timeout_s=1.0)
+            rpcs.append(rpc)
+            gossips.append(g)
+            s.attach_gossip(g)
+            g.start()
+        for g in gossips[1:]:
+            g.join(gossips[0].me.addr)
+        assert wait_until(lambda: all(
+            len(g.members(alive_only=True)) == 3 for g in gossips),
+            timeout=10)
+
+        # hard-kill a FOLLOWER (server + its gossip)
+        leader = _leader(servers)
+        victim = next(s for s in servers if s is not leader)
+        vix = servers.index(victim)
+        victim.stop()
+        gossips[vix].stop()
+        rpcs[vix].stop()
+
+        # autopilot: the leader notices the death and removes the peer
+        assert wait_until(lambda: victim.raft.id not in
+                          _leader(servers).raft.cfg.peers, timeout=20), \
+            "dead server never removed from the peer set"
+        # quorum is now 2-of-2: writes still commit
+        job = mock.job()
+        _leader(servers).register_job(job)
+        live = [s for s in servers if s is not victim]
+        assert wait_until(lambda: all(
+            s.store.job_by_id(job.namespace, job.id) is not None
+            for s in live), timeout=10)
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        for g in gossips:
+            g.stop()
+        for r in rpcs:
+            r.stop()
