@@ -565,8 +565,20 @@ class HTTPAgentServer:
         return 200, out, None
 
     def agent_members(self, q, body):
-        return 200, {"members": [{"name": "server-1", "status": "alive",
-                                  "leader": True}]}, None
+        """Server membership (reference: /v1/agent/members from serf).
+        With gossip attached the real member list is served; a
+        standalone dev server reports itself."""
+        gossip = getattr(self.server, "gossip", None)
+        if gossip is not None:
+            leader_id = self.server.raft.leader_id
+            return 200, {"members": [
+                {"name": m.id, "addr": list(m.addr),
+                 "region": m.region, "status": m.status,
+                 "leader": m.id == leader_id}
+                for m in gossip.members()]}, None
+        return 200, {"members": [{
+            "name": self.server.raft.id, "status": "alive",
+            "leader": self.server.is_leader()}]}, None
 
     def status_leader(self, q, body):
         return 200, "127.0.0.1:4647", None

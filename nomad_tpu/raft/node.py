@@ -107,6 +107,9 @@ class RaftNode:
         self._events_lock = threading.Lock()
         self._next: Dict[str, int] = {}
         self._match: Dict[str, int] = {}
+        # learners: replicated to, never counted toward quorum — the
+        # catch-up phase before a membership add (raft §6 non-voters)
+        self._staging: List[str] = []
         self.running = False
         self._closed = False
         self._threads: List[threading.Thread] = []
@@ -362,9 +365,10 @@ class RaftNode:
             return index
 
     def _replicate_all(self) -> None:
-        for peer in self.cfg.peers:
-            if peer == self.id:
-                continue
+        with self._lock:
+            targets = [p for p in list(self.cfg.peers)
+                       + list(self._staging) if p != self.id]
+        for peer in targets:
             self._replicate_one(peer)
         with self._lock:
             if self.role == ROLE_LEADER:
@@ -477,6 +481,33 @@ class RaftNode:
             for p in old - set(peers):
                 self._next.pop(p, None)
                 self._match.pop(p, None)
+
+    def add_learner(self, peer: str) -> None:
+        """Start replicating to a NON-VOTING peer (it never counts
+        toward quorum — _advance_commit iterates cfg.peers only)."""
+        with self._lock:
+            if peer not in self._staging and peer not in self.cfg.peers:
+                self._staging.append(peer)
+                self._next[peer] = self.log.last_index() + 1
+                self._match[peer] = 0
+
+    def learner_caught_up(self, peer: str) -> bool:
+        with self._lock:
+            # require real replicated progress: the peer must have acked
+            # appends up to the current commit AND near the log head —
+            # a freshly restored commit_index of 0 must not vacuously
+            # pass a peer that holds nothing
+            match = self._match.get(peer, 0)
+            target = max(self.commit_index, self.log.last_index() - 1)
+            return target > 0 and match >= target
+
+    def remove_learner(self, peer: str) -> None:
+        with self._lock:
+            if peer in self._staging:
+                self._staging.remove(peer)
+            if peer not in self.cfg.peers:
+                self._next.pop(peer, None)
+                self._match.pop(peer, None)
 
     def propose_config(self, peers: List[str],
                        timeout: float = 10.0) -> int:

@@ -16,7 +16,7 @@ import threading
 import time as _time
 from typing import Dict, Iterable, List, Optional
 
-from ..raft import RaftConfig, RaftNode, StateFSM
+from ..raft import NotLeaderError, RaftConfig, RaftNode, StateFSM
 from ..utils.codec import to_wire
 
 from ..state.store import StateStore
@@ -619,9 +619,13 @@ class Server:
         return self.register_job(j)
 
     # --------------------------------------------------- raft membership
-    def add_server_peer(self, peer_id: str, addr=None) -> int:
+    def add_server_peer(self, peer_id: str, addr=None,
+                        catchup_timeout_s: float = 10.0) -> int:
         """One-at-a-time raft membership add (reference: raft
-        AddVoter via nomad/leader.go addRaftPeer on serf join). `addr`
+        AddVoter via nomad/leader.go addRaftPeer on serf join). The new
+        server first replicates as a NON-VOTER until it holds the
+        leader's committed log (the learner phase), then joins the
+        voting config — so a lagging joiner never drags quorum. `addr`
         updates the transport's peer map when it routes by address."""
         if addr is not None and hasattr(self.raft.transport,
                                         "peer_addrs"):
@@ -629,7 +633,25 @@ class Server:
         peers = list(self.raft.cfg.peers)
         if peer_id in peers:
             return self.store.latest_index()
-        return self.raft.propose_config(peers + [peer_id])
+        self.raft.add_learner(peer_id)
+        try:
+            deadline = _time.monotonic() + catchup_timeout_s
+            while not self.raft.learner_caught_up(peer_id):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"peer {peer_id} did not catch up in "
+                        f"{catchup_timeout_s}s")
+                if not self.is_leader():
+                    raise NotLeaderError(self.raft.leader_id)
+                _time.sleep(0.02)
+            # re-read the config: another membership change may have
+            # committed during the catch-up wait
+            peers = list(self.raft.cfg.peers)
+            if peer_id in peers:
+                return self.store.latest_index()
+            return self.raft.propose_config(peers + [peer_id])
+        finally:
+            self.raft.remove_learner(peer_id)
 
     def remove_server_peer(self, peer_id: str) -> int:
         """Membership removal (reference: removeRaftPeer; autopilot's

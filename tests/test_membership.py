@@ -135,3 +135,34 @@ def test_region_routing_cross_region_job_register():
         for s, r in ((servers_a[0], rpcs_a[0]), (servers_b[0], rpcs_b[0])):
             s.stop()
             r.rpc.stop()
+
+
+def test_agent_members_endpoint_reflects_gossip():
+    import json
+    import urllib.request
+    from nomad_tpu.api.http_server import HTTPAgentServer
+    from nomad_tpu.server.server import Server
+
+    srv = Server(num_workers=0)
+    srv.start()
+    http = HTTPAgentServer(srv)
+    http.start()
+    a, rpc_a = make_agent("srv-a", region="alpha")
+    b, rpc_b = make_agent("srv-b", region="beta")
+    try:
+        a.start()
+        b.start()
+        b.join(a.me.addr)
+        srv.attach_gossip(a)
+        assert wait_until(lambda: len(a.members(alive_only=True)) == 2,
+                          timeout=10)
+        with urllib.request.urlopen(http.address + "/v1/agent/members",
+                                    timeout=5) as r:
+            out = json.loads(r.read())
+        names = {m["name"]: m for m in out["members"]}
+        assert set(names) == {"srv-a", "srv-b"}
+        assert names["srv-b"]["region"] == "beta"
+    finally:
+        stop_all([(a, rpc_a), (b, rpc_b)])
+        http.stop()
+        srv.stop()

@@ -335,3 +335,33 @@ def test_autopilot_removes_dead_server_and_quorum_shrinks(tmp_path):
             g.stop()
         for r in rpcs:
             r.stop()
+
+
+def test_add_peer_learner_catchup_before_voting(tmp_path):
+    """A joining peer replicates as a non-voter first; only once it
+    holds the committed log does it enter the voting config."""
+    transport, servers = _cluster(tmp_path)
+    s3 = None
+    try:
+        leader = _leader(servers)
+        for i in range(20):
+            j = mock.job()
+            j.id = f"pre-{i}"
+            leader.register_job(j)
+        peers4 = [s.raft.id for s in servers] + ["s3"]
+        s3 = Server(num_workers=1, raft_config=RaftConfig(
+            node_id="s3", peers=list(peers4),
+            election_timeout_s=(0.10, 0.25), heartbeat_interval_s=0.03),
+            raft_transport=transport)
+        s3.start()
+        leader.add_server_peer("s3")
+        # the add only completed after catch-up: s3 already holds the
+        # pre-join jobs the moment it becomes a voter
+        assert s3.store.job_by_id("default", "pre-19") is not None
+        assert set(_leader(servers).raft.cfg.peers) == set(peers4)
+    finally:
+        for s in servers + ([s3] if s3 else []):
+            try:
+                s.stop()
+            except Exception:
+                pass
