@@ -39,6 +39,7 @@ class TcpRaftTransport:
         self._lock = threading.Lock()
         self._local: Dict[str, Any] = {}
         self._backoff: Dict[str, Tuple[float, int]] = {}  # until, fails
+        self._vote_probe: Dict[str, float] = {}  # last exempt vote dial
 
     # -- the InProcTransport surface ----------------------------------
     def register(self, node) -> None:
@@ -75,12 +76,22 @@ class TcpRaftTransport:
         with self._lock:
             until, fails = self._backoff.get(target, (0.0, 0))
             if now < until:
-                raise ConnectionError(f"peer {target} backing off")
+                # elections must still be able to reach a slow-but-
+                # alive peer, but a black-holed peer must not reinstate
+                # blocking dials in the sequential election loop: allow
+                # ONE exempt vote probe per base backoff window
+                if method != "rpc_request_vote":
+                    raise ConnectionError(f"peer {target} backing off")
+                last = self._vote_probe.get(target, 0.0)
+                if now - last < BACKOFF_BASE_S:
+                    raise ConnectionError(f"peer {target} backing off")
+                self._vote_probe[target] = now
         client = self._pool.get(target, addr)
         try:
             out = client.call(f"raft.{method}",
                               _encode_args(method, list(args)),
-                              timeout=RAFT_CALL_TIMEOUT_S)
+                              timeout=(1.0 if method == "rpc_request_vote"
+                                       else RAFT_CALL_TIMEOUT_S))
         except RpcError as e:
             raise ConnectionError(f"peer {target}: {e}") from e
         except ValueError as e:
