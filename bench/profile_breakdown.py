@@ -1,0 +1,153 @@
+"""Ad-hoc breakdown of where wall time goes in run_ours (bench.py).
+
+Not part of the benchmark — a profiling aid. Run:
+    python bench/profile_breakdown.py <config>
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import bench  # noqa: E402
+
+
+def profiled_run(config):
+    import dataclasses
+    import numpy as np
+    from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
+    from nomad_tpu.solver.kernel import MERGED_GP_MAX
+
+    p = dict(bench.CONFIGS[config])
+    n_nodes, n_evals = p["n_nodes"], p["n_evals"]
+    count, resident = p["count"], p["resident"]
+    epc = min(128, n_evals)
+
+    devices = config == 4
+    nodes = bench.make_nodes(n_nodes, devices=devices)
+    probe_job = bench.make_job(config, 0, count)
+    merge = True
+    gp_need = MERGED_GP_MAX
+    kp_need = count * epc
+    t0 = time.perf_counter()
+    rs = ResidentSolver(nodes, bench.asks_for(probe_job),
+                        gp=1 << max(0, (gp_need - 1).bit_length()),
+                        kp=1 << max(0, (kp_need - 1).bit_length()),
+                        max_waves=18)
+    t_build = time.perf_counter() - t0
+    rs.reset_usage(used0=bench.resident_used0(
+        rs.template, n_nodes, resident))
+
+    t0 = time.perf_counter()
+    jobs = [bench.make_job(config, e, count) for e in range(n_evals)]
+    t_jobs = time.perf_counter() - t0
+
+    NB = -(-n_evals // epc)
+    warm_asks = sum((bench.asks_for(j) for j in jobs[:epc]), [])
+    warm_asks, _wk = rs.merge_asks(warm_asks)
+    warm = rs.pack_batch(warm_asks)
+    warm.job_keys = None
+    t0 = time.perf_counter()
+    rs.solve_stream([warm] * NB, seeds=list(range(1, NB + 1)))
+    t_warm = time.perf_counter() - t0
+    if NB > 1:
+        rs.solve_stream([warm], seeds=[1])
+    rs.reset_usage(used0=bench.resident_used0(
+        rs.template, n_nodes, resident))
+
+    # ---- measured section, phase by phase
+    t0 = time.perf_counter()
+    asks_all, batches = [], []
+    t_merge = t_pack = 0.0
+    for i in range(0, n_evals, epc):
+        t1 = time.perf_counter()
+        asks = sum((bench.asks_for(j) for j in jobs[i:i + epc]), [])
+        asks, keys = rs.merge_asks(asks)
+        t_merge += time.perf_counter() - t1
+        t1 = time.perf_counter()
+        pb = rs.pack_batch(asks, job_keys=keys)
+        t_pack += time.perf_counter() - t1
+        asks_all.append(asks)
+        batches.append(pb)
+    t_pack_all = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = rs.solve_stream_async(
+        batches, seeds=list(range(1, NB + 1)))
+    t_dispatch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    choice, ok, score, status = rs.finish_stream(out)
+    t_fetch = time.perf_counter() - t0
+
+    placed = failed = 0
+    t0 = time.perf_counter()
+    cur = []
+    for b, pb in enumerate(batches):
+        placed += int(ok[b, :pb.n_place, 0].sum())
+        failed += int((status[b, :pb.n_place] == 0).sum())
+        per_ask = [0] * len(asks_all[b])
+        for pix in range(pb.n_place):
+            if status[b, pix] == STATUS_RETRY:
+                per_ask[int(pb.p_ask[pix])] += 1
+        cur.extend((a, r) for a, r in zip(asks_all[b], per_ask) if r)
+    t_harvest = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    n_drain_calls = 0
+    drain_left = sum(r for _, r in cur)
+    for t_retry in range(4):
+        if not cur:
+            break
+        drain_asks = [dataclasses.replace(a, count=r) for a, r in cur]
+        by_job = {}
+        for a in drain_asks:
+            by_job.setdefault((a.job.namespace, a.job.id), []).append(a)
+        chunks, cur_chunk, cur_k = [], [], 0
+        for job_asks in by_job.values():
+            jk = sum(a.count for a in job_asks)
+            if cur_chunk and (len(cur_chunk) + len(job_asks) > rs.gp
+                              or cur_k + jk > rs.kp):
+                chunks.append(cur_chunk)
+                cur_chunk, cur_k = [], 0
+            cur_chunk.extend(job_asks)
+            cur_k += jk
+        if cur_chunk:
+            chunks.append(cur_chunk)
+        pbs = [rs.pack_batch(c) for c in chunks]
+        n_drain_calls += 1
+        _, ok2, _, st2 = rs.solve_stream(
+            pbs, seeds=[1009 + 17 * t_retry + i for i in range(len(pbs))])
+        nxt = []
+        for b, (pb, chunk) in enumerate(zip(pbs, chunks)):
+            placed += int(ok2[b, :pb.n_place, 0].sum())
+            failed += int((st2[b, :pb.n_place] == 0).sum())
+            per_ask = [0] * len(chunk)
+            for pix in range(pb.n_place):
+                if st2[b, pix] == STATUS_RETRY:
+                    per_ask[int(pb.p_ask[pix])] += 1
+            nxt.extend((a, r) for a, r in zip(chunk, per_ask) if r)
+        cur = nxt
+    t_drain = time.perf_counter() - t0
+
+    total = t_pack_all + t_dispatch + t_fetch + t_harvest + t_drain
+    print(f"config {config}: nodes={n_nodes} evals={n_evals} "
+          f"count={count} resident={resident} NB={NB}")
+    print(f"  build solver       {t_build:8.3f}s")
+    print(f"  make jobs          {t_jobs:8.3f}s  (outside measured)")
+    print(f"  warm call          {t_warm:8.3f}s")
+    print(f"  [measured] total   {total:8.3f}s -> "
+          f"{placed / total:,.0f} placements/s  placed={placed} "
+          f"failed={failed} drain_left={drain_left}")
+    print(f"    merge_asks       {t_merge:8.3f}s")
+    print(f"    pack_batch       {t_pack:8.3f}s")
+    print(f"    dispatch (async) {t_dispatch:8.3f}s  "
+          "(stack+transfer+launch)")
+    print(f"    fetch result     {t_fetch:8.3f}s  (device compute+rtt)")
+    print(f"    harvest status   {t_harvest:8.3f}s")
+    print(f"    drain rounds     {t_drain:8.3f}s  calls={n_drain_calls}")
+
+
+if __name__ == "__main__":
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    profiled_run(cfg)

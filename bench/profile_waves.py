@@ -1,0 +1,75 @@
+"""Measure device compute per stream call vs max_waves (profiling aid).
+
+Times solve_stream on pre-packed batches for a config, subtracting the
+transport round trip, across wave budgets. Run:
+    python bench/profile_waves.py <config>
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import bench  # noqa: E402
+
+
+def main(config):
+    import numpy as np
+    from nomad_tpu.solver.kernel import MERGED_GP_MAX
+    from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
+
+    p = dict(bench.CONFIGS[config])
+    n_nodes, n_evals = p["n_nodes"], p["n_evals"]
+    count, resident = p["count"], p["resident"]
+    epc = min(128, n_evals)
+    NB = -(-n_evals // epc)
+    rtt = bench.measure_transport_rtt()
+    print(f"rtt={1000 * rtt:.1f}ms  config={config} NB={NB}")
+
+    nodes = bench.make_nodes(n_nodes, devices=config == 4)
+    probe_job = bench.make_job(config, 0, count)
+    jobs = [bench.make_job(config, e, count) for e in range(n_evals)]
+
+    for mw in (4, 6, 8, 12, 18):
+        rs = ResidentSolver(nodes, bench.asks_for(probe_job),
+                            gp=MERGED_GP_MAX,
+                            kp=1 << max(0, (count * epc - 1).bit_length()),
+                            max_waves=mw)
+        used0 = bench.resident_used0(rs.template, n_nodes, resident)
+        batches, keys_all = [], []
+        for i in range(0, n_evals, epc):
+            asks = sum((bench.asks_for(j) for j in jobs[i:i + epc]), [])
+            asks, keys = rs.merge_asks(asks)
+            pb = rs.pack_batch(asks, job_keys=keys)
+            batches.append(pb)
+        if mw == 4:
+            print(f"  merged groups per batch: "
+                  f"{[len({tuple(pb.p_ask[:pb.n_place])}) for pb in batches[:1]]}"
+                  f" G rows used: {int((batches[0].ask_desired > 0).sum())}"
+                  f" K={batches[0].n_place}")
+        rs.reset_usage(used0=used0)
+        seeds = list(range(1, NB + 1))
+        rs.solve_stream(batches, seeds=seeds)      # compile
+        rs.reset_usage(used0=used0)
+        ts = []
+        outs = None
+        for _ in range(3):
+            rs.reset_usage(used0=used0)
+            t0 = time.perf_counter()
+            outs = rs.solve_stream(batches, seeds=seeds)
+            ts.append(time.perf_counter() - t0)
+        choice, ok, score, status = outs
+        placed = retry = failed = 0
+        for b, pb in enumerate(batches):
+            placed += int(ok[b, :pb.n_place, 0].sum())
+            retry += int((status[b, :pb.n_place] == STATUS_RETRY).sum())
+            failed += int((status[b, :pb.n_place] == 0).sum())
+        best = min(ts)
+        print(f"  max_waves={mw:3d}: call={1000 * best:7.1f}ms "
+              f"compute~={1000 * (best - rtt):7.1f}ms "
+              f"placed={placed} retry={retry} failed={failed}")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2)

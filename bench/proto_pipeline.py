@@ -1,0 +1,95 @@
+"""Prototype: chunked async dispatch vs single fused call (config 2/3).
+
+    python bench/proto_pipeline.py <config> [n_evals]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import bench  # noqa: E402
+
+
+def main(config, n_evals=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nomad_tpu.solver.kernel import MERGED_GP_MAX
+    from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
+
+    p = dict(bench.CONFIGS[config])
+    n_nodes = p["n_nodes"]
+    n_evals = n_evals or p["n_evals"]
+    count, resident = p["count"], p["resident"]
+    epc = min(128, n_evals)
+    NB = -(-n_evals // epc)
+
+    nodes = bench.make_nodes(n_nodes, devices=config == 4)
+    probe_job = bench.make_job(config, 0, count)
+    jobs = [bench.make_job(config, e, count) for e in range(n_evals)]
+    rs = ResidentSolver(nodes, bench.asks_for(probe_job),
+                        gp=MERGED_GP_MAX,
+                        kp=1 << max(0, (count * epc - 1).bit_length()),
+                        max_waves=6)
+    used0 = bench.resident_used0(rs.template, n_nodes, resident)
+
+    stack_jit = jax.jit(lambda *xs: jnp.stack(xs))
+
+    # warm both paths
+    warm_asks, _ = rs.merge_asks(
+        sum((bench.asks_for(j) for j in jobs[:epc]), []))
+    warm = rs.pack_batch(warm_asks)
+    warm.job_keys = None
+    rs.solve_stream([warm] * NB, seeds=list(range(1, NB + 1)))
+    out1 = rs.solve_stream_async([warm], seeds=[1])
+    np.asarray(stack_jit(*([out1] * NB)))
+
+    def harvest(status, pb):
+        st = status[:pb.n_place]
+        placed = int((st == 1).sum())
+        failed = int((st == 0).sum())
+        return placed, failed
+
+    # ---- path 1: pack everything, one fused call
+    for trial in range(2):
+        rs.reset_usage(used0=used0)
+        t0 = time.perf_counter()
+        batches = []
+        for i in range(0, n_evals, epc):
+            asks, keys = rs.merge_asks(
+                sum((bench.asks_for(j) for j in jobs[i:i + epc]), []))
+            batches.append(rs.pack_batch(asks, job_keys=keys))
+        choice, ok, score, status = rs.solve_stream(
+            batches, seeds=list(range(1, NB + 1)))
+        el = time.perf_counter() - t0
+        placed = sum(harvest(status[b], pb)[0]
+                     for b, pb in enumerate(batches))
+        print(f"fused single call : {1000 * el:7.1f}ms "
+              f"{placed / el:10,.0f} pps placed={placed}")
+
+    # ---- path 2: per-chunk async dispatch, one stacked fetch
+    for trial in range(2):
+        rs.reset_usage(used0=used0)
+        t0 = time.perf_counter()
+        outs, pbs = [], []
+        for b, i in enumerate(range(0, n_evals, epc)):
+            asks, keys = rs.merge_asks(
+                sum((bench.asks_for(j) for j in jobs[i:i + epc]), []))
+            pb = rs.pack_batch(asks, job_keys=keys)
+            pbs.append(pb)
+            outs.append(rs.solve_stream_async([pb], seeds=[b + 1]))
+        packed = np.asarray(stack_jit(*outs))   # one fetch
+        el = time.perf_counter() - t0
+        status = packed[:, 0, :, -1].astype(np.int32)
+        placed = sum(harvest(status[b], pb)[0]
+                     for b, pb in enumerate(pbs))
+        print(f"pipelined chunks  : {1000 * el:7.1f}ms "
+              f"{placed / el:10,.0f} pps placed={placed}")
+
+
+if __name__ == "__main__":
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    ne = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    main(cfg, ne)

@@ -385,7 +385,7 @@ static State make_cluster(int n_nodes, int resident, bool devices) {
     n.cap.mem = 8192 + (i % 4) * 4096;
     n.cap.disk = 100000;
     n.cap.net = 1000;
-    if (devices && i % 4 == 0) n.device_cap = 4;
+    if (devices && i % 2 == 0) n.device_cap = 8;
     // computed class = everything non-unique (node.go ComputedClass)
     n.computed_class = n.dc + "|" + n.attrs["rack"] + "|" + n.attrs["zone"] +
                        "|" + std::to_string(n.cap.cpu) + "|" +

@@ -1,0 +1,243 @@
+"""Federated resident solve: R regions fused into ONE device call.
+
+The reference federates by running an independent server cluster per
+region and forwarding RPCs between them (nomad/serf.go WAN gossip,
+nomad/rpc.go `forward`); each region's scheduler is oblivious to the
+others.  The TPU recast keeps that isolation — each region owns its own
+node universe, usage tensors, and eval stream — but fuses the *solves*:
+every stream step carries one batch per region, vmapped over a leading
+region axis inside a single `lax.scan` device program.  One dispatch and
+one result fetch cover every region's whole workload, where R separate
+streams would pay R transport round trips (ruinous on tunneled
+transports, see solver/resident.py).
+
+On a multi-chip mesh the region axis is the natural sharding axis: the
+same program with the vmap replaced by a `shard_map` over a
+`Mesh(('region',))` places one region's universe per chip and needs no
+cross-chip collectives at all — regions never share state (see
+parallel/sharded.federated_solve for the mesh variant used by the
+multi-chip dryrun).
+
+Semantics per region are identical to ResidentSolver.solve_stream:
+resource usage carries batch-to-batch on device, job-scoped state is
+seeded per batch, and the per-job stream guard applies within a region's
+stream.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..structs import Node
+from ..solver.kernel import NEG_INF, TOP_K
+from ..solver.resident import (ResidentSolver, STATUS_COMMITTED,
+                               STATUS_FAILED, STATUS_RETRY, _ASK_ARGS,
+                               _solve_one)
+from ..solver.tensorize import PackedBatch, PlacementAsk
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("has_spread", "group_count_hint",
+                                    "max_waves"))
+def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
+                             dev_cap, used0, dev_used0, stacked, n_places,
+                             seeds, has_spread=True, group_count_hint=0,
+                             max_waves=0):
+    """Node args carry a leading [R] region axis; `stacked` ask tensors
+    carry [B, R, ...]; scan over B steps, vmap over R regions."""
+
+    def step(carry, xs):
+        used, dev_used = carry                       # [R, ...]
+        batch, n_place, seed = xs                    # [R, ...] each
+
+        def one_region(av, rs_, vl, ndc, ar, dcp, u, du, b, n, s):
+            # "while" wave mode: under this vmap a cond-skipped scan
+            # would execute every budget wave for every region lane
+            # (cond lowers to select when batched); the while_loop runs
+            # exactly as many waves as the slowest region needs
+            return _solve_one(av, rs_, vl, ndc, ar, dcp, u, du, b, n, s,
+                              has_spread, group_count_hint, max_waves,
+                              "while")
+
+        res = jax.vmap(one_region)(avail, reserved, valid, node_dc,
+                                   attr_rank, dev_cap, used, dev_used,
+                                   batch, n_place, seed)
+        status = jnp.where(res.choice_ok[:, :, 0], STATUS_COMMITTED,
+                           jnp.where(res.unfinished, STATUS_RETRY,
+                                     STATUS_FAILED))
+        packed = jnp.concatenate(
+            [res.choice.astype(jnp.float32), res.score,
+             status.astype(jnp.float32)[:, :, None]], axis=-1)
+        return (res.used_final, res.dev_used_final), packed
+
+    (used_f, dev_used_f), out = jax.lax.scan(
+        step, (used0, dev_used0), (stacked, n_places, seeds))
+    return used_f, dev_used_f, out                   # out [B, R, K, .]
+
+
+class FederatedResidentSolver:
+    """R regional node universes solved in one fused device stream.
+
+    Every region gets its own ResidentSolver for packing (merge_asks /
+    pack_batch run against that region's rank universe); the node-side
+    tensors are stacked [R, ...] once at construction.  All regions'
+    templates must agree on padded shapes — build them from the same
+    probe asks over same-sized clusters (pass `gp`/`kp` explicitly to
+    pin the ask-side padding).
+    """
+
+    def __init__(self, region_nodes: Sequence[Sequence[Node]],
+                 probe_asks: Sequence[PlacementAsk],
+                 gp: Optional[int] = None, kp: Optional[int] = None,
+                 max_waves: int = 0):
+        if not region_nodes:
+            raise ValueError("need at least one region")
+        self.solvers: List[ResidentSolver] = [
+            ResidentSolver(nodes, probe_asks, gp=gp, kp=kp,
+                           max_waves=max_waves)
+            for nodes in region_nodes]
+        self.R = len(self.solvers)
+        self.gp = self.solvers[0].gp
+        self.kp = self.solvers[0].kp
+        self.max_waves = max_waves
+        shapes = {tuple(s.template.avail.shape) for s in self.solvers}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"region universes disagree on padded node shape: {shapes}")
+        for name in ("attr_rank", "dc_ok", "dev_cap"):
+            dims = {tuple(getattr(s.template, name).shape)
+                    for s in self.solvers}
+            if len(dims) != 1:
+                raise ValueError(
+                    f"region universes disagree on {name} shape: {dims}")
+        t0 = self.solvers[0].template
+        self._node_stack = {
+            "avail": jax.device_put(np.stack(
+                [s.template.avail for s in self.solvers])),
+            "reserved": jax.device_put(np.stack(
+                [s.template.reserved for s in self.solvers])),
+            "valid": jax.device_put(np.stack(
+                [s.template.valid for s in self.solvers])),
+            "node_dc": jax.device_put(np.stack(
+                [s.template.node_dc for s in self.solvers])),
+            "attr_rank": jax.device_put(np.stack(
+                [s.template.attr_rank for s in self.solvers])),
+            "dev_cap": jax.device_put(np.stack(
+                [s.template.dev_cap for s in self.solvers])),
+        }
+        self._used = jax.device_put(np.stack(
+            [s.template.used0 for s in self.solvers]))
+        self._dev_used = jax.device_put(np.stack(
+            [s.template.dev_used0 for s in self.solvers]))
+        self._const_cache: Dict = {}
+        self._default_host_ok = np.stack(
+            [s._default_host_ok for s in self.solvers])  # [R, gp, Np]
+
+    # ---------------- packing (delegates per region) ----------------
+    def merge_asks(self, region: int, asks: Sequence[PlacementAsk]):
+        return self.solvers[region].merge_asks(asks)
+
+    def pack_batch(self, region: int, asks: Sequence[PlacementAsk],
+                   job_keys: Optional[set] = None
+                   ) -> Optional[PackedBatch]:
+        return self.solvers[region].pack_batch(asks, job_keys=job_keys)
+
+    # ---------------- solving ----------------
+    def solve_stream(self, batches: Sequence[Sequence[PackedBatch]],
+                     seeds: Optional[Sequence[Sequence[int]]] = None):
+        """batches[r][b]: region r's b-th batch; every region must carry
+        the same number of steps (pad with an empty repeat batch if a
+        region's workload is shorter).  Returns (choice, ok, score,
+        status) each with leading [R, B] axes."""
+        return self.finish_stream(self.solve_stream_async(batches, seeds))
+
+    def solve_stream_async(self,
+                           batches: Sequence[Sequence[PackedBatch]],
+                           seeds=None):
+        NBs = {len(rb) for rb in batches}
+        if len(batches) != self.R or len(NBs) != 1:
+            raise ValueError(
+                f"need {self.R} regions with equal step counts, got "
+                f"{[len(rb) for rb in batches]}")
+        NB = NBs.pop()
+        for r, rb in enumerate(batches):
+            self.solvers[r]._check_stream_jobs(rb)
+        stacked = self._stack_args(batches, NB)
+        n_places = np.asarray(
+            [[batches[r][b].n_place for r in range(self.R)]
+             for b in range(NB)], np.int32)               # [B, R]
+        if seeds is None:
+            seed_arr = np.zeros((NB, self.R), np.int32)
+        else:
+            seed_arr = np.asarray(
+                [[seeds[r][b] for r in range(self.R)]
+                 for b in range(NB)], np.int32)
+        flat = [pb for rb in batches for pb in rb]
+        self._used, self._dev_used, out = _federated_stream_kernel(
+            self._node_stack["avail"], self._node_stack["reserved"],
+            self._node_stack["valid"], self._node_stack["node_dc"],
+            self._node_stack["attr_rank"], self._node_stack["dev_cap"],
+            self._used, self._dev_used, stacked, n_places, seed_arr,
+            has_spread=ResidentSolver._has_spread(flat),
+            group_count_hint=ResidentSolver._group_count_hint(flat),
+            max_waves=self.max_waves)
+        return out
+
+    def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+        out = np.asarray(out)                        # [B, R, K, .]
+        out = np.swapaxes(out, 0, 1)                 # [R, B, K, .]
+        choice = out[..., :TOP_K].astype(np.int32)
+        score = out[..., TOP_K:2 * TOP_K]
+        status = out[..., -1].astype(np.int32)
+        ok = score > NEG_INF / 2
+        return choice, ok, score, status
+
+    def _stack_args(self, batches, NB):
+        """[B, R, ...] host stack with the device-resident zero-constant
+        shortcut for the big [G, N] tensors (see ResidentSolver)."""
+        stacked = {}
+        for name in _ASK_ARGS:
+            mats = [[getattr(batches[r][b], name) for r in range(self.R)]
+                    for b in range(NB)]
+            if name in ("coll0", "penalty", "a_host") and not any(
+                    m.any() for row in mats for m in row):
+                key = (name, NB)
+                if key not in self._const_cache:
+                    self._const_cache[key] = jax.device_put(np.zeros(
+                        (NB, self.R) + mats[0][0].shape,
+                        mats[0][0].dtype))
+                stacked[name] = self._const_cache[key]
+                continue
+            if name == "host_ok" and all(
+                    np.array_equal(m, self._default_host_ok[r])
+                    for row in mats for r, m in enumerate(row)):
+                key = (name, NB)
+                if key not in self._const_cache:
+                    self._const_cache[key] = jax.device_put(
+                        np.broadcast_to(
+                            self._default_host_ok[None],
+                            (NB,) + self._default_host_ok.shape).copy())
+                stacked[name] = self._const_cache[key]
+                continue
+            stacked[name] = np.stack(
+                [np.stack(row) for row in mats])
+        return stacked
+
+    # ---------------- usage ----------------
+    def usage(self) -> Tuple[np.ndarray, np.ndarray]:
+        return np.asarray(self._used), np.asarray(self._dev_used)
+
+    def reset_usage(self, used0: Optional[np.ndarray] = None,
+                    dev_used0: Optional[np.ndarray] = None) -> None:
+        if used0 is None:
+            used0 = np.stack([s.template.used0 for s in self.solvers])
+        if dev_used0 is None:
+            dev_used0 = np.stack(
+                [s.template.dev_used0 for s in self.solvers])
+        self._used = jax.device_put(used0)
+        self._dev_used = jax.device_put(dev_used0)

@@ -16,6 +16,7 @@ Two levels:
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -97,7 +98,11 @@ def sharded_solve(pb: PackedBatch, mesh: Mesh):
 
 # vmap over a leading region axis: each region is an independent solve
 # (regions don't share nodes), mapping onto disjoint device rows.
-_federated_kernel = jax.jit(jax.vmap(solve_kernel))
+# wave_mode="while": under vmap the scan shape's cond-skip lowers to
+# select and pays the full wave budget per lane (see kernel.py loop-
+# shape note); the while_loop runs only as deep as the slowest region.
+_federated_kernel = jax.jit(jax.vmap(
+    functools.partial(solve_kernel, wave_mode="while")))
 
 
 def federated_solve(pbs: Sequence[PackedBatch], mesh: Mesh):

@@ -26,6 +26,11 @@ _log = logging.getLogger(__name__)
 RAFT_CALL_TIMEOUT_S = 2.0
 BACKOFF_BASE_S = 0.25
 BACKOFF_MAX_S = 5.0
+VOTE_PROBE_TIMEOUT_S = 1.0
+# the exempt-probe window must cover at least one full blocked dial,
+# or a black-holed peer gets a fresh blocking probe every election
+# round (each round is naturally spaced by the dial timeout itself)
+VOTE_PROBE_WINDOW_S = 2 * VOTE_PROBE_TIMEOUT_S
 
 
 class TcpRaftTransport:
@@ -79,18 +84,21 @@ class TcpRaftTransport:
                 # elections must still be able to reach a slow-but-
                 # alive peer, but a black-holed peer must not reinstate
                 # blocking dials in the sequential election loop: allow
-                # ONE exempt vote probe per base backoff window
+                # ONE exempt vote probe per probe window (the window is
+                # wider than the probe's own dial timeout, so at most
+                # half of any period can be spent blocked on one peer)
                 if method != "rpc_request_vote":
                     raise ConnectionError(f"peer {target} backing off")
                 last = self._vote_probe.get(target, 0.0)
-                if now - last < BACKOFF_BASE_S:
+                if now - last < VOTE_PROBE_WINDOW_S:
                     raise ConnectionError(f"peer {target} backing off")
                 self._vote_probe[target] = now
         client = self._pool.get(target, addr)
         try:
             out = client.call(f"raft.{method}",
                               _encode_args(method, list(args)),
-                              timeout=(1.0 if method == "rpc_request_vote"
+                              timeout=(VOTE_PROBE_TIMEOUT_S
+                                       if method == "rpc_request_vote"
                                        else RAFT_CALL_TIMEOUT_S))
         except RpcError as e:
             raise ConnectionError(f"peer {target}: {e}") from e

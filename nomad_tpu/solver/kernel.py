@@ -107,7 +107,7 @@ class SolveResult(NamedTuple):
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves"))
+                                    "max_waves", "wave_mode"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -115,7 +115,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  sp_col, sp_weight, sp_targeted, sp_desired, sp_implicit,
                  sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place,
                  seed=0, *, has_spread=True,
-                 group_count_hint=0, max_waves=0) -> SolveResult:
+                 group_count_hint=0, max_waves=0,
+                 wave_mode="scan") -> SolveResult:
     max_waves = max_waves or MAX_WAVES
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
@@ -594,18 +595,25 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
                 wave + jnp.int32(1))
 
-    # Fixed-trip scan, not while_loop: a data-dependent loop condition
-    # forces a host sync per iteration on tunneled transports (tens of
-    # ms each), while a static-length scan is one uninterrupted device
-    # program. Drained waves skip the body through lax.cond, costing
-    # only the (compact) carry. The rank-wrap commit above converges
-    # real batches in a handful of waves; anything still unfinished
-    # after MAX_WAVES is reported in `unfinished` and flows into the
-    # system's blocked-eval retry path.
-    def body_scan(st, _):
-        any_active = (~st[3] & (ks < n_place)).any()
-        return lax.cond(any_active, body, lambda s: s, st), None
-
+    # Two loop shapes, chosen statically by the caller:
+    #
+    # "scan" (default) — fixed-trip scan whose body is skipped through
+    # `lax.cond` once every placement is decided.  In unbatched context
+    # the cond lowers to a real branch, so drained waves cost only the
+    # (compact) carry; the wave budget can be generous.
+    #
+    # "while" — `lax.while_loop` with the same condition.  Under a vmap
+    # (the federated region-stacked solve) `lax.cond` degrades to
+    # `select` and BOTH branches execute every wave for every lane, so
+    # the scan shape pays the full budget; a while_loop instead runs
+    # until every lane drains — the trip count is the max actual
+    # convergence depth, evaluated ON DEVICE (no host sync per
+    # iteration, the loop is one uninterrupted device program).
+    #
+    # The rank-wrap commit above converges real batches in a handful of
+    # waves either way; anything still unfinished after max_waves is
+    # reported in `unfinished` and flows into the system's blocked-eval
+    # retry path.
     st0 = (used0, dev_used0, sp_used0,
            jnp.zeros(K, bool),
            jnp.zeros((K, TOP_K), jnp.int32),
@@ -615,7 +623,18 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
            jnp.zeros(K, jnp.int32),
            jnp.zeros((K, R), jnp.int32),
            jnp.int32(0))
-    (st_final, _) = lax.scan(body_scan, st0, None, length=max_waves)
+    if wave_mode == "while":
+        def w_cond(st):
+            return ((~st[3] & (ks < n_place)).any()
+                    & (st[10] < jnp.int32(max_waves)))
+
+        st_final = lax.while_loop(w_cond, body, st0)
+    else:
+        def body_scan(st, _):
+            any_active = (~st[3] & (ks < n_place)).any()
+            return lax.cond(any_active, body, lambda s: s, st), None
+
+        (st_final, _) = lax.scan(body_scan, st0, None, length=max_waves)
     (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
      out_nfeas, out_nexh, out_dimexh, waves) = st_final
     unfinished = ~done & (ks < n_place)

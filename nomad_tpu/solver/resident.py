@@ -57,7 +57,7 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
-               group_count_hint=0, max_waves=0):
+               group_count_hint=0, max_waves=0, wave_mode="scan"):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -69,15 +69,16 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
         dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
         seed, has_spread=has_spread, group_count_hint=group_count_hint,
-        max_waves=max_waves)
+        max_waves=max_waves, wave_mode=wave_mode)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves"))
+                                    "max_waves", "wave_mode"))
 def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                      used0, dev_used0, stacked, n_places, seeds,
-                     has_spread=True, group_count_hint=0, max_waves=0):
+                     has_spread=True, group_count_hint=0, max_waves=0,
+                     wave_mode="while"):
     """The TPU recast of the reference's optimistic worker concurrency
     (nomad/worker.go goroutines + nomad/plan_apply.go serial applier):
     vmap B batch-solves against ONE shared usage snapshot — each with its
@@ -89,7 +90,8 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         lambda b, n, s: _solve_one(avail, reserved, valid, node_dc,
                                    attr_rank, dev_cap, used0, dev_used0,
                                    b, n, s, has_spread,
-                                   group_count_hint, max_waves)
+                                   group_count_hint, max_waves,
+                                   wave_mode)
     )(stacked, n_places, seeds)
     # res.* have a leading [B] axis; slot-0 choices are the commits
     K = res.choice.shape[1]
@@ -144,10 +146,11 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves"))
+                                    "max_waves", "wave_mode"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
-                   has_spread=True, group_count_hint=0, max_waves=0):
+                   has_spread=True, group_count_hint=0, max_waves=0,
+                   wave_mode="scan"):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device."""
 
@@ -156,7 +159,8 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch, n_place, seed = xs
         res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used, dev_used, batch, n_place, seed,
-                         has_spread, group_count_hint, max_waves)
+                         has_spread, group_count_hint, max_waves,
+                         wave_mode)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -185,9 +189,10 @@ class ResidentSolver:
                  probe_asks: Sequence[PlacementAsk],
                  allocs_by_node: Optional[Dict[str, list]] = None,
                  gp: Optional[int] = None, kp: Optional[int] = None,
-                 max_waves: int = 0):
+                 max_waves: int = 0, wave_mode: str = "scan"):
         self.nodes = list(nodes)
         self.max_waves = max_waves        # 0 = kernel default
+        self.wave_mode = wave_mode        # see kernel.py loop-shape note
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
         self.gp = gp or self.template.ask_res.shape[0]
@@ -311,7 +316,7 @@ class ResidentSolver:
             self._used, self._dev_used, stacked, n_places, seed_arr,
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
-            max_waves=self.max_waves)
+            max_waves=self.max_waves, wave_mode=self.wave_mode)
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
@@ -423,7 +428,9 @@ class ResidentSolver:
             self._used, self._dev_used, stacked, n_places, seeds,
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
-            max_waves=self.max_waves)
+            max_waves=self.max_waves)     # wave_mode: the parallel
+        # kernel's vmap over sibling batches always wants "while" (its
+        # default) — a cond would run every budget wave for every lane
         return self._unpack(out)
 
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:

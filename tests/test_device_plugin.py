@@ -54,8 +54,11 @@ def test_device_ask_e2e_env_injection(tmp_path):
         task = tg.tasks[0]
         task.driver = "raw_exec"
         out_file = str(tmp_path / "envdump")
+        # write-then-rename so the watcher never reads a half-written dump
         task.config = {"command": "/bin/sh",
-                       "args": ["-c", f"env > {out_file}; sleep 30"]}
+                       "args": ["-c", f"env > {out_file}.tmp && "
+                                      f"mv {out_file}.tmp {out_file}; "
+                                      "sleep 30"]}
         task.resources.networks = []
         task.resources.devices = [RequestedDevice(name="acme/fpga/v9",
                                                   count=2)]

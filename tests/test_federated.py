@@ -1,0 +1,118 @@
+"""FederatedResidentSolver: the region-fused stream must be bitwise
+identical, region by region, to independent ResidentSolver streams with
+the same batches and seeds (regions never share state — reference:
+nomad/serf.go WAN federation keeps regional schedulers independent)."""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.parallel.federated import FederatedResidentSolver
+from nomad_tpu.solver.resident import ResidentSolver
+from nomad_tpu.solver.tensorize import PlacementAsk
+from nomad_tpu.structs import Constraint, Spread
+
+
+def region_nodes(n, flavor):
+    nodes = []
+    for i in range(n):
+        nd = mock.node(datacenter=f"dc{i % 2}")
+        nd.attributes["rack"] = f"r{i % 4}"
+        nd.node_resources.cpu = 4000 + (i % 4) * 1000 + flavor * 500
+        nd.compute_class()
+        nodes.append(nd)
+    return nodes
+
+
+def make_ask(count, cpu=500, rack=None, spread=False, job_id=None):
+    job = mock.job()
+    if job_id:
+        job.id = job_id
+        job.name = job_id
+    job.datacenters = ["dc0", "dc1"]
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = cpu
+    if rack:
+        job.constraints = [Constraint("${attr.rack}", rack, "=")]
+    if spread:
+        job.spreads = [Spread(attribute="${node.datacenter}", weight=100)]
+    return PlacementAsk(job=job, tg=tg, count=count)
+
+
+def batch_stream(region_ix):
+    """Two batches per region, distinct jobs, mixed specs."""
+    return [
+        [make_ask(3, cpu=600, job_id=f"r{region_ix}-a"),
+         make_ask(2, rack="r1", job_id=f"r{region_ix}-b")],
+        [make_ask(4, spread=True, job_id=f"r{region_ix}-c")],
+    ]
+
+
+def test_federated_stream_matches_independent_regions():
+    regions = [region_nodes(16, 0), region_nodes(16, 1)]
+    probe = [make_ask(2, rack="r1", spread=True), make_ask(2)]
+    fed = FederatedResidentSolver(regions, probe, gp=4, kp=8)
+    seeds = [[1, 2], [3, 4]]
+
+    batches = []
+    for r in range(2):
+        rb = [fed.pack_batch(r, asks) for asks in batch_stream(r)]
+        assert all(pb is not None for pb in rb)
+        batches.append(rb)
+    choice, ok, score, status = fed.solve_stream(batches, seeds=seeds)
+
+    for r in range(2):
+        solo = ResidentSolver(regions[r], probe, gp=4, kp=8)
+        solo_b = [solo.pack_batch(asks) for asks in batch_stream(r)]
+        c2, ok2, s2, st2 = solo.solve_stream(solo_b, seeds=seeds[r])
+        np.testing.assert_array_equal(choice[r], c2)
+        np.testing.assert_array_equal(ok[r], ok2)
+        np.testing.assert_array_equal(status[r], st2)
+        np.testing.assert_allclose(score[r], s2, rtol=1e-6)
+
+
+def test_federated_usage_carries_across_streams():
+    regions = [region_nodes(8, 0), region_nodes(8, 1)]
+    probe = [make_ask(2)]
+    fed = FederatedResidentSolver(regions, probe, gp=2, kp=8)
+    b1 = [[fed.pack_batch(0, [make_ask(2, job_id="x0")])],
+          [fed.pack_batch(1, [make_ask(2, job_id="x1")])]]
+    fed.solve_stream(b1)
+    used_after1, _ = fed.usage()
+    b2 = [[fed.pack_batch(0, [make_ask(2, job_id="y0")])],
+          [fed.pack_batch(1, [make_ask(2, job_id="y1")])]]
+    fed.solve_stream(b2)
+    used_after2, _ = fed.usage()
+    # each region's usage strictly grows on its own axis
+    assert (used_after2.sum() > used_after1.sum())
+    assert used_after1.shape[0] == 2
+
+
+def test_federated_rejects_mismatched_step_counts():
+    regions = [region_nodes(8, 0), region_nodes(8, 1)]
+    probe = [make_ask(2)]
+    fed = FederatedResidentSolver(regions, probe, gp=2, kp=8)
+    b = [[fed.pack_batch(0, [make_ask(2, job_id="x0")])], []]
+    with pytest.raises(ValueError):
+        fed.solve_stream(b)
+
+
+def test_federated_same_job_guard_is_per_region():
+    """The same job id in two batches of ONE region's stream must raise;
+    the same job id appearing in DIFFERENT regions is fine (regions are
+    separate failure/scheduling domains)."""
+    regions = [region_nodes(8, 0), region_nodes(8, 1)]
+    probe = [make_ask(2)]
+    fed = FederatedResidentSolver(regions, probe, gp=2, kp=8)
+    # same id in both regions: allowed
+    b_ok = [[fed.pack_batch(0, [make_ask(1, job_id="dup")])],
+            [fed.pack_batch(1, [make_ask(1, job_id="dup")])]]
+    fed.solve_stream(b_ok)
+    # same id twice within region 0's stream: rejected
+    b_bad = [[fed.pack_batch(0, [make_ask(1, job_id="dup")]),
+              fed.pack_batch(0, [make_ask(1, job_id="dup")])],
+             [fed.pack_batch(1, [make_ask(1, job_id="z1")]),
+              fed.pack_batch(1, [make_ask(1, job_id="z2")])]]
+    with pytest.raises(ValueError):
+        fed.solve_stream(b_bad)

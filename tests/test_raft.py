@@ -95,10 +95,12 @@ def test_single_server_restart_restores_state(tmp_path):
     # and the restored cluster still schedules: a client picks up work
     client = SimClient(s2, s2.store.node_by_id(node.id))
     client.start()
+    # generous: under a full-suite run this may be the test that pays for
+    # a cold XLA compile of the solve kernel on a loaded machine
     assert wait_until(lambda: any(
         a.client_status == "running"
         for a in s2.store.allocs_by_job(job.namespace, job.id)),
-        timeout=30)
+        timeout=120)
     client.stop()
     s2.stop()
 
@@ -164,7 +166,7 @@ def test_leader_failover_keeps_identical_state_mid_workload(tmp_path):
         leader.register_job(job)
         assert wait_until(lambda: sum(
             1 for a in leader.store.allocs_by_job(job.namespace, job.id)
-            if a.client_status == "running") == 3, timeout=30)
+            if a.client_status == "running") == 3, timeout=120)
         pre_allocs = {a.id for a in
                       leader.store.allocs_by_job(job.namespace, job.id)}
 
@@ -194,7 +196,7 @@ def test_leader_failover_keeps_identical_state_mid_workload(tmp_path):
         assert wait_until(lambda: sum(
             1 for a in new_leader.store.allocs_by_job(job2.namespace,
                                                       job2.id)
-            if a.client_status == "running") == 2, timeout=30)
+            if a.client_status == "running") == 2, timeout=120)
         client2.stop()
     finally:
         for s in servers:

@@ -98,8 +98,12 @@ def test_task_env_resolves_secret_references(tmp_path):
         tg.count = 1
         task = tg.tasks[0]
         task.driver = "raw_exec"
+        # write-then-rename: the watcher below must never read a
+        # half-written dump
         task.config = {"command": "/bin/sh",
-                       "args": ["-c", f"env > {out_file}; sleep 30"]}
+                       "args": ["-c", f"env > {out_file}.tmp && "
+                                      f"mv {out_file}.tmp {out_file}; "
+                                      "sleep 30"]}
         task.resources.networks = []
         task.env = {"DB_PASS": "${secret.db/creds.pass}",
                     "PLAIN": "asis"}
