@@ -1,6 +1,11 @@
-"""Ad-hoc breakdown of where wall time goes in run_ours (bench.py).
+"""Ad-hoc phase breakdown of the SINGLE-FUSED-CALL schedule.
 
-Not part of the benchmark — a profiling aid. Run:
+Not part of the benchmark, and deliberately NOT the shipped run_ours
+schedule: bench.py now dispatches two pipelined half-calls (pack
+overlapping solve) and harvests with bench._harvest; this aid keeps the
+one-fused-call shape so pack / dispatch / fetch / harvest can be timed
+in isolation (the pipelined path hides them inside each other). Compare
+its total against bench.py to see what the overlap buys. Run:
     python bench/profile_breakdown.py <config>
 """
 import os

@@ -44,10 +44,10 @@ def main(config):
             pb = rs.pack_batch(asks, job_keys=keys)
             batches.append(pb)
         if mw == 4:
+            pb0 = batches[0]
             print(f"  merged groups per batch: "
-                  f"{[len({tuple(pb.p_ask[:pb.n_place])}) for pb in batches[:1]]}"
-                  f" G rows used: {int((batches[0].ask_desired > 0).sum())}"
-                  f" K={batches[0].n_place}")
+                  f"{len(set(pb0.p_ask[:pb0.n_place].tolist()))}"
+                  f" K={pb0.n_place}")
         rs.reset_usage(used0=used0)
         seeds = list(range(1, NB + 1))
         rs.solve_stream(batches, seeds=seeds)      # compile

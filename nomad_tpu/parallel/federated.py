@@ -42,11 +42,13 @@ from ..solver.tensorize import PackedBatch, PlacementAsk
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves"))
+                                    "max_waves", "has_distinct",
+                                    "has_devices"))
 def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
                              dev_cap, used0, dev_used0, stacked, n_places,
                              seeds, has_spread=True, group_count_hint=0,
-                             max_waves=0):
+                             max_waves=0, has_distinct=True,
+                             has_devices=True):
     """Node args carry a leading [R] region axis; `stacked` ask tensors
     carry [B, R, ...]; scan over B steps, vmap over R regions."""
 
@@ -61,7 +63,7 @@ def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
             # exactly as many waves as the slowest region needs
             return _solve_one(av, rs_, vl, ndc, ar, dcp, u, du, b, n, s,
                               has_spread, group_count_hint, max_waves,
-                              "while")
+                              "while", has_distinct, has_devices)
 
         res = jax.vmap(one_region)(avail, reserved, valid, node_dc,
                                    attr_rank, dev_cap, used, dev_used,
@@ -184,7 +186,9 @@ class FederatedResidentSolver:
             self._used, self._dev_used, stacked, n_places, seed_arr,
             has_spread=ResidentSolver._has_spread(flat),
             group_count_hint=ResidentSolver._group_count_hint(flat),
-            max_waves=self.max_waves)
+            max_waves=self.max_waves,
+            has_distinct=ResidentSolver._has_distinct(flat),
+            has_devices=ResidentSolver._has_devices(flat))
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,

@@ -65,6 +65,12 @@ _SELECT_SUM_MAX_V = 16
 # over so few rows is cheap. Shared by resident._group_count_hint and
 # merged-mode callers sizing gp.
 MERGED_GP_MAX = 16
+# per-group candidate-window caps (wave width W <= cap): merged
+# few-group batches carry thousands of placements per group, and a
+# wider window is more same-wave commit capacity — i.e. fewer waves —
+# at near-zero extraction cost with so few rows (read at trace time)
+_MERGED_W_CAP = 1024
+_WIDE_W_CAP = 256
 
 
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
@@ -107,7 +113,8 @@ class SolveResult(NamedTuple):
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves", "wave_mode"))
+                                    "max_waves", "wave_mode",
+                                    "has_distinct", "has_devices"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -116,7 +123,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  sp_used0, dev_cap, dev_used0, dev_ask, p_ask, n_place,
                  seed=0, *, has_spread=True,
                  group_count_hint=0, max_waves=0,
-                 wave_mode="scan") -> SolveResult:
+                 wave_mode="scan", has_distinct=True,
+                 has_devices=True) -> SolveResult:
+    # has_distinct / has_devices: trace-time guarantees from the packer
+    # that NO ask in this batch uses distinct_hosts / requests devices —
+    # the per-wave conflict sort, blocking scatter, and device-fit
+    # arithmetic those features need then drop out of the program
+    # entirely (the common fresh-service-job case)
     max_waves = max_waves or MAX_WAVES
     Np = avail.shape[0]
     Gp = ask_res.shape[0]
@@ -136,7 +149,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # merged few-group batches (throughput-mode ask dedup) carry far
     # more placements per group; with tiny Gp the top-k cost of a wider
     # window is negligible, so let W grow
-    w_cap = 1024 if Gp <= MERGED_GP_MAX else 256
+    w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
     TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, Np)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
     ks = jnp.arange(K)
@@ -242,8 +255,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         after = used[None, :, :] + ask_res[:, None, :]     # [Gp, Np, R]
         fit_dims = after <= avail[None, :, :]
         fit = fit_dims.all(axis=-1)
-        dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
-                   <= dev_cap[None, :, :]).all(axis=-1)
+        if has_devices:
+            dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
+                       <= dev_cap[None, :, :]).all(axis=-1)
+        else:
+            dev_fit = jnp.ones((Gp, Np), bool)
         feas_b = feas & ~blocked
         placeable = feas_b & fit & dev_fit
 
@@ -342,11 +358,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         chosen = jnp.where(committed, out_idx[:, 0], 0)
         coll = coll0.at[g_idx, chosen].add(
             committed.astype(jnp.float32))
-        dg_all = distinct[g_idx]
-        hit = jnp.zeros((Gp, Np), jnp.int32).at[
-            jnp.maximum(dg_all, 0), chosen].add(
-            (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
-        blocked = hit[jnp.maximum(distinct, 0)] & (distinct >= 0)[:, None]
+        if has_distinct:
+            dg_all = distinct[g_idx]
+            hit = jnp.zeros((Gp, Np), jnp.int32).at[
+                jnp.maximum(dg_all, 0), chosen].add(
+                (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
+            blocked = hit[jnp.maximum(distinct, 0)] \
+                & (distinct >= 0)[:, None]
+        else:
+            blocked = jnp.zeros((Gp, Np), bool)
 
         score, placeable, feas_b, fit, fit_dims, dev_fit = group_scores(
             used, dev_used, coll, sp_used, blocked)
@@ -509,19 +529,25 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 return jnp.where(member, rank, 0)
 
         res_k = ask_res[g_idx] * cand_ok[:, None]
-        dev_k = dev_ask[g_idx] * cand_ok[:, None]
         prior = prior_sum_node(res_k)                      # [K, R]
-        prior_dev = prior_sum_node(dev_k)                  # [K, D]
         fits = ((used[cand] + prior + ask_res[g_idx])
                 <= avail[cand]).all(axis=-1)
-        dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
-                    <= dev_cap[cand]).all(axis=-1)
+        if has_devices:
+            dev_k = dev_ask[g_idx] * cand_ok[:, None]
+            prior_dev = prior_sum_node(dev_k)              # [K, D]
+            dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
+                        <= dev_cap[cand]).all(axis=-1)
+        else:
+            dev_fits = jnp.ones(K, bool)
 
         # distinct_hosts: one commit per (node, distinct group) per wave;
         # cross-wave blocking keeps later waves off the node too
-        dg = distinct[g_idx]
-        dg_key = cand * jnp.int32(Gp) + jnp.maximum(dg, 0)
-        dg_ok = prior_rank(dg_key, dg >= 0) == 0
+        if has_distinct:
+            dg = distinct[g_idx]
+            dg_key = cand * jnp.int32(Gp) + jnp.maximum(dg, 0)
+            dg_ok = prior_rank(dg_key, dg >= 0) == 0
+        else:
+            dg_ok = jnp.ones(K, bool)
 
         # spread quota: cap same-wave commits per (group, slot, value) so
         # a wave cannot blow far past a spread target the serial
@@ -568,7 +594,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # -- apply all of this wave's commits at once (coll/blocked are
         # rebuilt from the outputs next wave, not carried) --
         used = used.at[cand].add(ask_res[g_idx] * cm)
-        dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
+        if has_devices:
+            dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
         if has_spread:
             svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
             okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm

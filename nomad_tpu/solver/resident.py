@@ -57,7 +57,8 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
-               group_count_hint=0, max_waves=0, wave_mode="scan"):
+               group_count_hint=0, max_waves=0, wave_mode="scan",
+               has_distinct=True, has_devices=True):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -69,16 +70,19 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         batch["sp_desired"], batch["sp_implicit"], batch["sp_used0"],
         dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
         seed, has_spread=has_spread, group_count_hint=group_count_hint,
-        max_waves=max_waves, wave_mode=wave_mode)
+        max_waves=max_waves, wave_mode=wave_mode,
+        has_distinct=has_distinct, has_devices=has_devices)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves", "wave_mode"))
+                                    "max_waves", "wave_mode",
+                                    "has_distinct", "has_devices"))
 def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                      used0, dev_used0, stacked, n_places, seeds,
                      has_spread=True, group_count_hint=0, max_waves=0,
-                     wave_mode="while"):
+                     wave_mode="while", has_distinct=True,
+                     has_devices=True):
     """The TPU recast of the reference's optimistic worker concurrency
     (nomad/worker.go goroutines + nomad/plan_apply.go serial applier):
     vmap B batch-solves against ONE shared usage snapshot — each with its
@@ -91,7 +95,7 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                                    attr_rank, dev_cap, used0, dev_used0,
                                    b, n, s, has_spread,
                                    group_count_hint, max_waves,
-                                   wave_mode)
+                                   wave_mode, has_distinct, has_devices)
     )(stacked, n_places, seeds)
     # res.* have a leading [B] axis; slot-0 choices are the commits
     K = res.choice.shape[1]
@@ -146,11 +150,13 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
-                                    "max_waves", "wave_mode"))
+                                    "max_waves", "wave_mode",
+                                    "has_distinct", "has_devices"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
                    has_spread=True, group_count_hint=0, max_waves=0,
-                   wave_mode="scan"):
+                   wave_mode="scan", has_distinct=True,
+                   has_devices=True):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device."""
 
@@ -160,7 +166,7 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used, dev_used, batch, n_place, seed,
                          has_spread, group_count_hint, max_waves,
-                         wave_mode)
+                         wave_mode, has_distinct, has_devices)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -250,7 +256,8 @@ class ResidentSolver:
         import dataclasses
         from ..scheduler import feasible as hostfeas
         from ..structs import CONSTRAINT_DISTINCT_HOSTS
-        merged: Dict = {}
+        first: Dict = {}
+        counts: Dict = {}
         out: List[PlacementAsk] = []
         order: List = []
         keys = {(a.job.namespace, a.job.id) for a in asks}
@@ -265,13 +272,17 @@ class ResidentSolver:
                 out.append(a)
                 continue
             sig = self._tz.ask_signature(a)
-            if sig in merged:
-                merged[sig] = dataclasses.replace(
-                    merged[sig], count=merged[sig].count + a.count)
+            if sig in counts:
+                counts[sig] += a.count
             else:
-                merged[sig] = a
+                first[sig] = a
+                counts[sig] = a.count
                 order.append(sig)
-        return [merged[sig] for sig in order] + out, keys
+        merged = [
+            (first[sig] if counts[sig] == first[sig].count
+             else dataclasses.replace(first[sig], count=counts[sig]))
+            for sig in order]
+        return merged + out, keys
 
     def solve_stream(self, batches: Sequence[PackedBatch],
                      seeds: Optional[Sequence[int]] = None
@@ -316,7 +327,9 @@ class ResidentSolver:
             self._used, self._dev_used, stacked, n_places, seed_arr,
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
-            max_waves=self.max_waves, wave_mode=self.wave_mode)
+            max_waves=self.max_waves, wave_mode=self.wave_mode,
+            has_distinct=self._has_distinct(batches),
+            has_devices=self._has_devices(batches))
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
@@ -326,6 +339,14 @@ class ResidentSolver:
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:
         return bool(any((pb.sp_col[:, 0] >= 0).any() for pb in batches))
+
+    @staticmethod
+    def _has_distinct(batches: Sequence[PackedBatch]) -> bool:
+        return bool(any((pb.distinct >= 0).any() for pb in batches))
+
+    @staticmethod
+    def _has_devices(batches: Sequence[PackedBatch]) -> bool:
+        return bool(any(pb.dev_ask.any() for pb in batches))
 
     @staticmethod
     def _group_count_hint(batches: Sequence[PackedBatch]) -> int:
@@ -340,11 +361,11 @@ class ResidentSolver:
         # floor at 64: one compiled variant covers all small counts
         # (reduced drain/retry batches would otherwise each compile
         # their own bucket). The ceiling mirrors the kernel's wave-width
-        # clamp (2*128 for wide batches, 2*512 for merged few-group
-        # batches <= MERGED_GP_MAX rows) — larger hints would compile
+        # clamp (W = min(2*hint, w_cap)) — larger hints would compile
         # byte-identical programs.
+        from .kernel import _MERGED_W_CAP, _WIDE_W_CAP
         gp = max((pb.ask_res.shape[0] for pb in batches), default=0)
-        cap = 512 if gp <= MERGED_GP_MAX else 128
+        cap = (_MERGED_W_CAP if gp <= MERGED_GP_MAX else _WIDE_W_CAP) // 2
         return min(1 << max(6, (m - 1).bit_length()), cap)
 
     @staticmethod
@@ -428,7 +449,9 @@ class ResidentSolver:
             self._used, self._dev_used, stacked, n_places, seeds,
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
-            max_waves=self.max_waves)     # wave_mode: the parallel
+            max_waves=self.max_waves,
+            has_distinct=self._has_distinct(batches),
+            has_devices=self._has_devices(batches))  # wave_mode: the parallel
         # kernel's vmap over sibling batches always wants "while" (its
         # default) — a cond would run every budget wave for every lane
         return self._unpack(out)
