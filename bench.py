@@ -308,34 +308,49 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         # compiled): a bigger retry count splits into <=64-count rows —
         # same merged-population semantics, no compile in the timed
         # region.  Exact mode never splits (counts are already <=64).
-        split = []
-        for a, r in cur:
-            if merge:
+        if merge:
+            # merged drain rows are stateless by merge eligibility, so
+            # they may span chunks freely: flatten the splits, then fill
+            # chunks greedily under the gp/kp caps
+            split = []
+            for a, r in cur:
                 while r > 64:
-                    split.append((a, 64))
+                    split.append(dataclasses.replace(a, count=64))
                     r -= 64
-            split.append((a, r))
-        drain_asks = [dataclasses.replace(a, count=r) for a, r in split]
-        # chunk into batches that fit the resident universe (gp asks /
-        # kp placements per batch); a job's asks stay in ONE batch
-        # (stream invariant: job-scoped state does not cross batches);
-        # each chunk dispatches as its own B=1 call (the warmed shape),
-        # one stacked fetch per drain round
-        by_job = {}
-        for a in drain_asks:
-            by_job.setdefault((a.job.namespace, a.job.id), []).append(a)
-        chunks, cur_chunk, cur_k = [], [], 0
-        for job_asks in by_job.values():
-            jk = sum(a.count for a in job_asks)
-            if cur_chunk and (len(cur_chunk) + len(job_asks) > gp_cap
-                              or cur_k + jk > kp_cap):
+                split.append(dataclasses.replace(a, count=r))
+            chunks, cur_chunk, cur_k = [], [], 0
+            for a in split:
+                if cur_chunk and (len(cur_chunk) + 1 > gp_cap
+                                  or cur_k + a.count > kp_cap):
+                    chunks.append(cur_chunk)
+                    cur_chunk, cur_k = [], 0
+                cur_chunk.append(a)
+                cur_k += a.count
+            if cur_chunk:
                 chunks.append(cur_chunk)
-                cur_chunk, cur_k = [], 0
-            cur_chunk.extend(job_asks)
-            cur_k += jk
-        if cur_chunk:
-            chunks.append(cur_chunk)
+        else:
+            # exact mode: asks may carry job-scoped state — a job's
+            # asks stay in ONE chunk (stream invariant)
+            drain_asks = [dataclasses.replace(a, count=r)
+                          for a, r in cur]
+            by_job = {}
+            for a in drain_asks:
+                by_job.setdefault((a.job.namespace, a.job.id),
+                                  []).append(a)
+            chunks, cur_chunk, cur_k = [], [], 0
+            for job_asks in by_job.values():
+                jk = sum(a.count for a in job_asks)
+                if cur_chunk and (len(cur_chunk) + len(job_asks) > gp_cap
+                                  or cur_k + jk > kp_cap):
+                    chunks.append(cur_chunk)
+                    cur_chunk, cur_k = [], 0
+                cur_chunk.extend(job_asks)
+                cur_k += jk
+            if cur_chunk:
+                chunks.append(cur_chunk)
         pbs = [rs.pack_batch(c) for c in chunks]
+        assert all(pb is not None for pb in pbs), \
+            "drain chunk fell outside the resident universe"
         douts = []
         for i, pb in enumerate(pbs):
             douts.append(rs.solve_stream_async(
@@ -656,12 +671,35 @@ def run_quality_duel():
 
 
 def main():
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        # subprocess mode: run one config, print its record as JSON
+        print("\x1e" + json.dumps(run_config(int(sys.argv[2]))))
+        return
     only = int(sys.argv[1]) if len(sys.argv) > 1 else None
     results = []
     for c in sorted(CONFIGS):
         if only and c != only:
             continue
-        results.append(run_config(c))
+        if only:
+            results.append(run_config(c))
+            continue
+        # full-suite mode: one subprocess per config — isolates device
+        # state and the transport client between configs (long-lived
+        # processes showed config-order throughput drift) while the
+        # persistent XLA compile cache keeps per-config startup warm
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", str(c)],
+            capture_output=True, text=True)
+        rec = None
+        if out.returncode == 0:
+            for line in out.stdout.splitlines():
+                if line.startswith("\x1e"):
+                    rec = json.loads(line[1:])
+        if rec is None:
+            sys.stderr.write(f"config {c} subprocess failed:\n"
+                             f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}\n")
+            rec = run_config(c)        # in-process fallback
+        results.append(rec)
     rtt = measure_transport_rtt()
     for r in results:
         if r["config"] == 1:

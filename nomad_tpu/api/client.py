@@ -24,9 +24,11 @@ class APIError(Exception):
 
 class ApiClient:
     def __init__(self, address: Optional[str] = None,
-                 timeout: float = 330.0):
+                 timeout: float = 330.0, token: Optional[str] = None):
         self.address = (address or os.environ.get("NOMAD_ADDR")
                         or "http://127.0.0.1:4646").rstrip("/")
+        # reference: api.Config.SecretID / NOMAD_TOKEN (api/api.go)
+        self.token = token or os.environ.get("NOMAD_TOKEN", "")
         self.timeout = timeout
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
@@ -48,6 +50,8 @@ class ApiClient:
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 payload = json.loads(resp.read() or b"null")
@@ -162,6 +166,71 @@ class Allocations(_Sub):
 
     def stop(self, alloc_id: str) -> dict:
         return self.c.post(f"/v1/allocation/{alloc_id}/stop")[0]
+
+    def exec_stream(self, alloc_id: str, command, task: str = "",
+                    tty: bool = True, stdin_fd=None, stdout_fd=1,
+                    tty_size=None, timeout: float = 3600.0) -> int:
+        """Interactive exec (reference: api/allocations.go Exec —
+        websocket to the agent, bridged to the driver's streaming
+        exec).  Pumps local file descriptors: stdin_fd -> task stdin
+        (None = output-only), task output -> stdout_fd.  Returns the
+        remote exit code."""
+        import base64
+        import json as _json
+        import select
+        import threading
+        from urllib.parse import quote
+
+        from .websocket import WebSocketClosed, client_connect
+
+        qs = (f"command={quote(_json.dumps([str(c) for c in command]))}"
+              f"&tty={'true' if tty else 'false'}")
+        if task:
+            qs += f"&task={quote(task)}"
+        url = (f"{self.c.address}/v1/client/allocation/{alloc_id}"
+               f"/exec?{qs}")
+        ws = client_connect(url, token=self.c.token, timeout=timeout)
+        if tty_size:
+            ws.send_json({"tty_size": {"width": tty_size[0],
+                                       "height": tty_size[1]}})
+        done = threading.Event()
+
+        def pump_stdin():
+            if stdin_fd is None:
+                return
+            try:
+                while not done.is_set():
+                    r, _, _ = select.select([stdin_fd], [], [], 0.2)
+                    if not r:
+                        continue
+                    data = os.read(stdin_fd, 65536)
+                    if not data:
+                        ws.send_json({"stdin": {"close": True}})
+                        return
+                    ws.send_json({"stdin": {
+                        "data": base64.b64encode(data).decode()}})
+            except (OSError, WebSocketClosed):
+                pass
+
+        in_t = threading.Thread(target=pump_stdin, daemon=True)
+        in_t.start()
+        code = -1
+        try:
+            while True:
+                msg = ws.recv_json()
+                if msg is None:
+                    break
+                if "stdout" in msg and msg["stdout"].get("data"):
+                    os.write(stdout_fd,
+                             base64.b64decode(msg["stdout"]["data"]))
+                elif "exit" in msg:
+                    code = int(msg["exit"].get("code", -1))
+                    break
+        finally:
+            done.set()
+            ws.close()
+            in_t.join(timeout=1.0)
+        return code
 
 
 class Evaluations(_Sub):

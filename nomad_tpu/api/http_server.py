@@ -10,8 +10,10 @@ answer with the X-Nomad-Index header, exactly like the reference.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -77,6 +79,13 @@ class HTTPAgentServer:
                 pass
 
             def _handle(self, method: str):
+                upgrade = (self.headers.get("Upgrade") or "").lower()
+                if (method == "GET" and upgrade == "websocket"
+                        and "/exec" in self.path
+                        and self.path.startswith("/v1/client/allocation/")):
+                    outer.handle_exec_ws(self)
+                    self.close_connection = True
+                    return
                 if method == "GET" and (self.path == "/ui"
                                         or self.path.startswith("/ui/")
                                         or self.path == "/"):
@@ -661,14 +670,119 @@ class HTTPAgentServer:
         return 200, {"task": task, "type": kind, "data": text,
                      "size": len(data)}, None
 
-    def client_exec(self, q, body, alloc_id):
-        """One-shot command execution inside a task's context
-        (reference: alloc exec, plugins/drivers ExecTask — the one-shot
-        form; interactive pty streaming is not implemented)."""
+    def handle_exec_ws(self, handler) -> None:
+        """Interactive exec over a websocket (reference: the alloc-exec
+        stream — api/allocations.go Exec websocket frames bridged to
+        plugins/drivers/execstreaming.go ExecTaskStreaming).
+
+        Frames: client sends {"stdin": {"data": b64}} |
+        {"stdin": {"close": true}} | {"tty_size": {"width", "height"}};
+        server sends {"stdout": {"data": b64}} | {"exit": {"code": N}}.
+        """
+        import base64
+        import select
+        from urllib.parse import parse_qs, urlsplit
+
+        from .websocket import WebSocketClosed, server_handshake
+
+        parts = urlsplit(handler.path)
+        q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        token = handler.headers.get("X-Nomad-Token", "")
+
+        def refuse(code: int, msg: str) -> None:
+            data = json.dumps({"error": msg}).encode()
+            resp = (f"HTTP/1.1 {code} Error\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(data)}\r\n\r\n")
+            handler.connection.sendall(resp.encode() + data)
+
+        try:
+            self._enforce_acl("POST", parts.path, q, None, token)
+            alloc_id = parts.path.split("/")[4]
+            tr = self._resolve_task_runner(alloc_id, q.get("task"))
+            cmd = json.loads(q.get("command") or "[]")
+            if not isinstance(cmd, list) or not cmd:
+                raise HTTPError(400, "query param 'command' must be a "
+                                     "non-empty JSON array")
+            tty = q.get("tty", "true") != "false"
+            stream = tr.driver.exec_task_streaming(
+                tr.task_id, [str(c) for c in cmd], tty=tty)
+        except HTTPError as e:
+            refuse(e.code, e.msg)
+            return
+        except Exception as e:
+            refuse(500, str(e))
+            return
+
+        ws = server_handshake(handler)
+        stop = threading.Event()
+
+        def pump_output():
+            try:
+                while not stop.is_set():
+                    r, _, _ = select.select([stream.fd], [], [], 0.2)
+                    if not r:
+                        if stream.poll() is not None:
+                            break
+                        continue
+                    try:
+                        data = os.read(stream.fd, 65536)
+                    except OSError:      # pty closed on child exit
+                        break
+                    if not data:
+                        break
+                    ws.send_json({"stdout": {
+                        "data": base64.b64encode(data).decode()}})
+            except WebSocketClosed:
+                pass
+            finally:
+                # drain the exit code (bounded — the child may have
+                # been killed by close)
+                code = stream.poll()
+                for _ in range(50):
+                    if code is not None:
+                        break
+                    time.sleep(0.1)
+                    code = stream.poll()
+                try:
+                    ws.send_json({"exit": {
+                        "code": -1 if code is None else code}})
+                except WebSocketClosed:
+                    pass
+                ws.send_close()
+
+        out_t = threading.Thread(target=pump_output, daemon=True)
+        out_t.start()
+        try:
+            while True:
+                msg = ws.recv_json()
+                if msg is None:
+                    break
+                if "stdin" in msg:
+                    st = msg["stdin"]
+                    if st.get("close"):
+                        stream.close_stdin()
+                    elif st.get("data"):
+                        try:
+                            os.write(stream.fd,
+                                     base64.b64decode(st["data"]))
+                        except OSError:
+                            break
+                elif "tty_size" in msg:
+                    sz = msg["tty_size"]
+                    stream.resize(int(sz.get("width", 80)),
+                                  int(sz.get("height", 24)))
+        finally:
+            stop.set()
+            if stream.poll() is None:
+                stream.terminate()
+            out_t.join(timeout=6.0)
+            stream.close()
+
+    def _resolve_task_runner(self, alloc_id: str, task):
+        """Find the local task runner for (alloc prefix, task name)."""
         if self.client is None:
             raise HTTPError(400, "no client agent on this node")
-        if not body or not body.get("cmd"):
-            raise HTTPError(400, "body must carry 'cmd' (list)")
         runner = self.client.get_alloc_runner(alloc_id)
         if runner is None:
             matches = [r for aid, r in list(self.client.runners.items())
@@ -676,7 +790,6 @@ class HTTPAgentServer:
             if len(matches) != 1:
                 raise HTTPError(404, f"alloc {alloc_id} not on node")
             runner = matches[0]
-        task = body.get("task")
         trs = runner.task_runners
         if task:
             trs = [tr for tr in trs if tr.task.name == task]
@@ -686,6 +799,15 @@ class HTTPAgentServer:
         tr = trs[0]
         if tr.handle is None:
             raise HTTPError(409, "task is not running")
+        return tr
+
+    def client_exec(self, q, body, alloc_id):
+        """One-shot command execution inside a task's context
+        (reference: alloc exec, plugins/drivers ExecTask — the one-shot
+        form; see handle_exec_ws for the interactive pty stream)."""
+        if not body or not body.get("cmd"):
+            raise HTTPError(400, "body must carry 'cmd' (list)")
+        tr = self._resolve_task_runner(alloc_id, body.get("task"))
         try:
             timeout_s = float(body.get("timeout_s", 30.0))
         except (TypeError, ValueError):

@@ -1,0 +1,239 @@
+"""Minimal dependency-free WebSocket (RFC 6455) — server and client.
+
+The reference streams interactive `alloc exec` sessions over a
+websocket between the CLI and the agent HTTP API
+(command/alloc_exec.go -> api/allocations.go Exec -> websocket ->
+command/agent/alloc_endpoint.go), then over gRPC to the driver
+(plugins/drivers/execstreaming.go).  This module is the wire layer for
+the same path here: JSON text frames, close/ping/pong control frames,
+client-side masking per the RFC.  Only what the exec path needs — no
+extensions, no fragmentation (frames up to 2^63 are written whole;
+fragmented incoming messages are reassembled).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+from typing import Optional, Tuple
+from urllib.parse import urlsplit
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+# the exec protocol's frames are b64 chunks of <=64KiB reads plus JSON
+# overhead; anything larger is a hostile or broken peer.  The cap
+# bounds what one connection can park in this process's memory.
+MAX_MESSAGE_BYTES = 1 << 20
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+class WebSocketClosed(Exception):
+    pass
+
+
+class WebSocketConn:
+    """A connected websocket endpoint over a plain socket.
+
+    `mask` must be True for client-originated frames (RFC 6455 §5.3);
+    servers send unmasked.
+    """
+
+    def __init__(self, sock: socket.socket, mask: bool):
+        self._sock = sock
+        self._mask = mask
+        self._buf = b""
+        self.closed = False
+
+    # ------------------------------------------------------------ send
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        if self.closed:
+            raise WebSocketClosed("send on closed websocket")
+        head = bytes([0x80 | opcode])
+        mask_bit = 0x80 if self._mask else 0
+        n = len(payload)
+        if n < 126:
+            head += bytes([mask_bit | n])
+        elif n < (1 << 16):
+            head += bytes([mask_bit | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+        if self._mask:
+            key = os.urandom(4)
+            masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+            data = head + key + masked
+        else:
+            data = head + payload
+        try:
+            self._sock.sendall(data)
+        except OSError as e:
+            self.closed = True
+            raise WebSocketClosed(str(e))
+
+    def send_json(self, obj) -> None:
+        self._send_frame(OP_TEXT, json.dumps(obj).encode())
+
+    def send_close(self, code: int = 1000) -> None:
+        if not self.closed:
+            try:
+                self._send_frame(OP_CLOSE, struct.pack(">H", code))
+            except WebSocketClosed:
+                pass
+            self.closed = True
+
+    # ------------------------------------------------------------ recv
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = self._sock.recv(65536)
+            except OSError as e:
+                raise WebSocketClosed(str(e))
+            if not chunk:
+                raise WebSocketClosed("peer closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _recv_frame(self) -> Tuple[int, bytes, bool]:
+        h = self._read_exact(2)
+        fin = bool(h[0] & 0x80)
+        opcode = h[0] & 0x0F
+        masked = bool(h[1] & 0x80)
+        n = h[1] & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", self._read_exact(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", self._read_exact(8))[0]
+        if n > MAX_MESSAGE_BYTES:
+            self.send_close(1009)          # message too big
+            raise WebSocketClosed(f"frame of {n} bytes exceeds cap")
+        key = self._read_exact(4) if masked else None
+        payload = self._read_exact(n)
+        if key:
+            payload = bytes(b ^ key[i % 4]
+                            for i, b in enumerate(payload))
+        return opcode, payload, fin
+
+    def recv_message(self) -> Optional[bytes]:
+        """Next complete data message (reassembling continuations), or
+        None once the peer closes."""
+        if self.closed:
+            return None
+        parts = []
+        total = 0
+        while True:
+            try:
+                opcode, payload, fin = self._recv_frame()
+            except WebSocketClosed:
+                self.closed = True
+                return None
+            if opcode == OP_CLOSE:
+                self.send_close()
+                return None
+            if opcode == OP_PING:
+                try:
+                    self._send_frame(OP_PONG, payload)
+                except WebSocketClosed:
+                    return None
+                continue
+            if opcode == OP_PONG:
+                continue
+            parts.append(payload)
+            total += len(payload)
+            if total > MAX_MESSAGE_BYTES:   # endless continuations
+                self.send_close(1009)
+                self.closed = True
+                return None
+            if fin:
+                return b"".join(parts)
+
+    def recv_json(self):
+        msg = self.recv_message()
+        return None if msg is None else json.loads(msg)
+
+    def close(self) -> None:
+        self.send_close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------- server
+def server_handshake(handler) -> WebSocketConn:
+    """Upgrade a BaseHTTPRequestHandler's connection; returns the
+    websocket (server side, unmasked sends)."""
+    key = handler.headers.get("Sec-WebSocket-Key", "")
+    if not key:
+        raise ValueError("missing Sec-WebSocket-Key")
+    resp = ("HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept_key(key)}\r\n\r\n")
+    handler.connection.sendall(resp.encode())
+    return WebSocketConn(handler.connection, mask=False)
+
+
+# ---------------------------------------------------------------- client
+def client_connect(url: str, token: str = "",
+                   timeout: float = 30.0) -> WebSocketConn:
+    """Dial an http(s)/ws(s) URL and perform the client handshake;
+    returns the websocket (client side, masked sends)."""
+    parts = urlsplit(url)
+    host = parts.hostname or "127.0.0.1"
+    tls = parts.scheme in ("https", "wss")
+    port = parts.port or (443 if tls else 80)
+    path = parts.path + (f"?{parts.query}" if parts.query else "")
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(timeout)
+    if tls:
+        import ssl
+        sock = ssl.create_default_context().wrap_socket(
+            sock, server_hostname=host)
+    key = base64.b64encode(os.urandom(16)).decode()
+    req = (f"GET {path} HTTP/1.1\r\n"
+           f"Host: {host}:{port}\r\n"
+           "Upgrade: websocket\r\n"
+           "Connection: Upgrade\r\n"
+           f"Sec-WebSocket-Key: {key}\r\n"
+           "Sec-WebSocket-Version: 13\r\n")
+    if token:
+        req += f"X-Nomad-Token: {token}\r\n"
+    req += "\r\n"
+    sock.sendall(req.encode())
+    # read response head
+    head = b""
+    while b"\r\n\r\n" not in head:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("websocket handshake: peer closed")
+        head += chunk
+        if len(head) > 65536:
+            raise ConnectionError("websocket handshake: oversized reply")
+    head_s, _, rest = head.partition(b"\r\n\r\n")
+    lines = head_s.decode("latin-1").split("\r\n")
+    status = lines[0].split(" ", 2)
+    if len(status) < 2 or status[1] != "101":
+        raise ConnectionError(f"websocket handshake refused: {lines[0]}")
+    hdrs = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    if hdrs.get("sec-websocket-accept") != accept_key(key):
+        raise ConnectionError("websocket handshake: bad accept key")
+    ws = WebSocketConn(sock, mask=True)
+    ws._buf = rest
+    return ws

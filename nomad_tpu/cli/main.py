@@ -283,6 +283,8 @@ def cmd_alloc_logs(args) -> int:
 
 def cmd_alloc_exec(args) -> int:
     api = _client(args)
+    if args.interactive or args.tty:
+        return _alloc_exec_interactive(api, args)
     body = {"cmd": args.cmd}
     if args.task:
         body["task"] = args.task
@@ -290,6 +292,35 @@ def cmd_alloc_exec(args) -> int:
         f"/v1/client/allocation/{args.alloc_id}/exec", body)
     sys.stdout.write(out["output"])
     return out["exit_code"]
+
+
+def _alloc_exec_interactive(api, args) -> int:
+    """`alloc exec -i -t` (reference: command/alloc_exec.go — raw
+    local terminal bridged over the agent websocket)."""
+    import os
+    import shutil
+
+    stdin_fd = sys.stdin.fileno() if args.interactive else None
+    # raw mode only when we are BOTH allocating a remote pty and
+    # streaming local stdin (-t alone is a valid output-only session)
+    use_tty = args.tty and stdin_fd is not None and sys.stdin.isatty()
+    size = shutil.get_terminal_size((80, 24))
+    raw_state = None
+    if use_tty:
+        import termios
+        import tty as _ttymod
+        raw_state = termios.tcgetattr(stdin_fd)
+        _ttymod.setraw(stdin_fd)
+    try:
+        return api.allocations.exec_stream(
+            args.alloc_id, args.cmd, task=args.task or "",
+            tty=args.tty, stdin_fd=stdin_fd,
+            stdout_fd=sys.stdout.fileno(),
+            tty_size=(size.columns, size.lines) if args.tty else None)
+    finally:
+        if raw_state is not None:
+            import termios
+            termios.tcsetattr(stdin_fd, termios.TCSADRAIN, raw_state)
 
 
 def cmd_job_scale(args) -> int:
@@ -445,6 +476,10 @@ def build_parser() -> argparse.ArgumentParser:
     ax = alloc.add_parser("exec")
     ax.add_argument("alloc_id")
     ax.add_argument("-task", default=None)
+    ax.add_argument("-i", dest="interactive", action="store_true",
+                    help="stream local stdin to the task")
+    ax.add_argument("-t", dest="tty", action="store_true",
+                    help="allocate a pseudo-terminal")
     # REMAINDER: everything after the alloc id (incl. dash flags like
     # `/bin/sh -c ...`) belongs to the command
     ax.add_argument("cmd", nargs=argparse.REMAINDER)

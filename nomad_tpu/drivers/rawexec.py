@@ -302,3 +302,52 @@ class RawExecDriver(DriverPlugin):
             return out.stdout, out.returncode
         except subprocess.TimeoutExpired as e:
             return (e.stdout or b"") + b"\n(timed out)", 124
+
+    def exec_task_streaming(self, task_id: str, cmd: List[str],
+                            tty: bool = True, width: int = 80,
+                            height: int = 24):
+        """Interactive exec in the task's dir/env (reference:
+        drivers/rawexec + drivers/shared/executor ExecStreaming,
+        executor/pty_unix.go).  tty=True runs the command on a fresh
+        pty (its own session + controlling terminal, so shells get job
+        control); tty=False uses a socketpair for clean EOF
+        semantics."""
+        import fcntl
+        import socket as _socket
+        import struct as _struct
+        import termios
+        from ..plugins.drivers import ExecStream
+
+        t = self._get(task_id)
+        cfg = t.handle.config
+        cwd = cfg.task_dir if cfg and cfg.task_dir else None
+        env = dict(os.environ)
+        if cfg:
+            env.update(cfg.env or {})
+        env.setdefault("TERM", "xterm")
+
+        if tty:
+            import pty
+            master, slave = pty.openpty()
+            fcntl.ioctl(slave, termios.TIOCSWINSZ,
+                        _struct.pack("HHHH", height, width, 0, 0))
+
+            def preexec():
+                os.setsid()
+                fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+
+            proc = subprocess.Popen(
+                cmd, cwd=cwd, env=env, stdin=slave, stdout=slave,
+                stderr=slave, preexec_fn=preexec, close_fds=True)
+            os.close(slave)
+            return ExecStream(fd=master, pid=proc.pid, tty=True,
+                              popen=proc)
+
+        parent, child = _socket.socketpair()
+        proc = subprocess.Popen(
+            cmd, cwd=cwd, env=env, stdin=child.fileno(),
+            stdout=child.fileno(), stderr=child.fileno(),
+            start_new_session=True, close_fds=True)
+        child.close()
+        return ExecStream(fd=parent.detach(), pid=proc.pid, tty=False,
+                          popen=proc)

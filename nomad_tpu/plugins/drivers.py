@@ -10,6 +10,7 @@ restarted agent can RecoverTask instead of re-running the workload.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time as _time
 from dataclasses import dataclass, field
@@ -147,6 +148,92 @@ class DriverPlugin(BasePlugin):
     def exec_task(self, task_id: str, cmd: List[str],
                   timeout_s: float = 30.0) -> Tuple[bytes, int]:
         raise DriverError(f"driver {self.name} does not support exec")
+
+    def exec_task_streaming(self, task_id: str, cmd: List[str],
+                            tty: bool = True, width: int = 80,
+                            height: int = 24) -> "ExecStream":
+        """Interactive exec in the task's context (reference:
+        plugins/drivers/execstreaming.go ExecTaskStreaming — the bidi
+        form behind `alloc exec -i -t`)."""
+        raise DriverError(
+            f"driver {self.name} does not support streaming exec")
+
+
+class ExecStream:
+    """A live interactive exec session handle.
+
+    `fd` is a bidirectional file descriptor (the pty master for
+    tty=True, a socketpair end otherwise): read it for the command's
+    output, write to it for stdin.  The bridge layer (HTTP websocket)
+    pumps it; the driver owns process lifetime.
+    """
+
+    def __init__(self, fd: int, pid: int, tty: bool, popen=None):
+        self.fd = fd
+        self.pid = pid
+        self.tty = tty
+        self._popen = popen       # reaps the child when provided
+        self._exit_code: Optional[int] = None
+
+    def resize(self, width: int, height: int) -> None:
+        if not self.tty:
+            return
+        import fcntl
+        import struct as _struct
+        import termios
+        try:
+            fcntl.ioctl(self.fd, termios.TIOCSWINSZ,
+                        _struct.pack("HHHH", height, width, 0, 0))
+        except OSError:
+            pass
+
+    def close_stdin(self) -> None:
+        """Half-close for pipe mode; a no-op for ttys (EOF is ^D)."""
+        if self.tty:
+            return
+        import socket as _socket
+        try:
+            _socket.socket(fileno=os.dup(self.fd)).shutdown(
+                _socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def poll(self) -> Optional[int]:
+        """Exit code if the process has finished, else None."""
+        if self._exit_code is not None:
+            return self._exit_code
+        if self._popen is not None:
+            rc = self._popen.poll()
+            if rc is None:
+                return None
+            self._exit_code = 128 - rc if rc < 0 else rc
+            return self._exit_code
+        try:
+            pid, status = os.waitpid(self.pid, os.WNOHANG)
+        except ChildProcessError:
+            self._exit_code = -1
+            return self._exit_code
+        if pid == 0:
+            return None
+        if os.WIFEXITED(status):
+            self._exit_code = os.WEXITSTATUS(status)
+        elif os.WIFSIGNALED(status):
+            self._exit_code = 128 + os.WTERMSIG(status)
+        else:
+            self._exit_code = -1
+        return self._exit_code
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, 15)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
 
 
 class DriverRegistry:

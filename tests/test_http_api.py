@@ -278,6 +278,14 @@ def test_ui_served():
                 assert "text/html" in r.headers["Content-Type"]
                 page = r.read().decode()
             assert "nomad-tpu" in page and "/v1/jobs" in page
+            # drill-down routes (reference: ui/app/router.js jobs/
+            # clients/allocations routes)
+            assert "viewJob" in page and "viewNode" in page \
+                and "viewAlloc" in page
+            assert "/v1/client/fs/logs/" in page
+            # alloc LIST endpoints serve CamelCase stubs; the UI must
+            # read that shape, not the snake_case detail shape
+            assert "a.ClientStatus" in page
     finally:
         http.stop()
         srv.stop()
