@@ -817,6 +817,21 @@ class HTTPAgentServer:
         return 200, {"output": out.decode("utf-8", errors="replace"),
                      "exit_code": code}, None
 
+    def client_csi_plugin_register(self, q, body, name):
+        """Register an external CSI plugin endpoint with this agent's
+        node (reference: dynamic plugin registration; the reference
+        does this via plugin-supervisor task hooks, here it is also a
+        first-class agent API)."""
+        if self.client is None:
+            raise HTTPError(400, "no client agent on this node")
+        if not body or "addr" not in body:
+            raise HTTPError(400, "body must carry 'addr' [host, port]")
+        try:
+            self.client.register_csi_plugin(name, tuple(body["addr"]))
+        except Exception as e:
+            raise HTTPError(502, f"plugin registration failed: {e}")
+        return 200, {"registered": name}, None
+
     def job_scale(self, q, body, job_id):
         """Adjust a task group's count (reference: Job.Scale,
         nomad/job_endpoint.go ScaleStatus/Scale — registers the updated
@@ -1047,6 +1062,9 @@ def _build_routes(s: HTTPAgentServer):
         (R(r"^/v1/client/fs/logs/([^/]+)$"), {"GET": s.client_logs}),
         (R(r"^/v1/client/allocation/([^/]+)/exec$"),
          {"POST": s.client_exec, "PUT": s.client_exec}),
+        (R(r"^/v1/client/csi/plugin/([^/]+)$"),
+         {"POST": s.client_csi_plugin_register,
+          "PUT": s.client_csi_plugin_register}),
         (R(r"^/v1/job/([^/]+)/scale$"), {"POST": s.job_scale,
                                          "PUT": s.job_scale}),
         (R(r"^/v1/services$"), {"GET": s.services_list}),

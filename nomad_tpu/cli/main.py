@@ -350,6 +350,56 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_volume_status(args) -> int:
+    api = _client(args)
+    if args.vol_id:
+        v, _ = api.get(f"/v1/volume/csi/{args.vol_id}")
+        for k in ("id", "name", "plugin_id", "access_mode",
+                  "attachment_mode", "schedulable"):
+            print(f"{k:<18}= {v.get(k, '')}")
+        print(f"{'write_claims':<18}= {len(v.get('write_claims') or {})}")
+        print(f"{'read_claims':<18}= {len(v.get('read_claims') or {})}")
+        return 0
+    vols, _ = api.get("/v1/volumes")
+    print(f"{'ID':<20} {'Plugin':<12} {'Mode':<22} Claims")
+    for v in vols:
+        claims = (len(v.get("write_claims") or {})
+                  + len(v.get("read_claims") or {}))
+        print(f"{v['id']:<20} {v.get('plugin_id', ''):<12} "
+              f"{v.get('access_mode', ''):<22} {claims}")
+    return 0
+
+
+def cmd_volume_register(args) -> int:
+    import json as _json
+    api = _client(args)
+    with open(args.file) as f:
+        spec = _json.load(f)
+    vol_id = spec.get("id") or ""
+    if not vol_id:
+        print("volume spec must carry 'id'", file=sys.stderr)
+        return 1
+    api.request("PUT", f"/v1/volume/csi/{vol_id}", body={"volume": spec})
+    print(f"==> Volume '{vol_id}' registered")
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    api = _client(args)
+    api.delete(f"/v1/volume/csi/{args.vol_id}")
+    print(f"==> Volume '{args.vol_id}' deregistered")
+    return 0
+
+
+def cmd_volume_plugin_register(args) -> int:
+    api = _client(args)
+    host, _, port = args.addr.rpartition(":")
+    api.request("PUT", f"/v1/client/csi/plugin/{args.name}",
+                body={"addr": [host or "127.0.0.1", int(port)]})
+    print(f"==> CSI plugin '{args.name}' registered at {args.addr}")
+    return 0
+
+
 def cmd_deployment(args) -> int:
     api = _client(args)
     if args.dep_cmd == "list":
@@ -496,6 +546,25 @@ def build_parser() -> argparse.ArgumentParser:
     es = ev.add_parser("status")
     es.add_argument("eval_id")
     es.set_defaults(fn=cmd_eval_status)
+
+    vol = sub.add_parser("volume", help="volume commands").add_subparsers(
+        dest="volume_cmd", required=True)
+    vs = vol.add_parser("status")
+    vs.add_argument("vol_id", nargs="?", default=None)
+    vs.set_defaults(fn=cmd_volume_status)
+    vr = vol.add_parser("register")
+    vr.add_argument("file", help="JSON volume spec "
+                                 "(id, plugin_id, access_mode, ...)")
+    vr.set_defaults(fn=cmd_volume_register)
+    vd = vol.add_parser("deregister")
+    vd.add_argument("vol_id")
+    vd.set_defaults(fn=cmd_volume_deregister)
+    vp = vol.add_parser("plugin-register",
+                        help="register a CSI plugin endpoint with the "
+                             "local agent")
+    vp.add_argument("name")
+    vp.add_argument("addr", help="host:port of the plugin's RPC listener")
+    vp.set_defaults(fn=cmd_volume_plugin_register)
 
     dep = sub.add_parser("deployment", help="deployment commands")
     dep.add_argument("dep_cmd",

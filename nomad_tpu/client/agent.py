@@ -56,6 +56,12 @@ class ServerEndpoints:
         start (the Vault-token fetch analog)."""
         raise NotImplementedError
 
+    def get_csi_volume(self, namespace: str, vol_id: str):
+        """Resolve a registered CSI volume's details (None if missing)
+        — consulted before staging (reference:
+        client/pluginmanager/csimanager/volume.go)."""
+        raise NotImplementedError
+
 
 class InProcServer(ServerEndpoints):
     """Direct adapter over nomad_tpu.server.server.Server."""
@@ -78,6 +84,9 @@ class InProcServer(ServerEndpoints):
     def get_secret(self, namespace: str, path: str):
         return self.server.store.secret_by_path(namespace, path)
 
+    def get_csi_volume(self, namespace: str, vol_id: str):
+        return self.server.store.csi_volume_by_id(namespace, vol_id)
+
 
 class Client:
     def __init__(self, servers: ServerEndpoints, data_dir: str,
@@ -99,6 +108,8 @@ class Client:
         self.state_db = state_db if state_db is not None else (
             MemDB() if dev_mode
             else StateDB(os.path.join(data_dir, "client", "state.db")))
+        from .csimanager import CSIManager
+        self.csi_manager = CSIManager(data_dir)
         self.node = node or self._fingerprint_with_identity(datacenter, meta)
         if self.node.status != NODE_STATUS_READY:
             self.node.status = NODE_STATUS_READY
@@ -242,11 +253,26 @@ class Client:
             runner.run()
         self._gc_terminal_runners()
 
+    def register_csi_plugin(self, name: str, addr) -> None:
+        """Register an external CSI plugin endpoint and advertise it in
+        the node fingerprint (reference: dynamic plugin registration +
+        Node.CSINodePlugins)."""
+        from ..structs import CSIPluginNodeInfo
+        self.csi_manager.register_plugin(name, addr)
+        self.node.csi_node_plugins[name] = CSIPluginNodeInfo(
+            plugin_id=name, healthy=True)
+        self.node.compute_class()
+        # if already running, push the updated fingerprint
+        if self._threads:
+            self.servers.register_node(self.node)
+
     def _new_runner(self, alloc: Allocation) -> AllocRunner:
         return AllocRunner(alloc, self.data_dir, self.registry, self.node,
                            self._queue_update, state_db=self.state_db,
                            device_registry=self.device_registry,
-                           secrets_fetcher=self.servers.get_secret)
+                           secrets_fetcher=self.servers.get_secret,
+                           csi_manager=self.csi_manager,
+                           csi_resolver=self.servers.get_csi_volume)
 
     def _fail_alloc(self, alloc: Allocation, reason: str) -> None:
         import copy

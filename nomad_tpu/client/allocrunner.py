@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
 import threading
 import time as _time
 from typing import Callable, Dict, List, Optional
@@ -49,11 +50,16 @@ class AllocRunner:
                  node: Optional[Node],
                  on_alloc_update: Callable[[Allocation], None],
                  state_db=None, device_registry=None,
-                 secrets_fetcher=None):
+                 secrets_fetcher=None, csi_manager=None,
+                 csi_resolver=None):
         self.alloc = alloc
         self.registry = registry
         self.device_registry = device_registry
         self.secrets_fetcher = secrets_fetcher
+        self.csi_manager = csi_manager
+        self.csi_resolver = csi_resolver
+        self._csi_mounts: List[tuple] = []   # (plugin, vol_id)
+        self._vol_binds: List[str] = []      # task-dir bind mounts
         self.node = node
         self.on_alloc_update = on_alloc_update
         self.state_db = state_db
@@ -86,8 +92,94 @@ class AllocRunner:
                 secrets_fetcher=self.secrets_fetcher))
 
     # ---------------------------------------------------------- lifecycle
+    def _mount_csi_volumes(self) -> None:
+        """Prerun CSI hook (reference: alloc_runner_hooks.go csi_hook —
+        stage/publish each task group CSI volume, then surface the
+        published path inside every task dir at its volume_mounts
+        destination).  A mount failure fails the whole alloc before any
+        task starts, like the reference's prerun hook failure path."""
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None or not getattr(tg, "volumes", None):
+            return
+        csi_reqs = {name: vr for name, vr in tg.volumes.items()
+                    if vr.type == "csi"}
+        if not csi_reqs:
+            return
+        if self.csi_manager is None or self.csi_resolver is None:
+            raise RuntimeError("alloc requests CSI volumes but this "
+                               "client has no CSI manager")
+        targets: Dict[str, str] = {}
+        for name, vr in csi_reqs.items():
+            vol = self.csi_resolver(self.alloc.namespace, vr.source)
+            if vol is None:
+                raise RuntimeError(f"unknown CSI volume {vr.source!r}")
+            target = self.csi_manager.mount(
+                vol.plugin_id, vol.id, self.alloc.id,
+                read_only=vr.read_only)
+            self._csi_mounts.append((vol.plugin_id, vol.id))
+            targets[name] = target
+        for tr in self.task_runners:
+            # destinations resolve under the task's working dir
+            # (NOMAD_TASK_DIR = <task>/local — taskenv.py layout).
+            # Bind mount when the host permits: a bind survives the
+            # exec driver's chroot (the jail rbinds the task dir),
+            # where a symlink to the client data dir would dangle.
+            local = os.path.join(self.alloc_dir.task_dir(tr.task.name),
+                                 "local")
+            for vm in getattr(tr.task, "volume_mounts", []) or []:
+                if vm.volume not in targets:
+                    continue
+                dest = os.path.join(local, vm.destination.lstrip("/"))
+                if os.path.lexists(dest):
+                    continue
+                os.makedirs(dest, exist_ok=True)
+                if self._try_bind(targets[vm.volume], dest,
+                                  vm.read_only):
+                    self._vol_binds.append(dest)
+                else:
+                    os.rmdir(dest)
+                    os.symlink(targets[vm.volume], dest)
+
+    @staticmethod
+    def _try_bind(src: str, dst: str, read_only: bool) -> bool:
+        try:
+            from ..drivers.isolation import (MS_BIND, MS_RDONLY,
+                                             MS_REMOUNT, _mount)
+            _mount(src, dst, None, MS_BIND)
+            if read_only:
+                _mount(None, dst, None,
+                       MS_REMOUNT | MS_BIND | MS_RDONLY)
+            return True
+        except OSError:
+            return False
+
+    def _unmount_csi_volumes(self) -> None:
+        for dest in self._vol_binds:
+            try:
+                from ..plugins.csi import _try_unmount
+                _try_unmount(dest)
+                os.rmdir(dest)
+            except OSError:
+                pass
+        self._vol_binds = []
+        for plugin, vol_id in self._csi_mounts:
+            try:
+                self.csi_manager.unmount(plugin, vol_id, self.alloc.id)
+            except Exception:
+                pass
+        self._csi_mounts = []
+
     def run(self) -> None:
         self.alloc_dir.build()
+        try:
+            self._mount_csi_volumes()
+        except Exception as e:
+            for tr in self.task_runners:
+                tr.mark_failed(f"csi volume setup failed: {e}")
+            self._done.set()
+            self._report()
+            return
         for tr in self.task_runners:
             if not tr.is_dead():
                 tr.start()
@@ -110,6 +202,9 @@ class AllocRunner:
         for tr in self.task_runners:
             tr.wait()
         self._health_stop.set()
+        # postrun: release the volume mounts once every task is done
+        # (reference: csi_hook Postrun -> NodeUnpublish/NodeUnstage)
+        self._unmount_csi_volumes()
         self._done.set()
         self._report()
 
@@ -220,6 +315,7 @@ class AllocRunner:
         """Full teardown incl. the alloc dir (client GC path)."""
         self.kill("alloc garbage collected")
         self._destroyed = True
+        self._unmount_csi_volumes()
         self.alloc_dir.destroy()
         if self.state_db is not None:
             self.state_db.delete_allocation(self.alloc.id)

@@ -1,0 +1,99 @@
+"""Client-side CSI volume mount lifecycle.
+
+Reference: client/pluginmanager/csimanager/ — the reference's manager
+owns per-plugin gRPC clients and drives NodeStageVolume /
+NodePublishVolume around alloc setup (volume.go MountVolume /
+UnmountVolume), refcounting the staging mount across allocs.  Same
+shape here over the framed-RPC CSI protocol (plugins/csi.py):
+
+  mount(plugin, vol, alloc)    -> stage once per (plugin, vol), then
+                                  publish a per-alloc target path
+  unmount(plugin, vol, alloc)  -> unpublish; unstage on last ref
+
+Paths follow the reference's layout under the client data dir:
+<data_dir>/csi/staging/<plugin>/<vol> and
+<data_dir>/csi/per-alloc/<alloc>/<vol>.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..plugins.csi import CSIError, CSIPluginClient
+
+
+class CSIManager:
+    def __init__(self, data_dir: str):
+        self.base = os.path.join(data_dir, "csi")
+        self._plugins: Dict[str, CSIPluginClient] = {}
+        self._stage_refs: Dict[Tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- plugins
+    def register_plugin(self, name: str, addr) -> CSIPluginClient:
+        """Register an external plugin endpoint (reference: dynamic
+        plugin registry fed by plugin-supervisor task hooks)."""
+        client = CSIPluginClient(tuple(addr))
+        if not client.probe():
+            raise CSIError(f"plugin {name!r} failed probe")
+        with self._lock:
+            self._plugins[name] = client
+        return client
+
+    def plugin(self, name: str) -> Optional[CSIPluginClient]:
+        with self._lock:
+            return self._plugins.get(name)
+
+    def plugin_names(self):
+        with self._lock:
+            return sorted(self._plugins)
+
+    # -------------------------------------------------------- mounts
+    def _staging_path(self, plugin: str, vol: str) -> str:
+        return os.path.join(self.base, "staging", plugin,
+                            vol.replace("/", "_"))
+
+    def _target_path(self, alloc_id: str, vol: str) -> str:
+        return os.path.join(self.base, "per-alloc", alloc_id,
+                            vol.replace("/", "_"))
+
+    def mount(self, plugin_name: str, volume_id: str, alloc_id: str,
+              read_only: bool = False) -> str:
+        client = self.plugin(plugin_name)
+        if client is None:
+            raise CSIError(f"no CSI plugin {plugin_name!r} registered")
+        staging = self._staging_path(plugin_name, volume_id)
+        target = self._target_path(alloc_id, volume_id)
+        with self._lock:
+            key = (plugin_name, volume_id)
+            refs = self._stage_refs.get(key, 0)
+        if refs == 0:
+            client.node_stage(volume_id, staging)
+        client.node_publish(volume_id, staging, target,
+                            read_only=read_only)
+        with self._lock:
+            self._stage_refs[key] = refs + 1
+        return target
+
+    def unmount(self, plugin_name: str, volume_id: str,
+                alloc_id: str) -> None:
+        client = self.plugin(plugin_name)
+        if client is None:
+            return
+        target = self._target_path(alloc_id, volume_id)
+        try:
+            client.node_unpublish(volume_id, target)
+        except CSIError:
+            pass
+        with self._lock:
+            key = (plugin_name, volume_id)
+            refs = max(0, self._stage_refs.get(key, 1) - 1)
+            self._stage_refs[key] = refs
+        if refs == 0:
+            try:
+                client.node_unstage(volume_id,
+                                    self._staging_path(plugin_name,
+                                                       volume_id))
+            except CSIError:
+                pass

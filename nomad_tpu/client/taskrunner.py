@@ -222,6 +222,12 @@ class TaskRunner:
                 self.alloc.id, self.task.name, handle, state)
 
     # --------------------------------------------------------------- run
+    def mark_failed(self, reason: str) -> None:
+        """Fail the task without running it (alloc-level prerun hook
+        failures — reference: alloc_runner.go prerun error path)."""
+        self._emit(EVENT_DRIVER_FAILURE, message=reason, failed=True)
+        self._set_state(TASK_STATE_DEAD, failed=True)
+
     def start(self) -> None:
         self._thread = threading.Thread(
             target=self.run, daemon=True,

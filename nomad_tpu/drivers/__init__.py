@@ -6,11 +6,13 @@ plugin boundary (nomad_tpu/plugins/drivers.py). Builtins:
 
 - rawexec: real subprocesses under a detached per-task executor
   (reference: drivers/rawexec + drivers/shared/executor)
-- exec: rawexec semantics plus best-effort isolation knobs
-  (reference: drivers/exec; chroot/libcontainer isolation is replaced
-  by setsid + rlimits — containers are out of scope for this build)
+- exec: rawexec supervision plus a real jail — mount+pid namespaces,
+  read-only allowlist chroot, cgroup cpu/memory limits (reference:
+  drivers/exec + executor_linux.go libcontainer isolation, rebuilt on
+  raw syscalls in drivers/isolation.py)
 - mock: scriptable lifecycle for tests (reference: drivers/mock)
 """
+from .exec import ExecDriver
 from .mock import MockDriver
 from .rawexec import RawExecDriver
 
@@ -18,7 +20,9 @@ from .rawexec import RawExecDriver
 def register_builtins(registry) -> None:
     """reference: helper/pluginutils/catalog/register.go:15-19."""
     registry.register(RawExecDriver())
+    registry.register(ExecDriver())
     registry.register(MockDriver())
 
 
-__all__ = ["RawExecDriver", "MockDriver", "register_builtins"]
+__all__ = ["RawExecDriver", "ExecDriver", "MockDriver",
+           "register_builtins"]

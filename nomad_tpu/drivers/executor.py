@@ -9,7 +9,12 @@ file (start_ticks defeats pid reuse on re-attach), waits, and writes the
 exit result file that a (possibly different) agent process polls.
 
 Spec file (JSON): argv, env, cwd, stdout_path, stderr_path,
-state_file, exit_file.
+state_file, exit_file; optionally `isolation` (exec driver —
+reference: drivers/shared/executor/executor_linux.go): {rootfs,
+task_dir, alloc_dir, secrets_dir, extra_paths, cpu_shares, memory_mb,
+cgroup_name} — the executor enters fresh mount+pid namespaces, builds
+the chroot from bind mounts, applies cgroup limits, and the forked
+task finishes the jail (own /proc, chroot) before exec.
 """
 from __future__ import annotations
 
@@ -61,20 +66,46 @@ def main(spec_path: str) -> int:
 
     stdout = open(spec["stdout_path"], "ab", buffering=0)
     stderr = open(spec["stderr_path"], "ab", buffering=0)
+    iso = spec.get("isolation")
+    cg_dirs = []
+    preexec = None
+    cwd = spec.get("cwd") or None
     try:
+        if iso:
+            from . import isolation
+            isolation.enter_namespaces()
+            isolation.build_chroot_binds(
+                iso["rootfs"], iso.get("task_dir", ""),
+                iso.get("alloc_dir", ""), iso.get("secrets_dir", ""),
+                iso.get("extra_paths"))
+            cg_dirs = isolation.cgroup_create(
+                iso.get("cgroup_name") or f"task-{os.getpid()}",
+                cpu_shares=int(iso.get("cpu_shares") or 0),
+                memory_mb=int(iso.get("memory_mb") or 0))
+            rootfs = iso["rootfs"]
+            cwd = None                # chroot sets its own cwd
+
+            def preexec():
+                isolation.child_preexec_steps(rootfs)
+
         child = subprocess.Popen(
             spec["argv"],
             env=spec.get("env") or None,
-            cwd=spec.get("cwd") or None,
+            cwd=cwd,
             stdout=stdout, stderr=stderr,
             stdin=subprocess.DEVNULL,
             start_new_session=True,   # own pgid: killpg targets the task tree
+            preexec_fn=preexec,
         )
-    except OSError as e:
+    except (OSError, KeyError) as e:
         _atomic_write_json(spec["exit_file"], {
             "exit_code": 127, "signal": 0, "err": str(e),
             "finished_at": time.time()})
         return 1
+
+    if cg_dirs:
+        from . import isolation
+        isolation.cgroup_add_pid(cg_dirs, child.pid)
 
     _atomic_write_json(spec["state_file"], {
         "executor_pid": os.getpid(),
@@ -95,6 +126,9 @@ def main(spec_path: str) -> int:
               "err": "",
               "finished_at": time.time()}
     _atomic_write_json(spec["exit_file"], result)
+    if cg_dirs:
+        from . import isolation
+        isolation.cgroup_remove(cg_dirs)
     return 0
 
 

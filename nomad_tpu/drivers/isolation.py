@@ -1,0 +1,204 @@
+"""Linux isolation primitives for the exec driver.
+
+Reference: drivers/shared/executor/executor_linux.go — the reference
+jails exec-driver tasks with libcontainer (runc): mount+pid namespaces,
+a chroot built from an allowlist of system paths, cgroup resource
+limits.  This is the same sandbox built directly on the syscalls
+(no container runtime dependency): `enter_namespaces` +
+`build_chroot_binds` run in the detached executor process, and
+`child_preexec_steps` finish the jail (fresh /proc, chroot) in the
+forked task between fork and exec.
+
+Degrades explicitly: `probe()` reports which pieces this kernel/user
+can do; the driver refuses to start (rather than silently weakening
+the sandbox) unless the caller opts into best-effort.
+"""
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+from typing import Dict, List, Optional
+
+_libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                    use_errno=True)
+
+MS_RDONLY = 0x1
+MS_NOSUID = 0x2
+MS_NODEV = 0x4
+MS_REMOUNT = 0x20
+MS_BIND = 0x1000
+MS_REC = 0x4000
+MS_PRIVATE = 0x40000
+
+#: reference: drivers/exec chroot_env default allowlist
+#: (website docs chroot_env; executor_linux chroot build)
+DEFAULT_CHROOT_PATHS = ["/bin", "/etc", "/lib", "/lib64", "/sbin",
+                        "/usr", "/dev", "/run/resolvconf",
+                        "/run/systemd/resolve"]
+
+
+class IsolationError(OSError):
+    pass
+
+
+def _mount(src: Optional[str], target: str, fstype: Optional[str],
+           flags: int, data: Optional[str] = None) -> None:
+    rc = _libc.mount(src.encode() if src else None, target.encode(),
+                     fstype.encode() if fstype else None, flags,
+                     data.encode() if data else None)
+    if rc != 0:
+        e = ctypes.get_errno()
+        raise IsolationError(
+            e, f"mount({src!r}, {target!r}, {fstype!r}, {flags:#x}): "
+               f"{os.strerror(e)}")
+
+
+_PROBE_SCRIPT = """
+import os, sys
+code = 0
+try:
+    os.unshare(os.CLONE_NEWNS | os.CLONE_NEWPID)
+    code |= 1
+except OSError:
+    try:
+        os.unshare(os.CLONE_NEWUSER | os.CLONE_NEWNS | os.CLONE_NEWPID)
+        code |= 1 | 2
+    except OSError:
+        pass
+sys.exit(code)
+"""
+_probe_cache: Optional[Dict[str, bool]] = None
+
+
+def probe() -> Dict[str, bool]:
+    """What this kernel/uid supports.  Checked once per process in a
+    throwaway subprocess (fork+exec — a bare fork from a threaded
+    process is a deadlock hazard)."""
+    global _probe_cache
+    if _probe_cache is not None:
+        return dict(_probe_cache)
+    import subprocess
+    import sys
+    try:
+        code = subprocess.run(
+            [sys.executable, "-c", _PROBE_SCRIPT],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=15).returncode
+    except (OSError, subprocess.TimeoutExpired):
+        code = 0
+    _probe_cache = {
+        "namespaces": bool(code & 1),
+        "userns": bool(code & 2),
+        "cgroups": os.access("/sys/fs/cgroup/cpu", os.W_OK),
+    }
+    return dict(_probe_cache)
+
+
+def enter_namespaces() -> None:
+    """Called in the EXECUTOR before forking the task: new mount + pid
+    namespaces (the next fork lands as pid 1), root-mapped user ns
+    first when not privileged."""
+    if os.getuid() != 0:
+        os.unshare(os.CLONE_NEWUSER)
+        # self-mapping is allowed for a single entry + setgroups deny
+        with open("/proc/self/setgroups", "w") as f:
+            f.write("deny")
+        with open("/proc/self/uid_map", "w") as f:
+            f.write(f"0 {os.getuid()} 1")
+        with open("/proc/self/gid_map", "w") as f:
+            f.write(f"0 {os.getgid()} 1")
+    os.unshare(os.CLONE_NEWNS | os.CLONE_NEWPID)
+    # stop mount events from leaking back to the host namespace
+    _mount(None, "/", None, MS_REC | MS_PRIVATE)
+
+
+def build_chroot_binds(rootfs: str, task_dir: str, alloc_dir: str,
+                       secrets_dir: str = "",
+                       extra_paths: Optional[List[str]] = None) -> None:
+    """Assemble the task's root: allowlisted system paths bound
+    read-only, task/alloc/secrets dirs bound writable at the
+    reference's in-chroot locations (/local, /alloc, /secrets —
+    client/allocdir layout), an empty /proc mountpoint for the child,
+    /tmp as a fresh tmpfs."""
+    os.makedirs(rootfs, exist_ok=True)
+    paths = list(DEFAULT_CHROOT_PATHS) + list(extra_paths or [])
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        tgt = rootfs + p
+        os.makedirs(tgt, exist_ok=True)
+        _mount(p, tgt, None, MS_BIND | MS_REC)
+        if p != "/dev":
+            # remount the bind read-only (two-step per mount(2))
+            _mount(None, tgt, None,
+                   MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
+    rw = [("/local", task_dir), ("/alloc", alloc_dir)]
+    if secrets_dir:
+        rw.append(("/secrets", secrets_dir))
+    for inpath, host in rw:
+        if not host:
+            continue
+        tgt = rootfs + inpath
+        os.makedirs(tgt, exist_ok=True)
+        # recursive: nested mounts under the task dir (CSI volume
+        # targets bound in by the alloc runner) must follow into the
+        # jail
+        _mount(host, tgt, None, MS_BIND | MS_REC)
+    os.makedirs(rootfs + "/proc", exist_ok=True)
+    os.makedirs(rootfs + "/tmp", exist_ok=True)
+    _mount("tmpfs", rootfs + "/tmp", "tmpfs", MS_NOSUID | MS_NODEV,
+           "size=64m")
+
+
+def child_preexec_steps(rootfs: str) -> None:
+    """Called in the forked TASK between fork and exec: it is pid 1 of
+    the new pid namespace here, so mount its own /proc, then jail."""
+    _mount("proc", rootfs + "/proc", "proc", MS_NOSUID | MS_NODEV)
+    os.chroot(rootfs)
+    os.chdir("/local")
+
+
+# ------------------------------------------------------------- cgroups
+_CG_ROOT = "/sys/fs/cgroup"
+
+
+def cgroup_create(name: str, cpu_shares: int = 0,
+                  memory_mb: int = 0) -> List[str]:
+    """Best-effort cgroup v1 limits (reference: libcontainer cgroup
+    manager driven by Resources.LinuxResources).  Returns the created
+    dirs (for cleanup)."""
+    created = []
+    subs = []
+    if cpu_shares and os.path.isdir(f"{_CG_ROOT}/cpu"):
+        subs.append(("cpu", "cpu.shares", str(max(2, cpu_shares))))
+    if memory_mb and os.path.isdir(f"{_CG_ROOT}/memory"):
+        subs.append(("memory", "memory.limit_in_bytes",
+                     str(memory_mb * 1024 * 1024)))
+    for sub, knob, value in subs:
+        d = f"{_CG_ROOT}/{sub}/nomad_tpu/{name}"
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, knob), "w") as f:
+                f.write(value)
+            created.append(d)
+        except OSError:
+            continue
+    return created
+
+
+def cgroup_add_pid(dirs: List[str], pid: int) -> None:
+    for d in dirs:
+        try:
+            with open(os.path.join(d, "tasks"), "w") as f:
+                f.write(str(pid))
+        except OSError:
+            pass
+
+
+def cgroup_remove(dirs: List[str]) -> None:
+    for d in dirs:
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass

@@ -83,6 +83,17 @@ class RawExecDriver(DriverPlugin):
             raise DriverError("raw_exec: 'args' must be a list")
         return command, [str(a) for a in args]
 
+    def _task_env(self, cfg: TaskConfig) -> Dict[str, str]:
+        """Hook: the env the workload sees (exec rewrites NOMAD_* paths
+        to their in-chroot locations)."""
+        return dict(cfg.env)
+
+    def _isolation_spec(self, cfg: TaskConfig):
+        """Hook: executor isolation block; None = no sandbox
+        (raw_exec's contract — reference: drivers/rawexec has no
+        isolation)."""
+        return None
+
     def _paths(self, cfg: TaskConfig) -> Dict[str, str]:
         base = os.path.join(cfg.task_dir, ".executor")
         os.makedirs(base, exist_ok=True)
@@ -104,13 +115,16 @@ class RawExecDriver(DriverPlugin):
                 os.unlink(stale)
         spec = {
             "argv": [command] + args,
-            "env": dict(cfg.env),
+            "env": self._task_env(cfg),
             "cwd": cfg.task_dir,
             "stdout_path": cfg.stdout_path,
             "stderr_path": cfg.stderr_path,
             "state_file": paths["state"],
             "exit_file": paths["exit"],
         }
+        iso = self._isolation_spec(cfg)
+        if iso:
+            spec["isolation"] = iso
         with open(paths["spec"], "w") as f:
             json.dump(spec, f)
         with open(paths["log"], "ab") as elog:
@@ -120,8 +134,11 @@ class RawExecDriver(DriverPlugin):
                 stdout=elog, stderr=elog, stdin=subprocess.DEVNULL,
                 start_new_session=True,      # survives this agent's death
                 cwd="/",
+                # absolutize: the executor runs with cwd=/ — relative
+                # sys.path entries (script dirs, '') would dangle
                 env={"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
-                     "PYTHONPATH": os.pathsep.join(sys.path)},
+                     "PYTHONPATH": os.pathsep.join(
+                         os.path.abspath(p) for p in sys.path)},
             )
         state = self._await_state(paths, popen)
         handle = TaskHandle(

@@ -202,12 +202,24 @@ class Server:
             self.upsert_evals(cancelled)
 
     def _restore_evals(self) -> None:
-        """Re-enqueue non-terminal evals from state (leader.go:245)."""
+        """Re-enqueue non-terminal evals from state (leader.go:245).
+
+        Blocked evals are RE-ENQUEUED rather than re-blocked: the
+        missed-unblock protection (blocked_evals.py) keys off an
+        in-memory map of capacity-change indexes that an incoming
+        leader doesn't have, so a blocked eval whose capacity arrived
+        before the leadership change would otherwise wait forever.  One
+        fresh scheduling pass either places it or re-blocks it against
+        live capacity state."""
+        import copy
+        from ..structs import EVAL_STATUS_PENDING
         for ev in list(self.store.evals()):
             if ev.should_enqueue():
                 self.broker.enqueue(ev)
             elif ev.should_block():
-                self.blocked_evals.block(ev)
+                redo = copy.copy(ev)
+                redo.status = EVAL_STATUS_PENDING
+                self.broker.enqueue(redo)
 
     def _schedule_periodic_gc(self) -> None:
         """Leader timer enqueueing core GC evals (leader.go:513

@@ -137,7 +137,7 @@ def test_mock_driver_recover_always_lost():
 
 def test_registry_fingerprints():
     reg = default_registry()
-    assert set(reg.names()) == {"mock_driver", "raw_exec"}
+    assert set(reg.names()) == {"mock_driver", "raw_exec", "exec"}
     fps = reg.fingerprints()
     assert fps["raw_exec"].attributes["driver.raw_exec"] == "1"
 

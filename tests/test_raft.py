@@ -105,6 +105,35 @@ def test_single_server_restart_restores_state(tmp_path):
     s2.stop()
 
 
+def test_restored_blocked_eval_reschedules_when_capacity_preexists():
+    """Regression: an incoming leader restores a BLOCKED eval whose
+    capacity arrived before the leadership change.  The blocked-evals
+    missed-unblock map is in-memory and empty on a fresh leader, so
+    re-blocking would strand the eval forever; restore must give it a
+    fresh scheduling pass instead."""
+    from nomad_tpu.structs import EVAL_STATUS_BLOCKED, Evaluation
+    s = Server(num_workers=1)
+    # pre-leadership state: job + ready node + an eval that blocked
+    # against an older snapshot (as a previous leader would have left)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    node = mock.node()
+    s.store.upsert_job(10, job)
+    ev = Evaluation(id="stranded", namespace=job.namespace,
+                    job_id=job.id, priority=50, type=job.type,
+                    triggered_by="job-register",
+                    status=EVAL_STATUS_BLOCKED, snapshot_index=10)
+    s.store.upsert_evals(11, [ev])
+    s.store.upsert_node(12, node)
+    s.start()
+    try:
+        assert wait_until(lambda: bool(
+            s.store.allocs_by_job(job.namespace, job.id)), timeout=30), \
+            "restored blocked eval must get a fresh scheduling pass"
+    finally:
+        s.stop()
+
+
 # ------------------------------------------------------- 3-node cluster
 def _cluster(tmp_path, n=3, data=False):
     transport = InProcTransport()
