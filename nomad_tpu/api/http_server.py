@@ -705,8 +705,8 @@ class HTTPAgentServer:
                 raise HTTPError(400, "query param 'command' must be a "
                                      "non-empty JSON array")
             tty = q.get("tty", "true") != "false"
-            stream = tr.driver.exec_task_streaming(
-                tr.task_id, [str(c) for c in cmd], tty=tty)
+            if not handler.headers.get("Sec-WebSocket-Key"):
+                raise HTTPError(400, "missing Sec-WebSocket-Key")
         except HTTPError as e:
             refuse(e.code, e.msg)
             return
@@ -714,7 +714,21 @@ class HTTPAgentServer:
             refuse(500, str(e))
             return
 
-        ws = server_handshake(handler)
+        # spawn only after the request is fully validated; if the
+        # upgrade still fails mid-handshake, reap the process instead
+        # of leaking it
+        try:
+            stream = tr.driver.exec_task_streaming(
+                tr.task_id, [str(c) for c in cmd], tty=tty)
+        except Exception as e:
+            refuse(500, str(e))
+            return
+        try:
+            ws = server_handshake(handler)
+        except Exception:
+            stream.terminate()
+            stream.close()
+            raise
         stop = threading.Event()
 
         def pump_output():

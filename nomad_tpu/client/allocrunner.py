@@ -175,6 +175,10 @@ class AllocRunner:
         try:
             self._mount_csi_volumes()
         except Exception as e:
+            # release anything already staged/published before the
+            # failing volume — otherwise stage refs and publish targets
+            # leak until GC destroy
+            self._unmount_csi_volumes()
             for tr in self.task_runners:
                 tr.mark_failed(f"csi volume setup failed: {e}")
             self._done.set()

@@ -28,7 +28,19 @@ class CSIManager:
         self.base = os.path.join(data_dir, "csi")
         self._plugins: Dict[str, CSIPluginClient] = {}
         self._stage_refs: Dict[Tuple[str, str], int] = {}
+        # serializes the whole stage/publish/refcount sequence per
+        # volume (reference: csimanager's volume usage tracker) — a
+        # bare refcount read outside the lock lets two concurrent
+        # mounts both see refs==0 and double-stage
+        self._vol_locks: Dict[Tuple[str, str], threading.Lock] = {}
         self._lock = threading.Lock()
+
+    def _vol_lock(self, key: Tuple[str, str]) -> threading.Lock:
+        with self._lock:
+            lock = self._vol_locks.get(key)
+            if lock is None:
+                lock = self._vol_locks[key] = threading.Lock()
+            return lock
 
     # ------------------------------------------------------- plugins
     def register_plugin(self, name: str, addr) -> CSIPluginClient:
@@ -65,14 +77,13 @@ class CSIManager:
             raise CSIError(f"no CSI plugin {plugin_name!r} registered")
         staging = self._staging_path(plugin_name, volume_id)
         target = self._target_path(alloc_id, volume_id)
-        with self._lock:
-            key = (plugin_name, volume_id)
+        key = (plugin_name, volume_id)
+        with self._vol_lock(key):
             refs = self._stage_refs.get(key, 0)
-        if refs == 0:
-            client.node_stage(volume_id, staging)
-        client.node_publish(volume_id, staging, target,
-                            read_only=read_only)
-        with self._lock:
+            if refs == 0:
+                client.node_stage(volume_id, staging)
+            client.node_publish(volume_id, staging, target,
+                                read_only=read_only)
             self._stage_refs[key] = refs + 1
         return target
 
@@ -82,18 +93,18 @@ class CSIManager:
         if client is None:
             return
         target = self._target_path(alloc_id, volume_id)
-        try:
-            client.node_unpublish(volume_id, target)
-        except CSIError:
-            pass
-        with self._lock:
-            key = (plugin_name, volume_id)
-            refs = max(0, self._stage_refs.get(key, 1) - 1)
-            self._stage_refs[key] = refs
-        if refs == 0:
+        key = (plugin_name, volume_id)
+        with self._vol_lock(key):
             try:
-                client.node_unstage(volume_id,
-                                    self._staging_path(plugin_name,
-                                                       volume_id))
+                client.node_unpublish(volume_id, target)
             except CSIError:
                 pass
+            refs = max(0, self._stage_refs.get(key, 1) - 1)
+            self._stage_refs[key] = refs
+            if refs == 0:
+                try:
+                    client.node_unstage(volume_id,
+                                        self._staging_path(plugin_name,
+                                                           volume_id))
+                except CSIError:
+                    pass

@@ -76,3 +76,72 @@ class ExecDriver(RawExecDriver):
         env["NOMAD_TASK_DIR"] = "/local"
         env["NOMAD_ALLOC_DIR"] = "/alloc"
         return env
+
+    # ------------------------------------------------------ jailed exec
+    def _exec_env(self, cfg) -> Dict[str, str]:
+        # ONLY the task's env inside the jail — agent env vars must not
+        # leak through `alloc exec` (reference: drivers/exec runs
+        # ExecTaskStreaming inside the container with the task env)
+        env = self._task_env(cfg) if cfg else {}
+        env.setdefault("PATH", "/usr/local/bin:/usr/bin:/bin")
+        return env
+
+    def _exec_jail(self, t):
+        """Enter the running task's user/mount/pid namespaces and its
+        chroot before exec'ing the command, so `alloc exec` sees
+        exactly the task's view of the world (reference:
+        drivers/exec/driver.go ExecTaskStreaming -> shared executor in
+        the task's namespaces)."""
+        from .executor import pid_alive
+        from .rawexec import DriverError
+
+        ds = t.handle.driver_state or {}
+        pid = ds.get("pid")
+        cfg = t.handle.config
+        if not pid or cfg is None:
+            raise DriverError("exec: no live task process to enter")
+        # start_ticks defeats pid reuse: never setns into an unrelated
+        # process that inherited a dead task's pid
+        if not pid_alive(pid, ds.get("start_ticks", 0)):
+            raise DriverError("exec: task process is not running")
+        rootfs = os.path.join(cfg.task_dir, ".rootfs")
+        fds = []
+
+        def ns_fd(name: str) -> int:
+            fd = os.open(f"/proc/{pid}/ns/{name}", os.O_RDONLY)
+            fds.append(fd)
+            return fd
+
+        try:
+            # joining one's own user ns is EINVAL — only join when the
+            # executor created a root-mapped user ns (unprivileged run)
+            user_fd = None
+            if (os.stat(f"/proc/{pid}/ns/user").st_ino
+                    != os.stat("/proc/self/ns/user").st_ino):
+                user_fd = ns_fd("user")
+            mnt_fd = ns_fd("mnt")
+            pid_fd = ns_fd("pid")
+        except OSError as e:
+            for fd in fds:
+                os.close(fd)
+            raise DriverError(f"exec: cannot enter task namespaces: {e}")
+
+        def enter():
+            if user_fd is not None:
+                os.setns(user_fd, os.CLONE_NEWUSER)
+            os.setns(mnt_fd, os.CLONE_NEWNS)
+            os.setns(pid_fd, os.CLONE_NEWPID)  # children land in the jail
+            os.chroot(rootfs)
+            os.chdir("/local")
+
+        def cleanup():
+            for fd in fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+        # no pass_fds: preexec_fn runs before subprocess closes fds, so
+        # enter() can setns on them; marking them inheritable would hand
+        # the jailed command open /proc/<pid>/ns/* fds
+        return enter, (), None, cleanup

@@ -44,7 +44,8 @@ class IsolationError(OSError):
 
 def _mount(src: Optional[str], target: str, fstype: Optional[str],
            flags: int, data: Optional[str] = None) -> None:
-    rc = _libc.mount(src.encode() if src else None, target.encode(),
+    rc = _libc.mount(os.fsencode(src) if src else None,
+                     os.fsencode(target),
                      fstype.encode() if fstype else None, flags,
                      data.encode() if data else None)
     if rc != 0:
@@ -113,6 +114,59 @@ def enter_namespaces() -> None:
     _mount(None, "/", None, MS_REC | MS_PRIVATE)
 
 
+def _unescape_mount_path(raw: bytes) -> str:
+    """Decode one /proc/self/mounts path field: octal escapes
+    (\\040 etc per fstab(5)) applied on the raw bytes, then fs-decoded
+    so non-ASCII mount points survive the round trip."""
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        if raw[i:i + 1] == b"\\" and raw[i + 1:i + 4].isdigit():
+            out.append(int(raw[i + 1:i + 4], 8))
+            i += 4
+        else:
+            out.append(raw[i])
+            i += 1
+    return os.fsdecode(bytes(out))
+
+
+def _mounts_under(prefix: str) -> List[str]:
+    """Mount points strictly below `prefix` in this mount namespace,
+    deepest first."""
+    out = []
+    try:
+        with open("/proc/self/mounts", "rb") as f:
+            for line in f:
+                fields = line.split()
+                if len(fields) < 2:
+                    continue
+                mp = _unescape_mount_path(fields[1])
+                if mp.startswith(prefix + "/"):
+                    out.append(mp)
+    except OSError:
+        return []
+    return sorted(set(out), key=len, reverse=True)
+
+
+def _remount_ro_tree(tgt: str) -> None:
+    """Remount-ro `tgt` and every submount below it (a recursive bind
+    keeps each submount's own writability until told otherwise).
+
+    The top-level remount must succeed — a writable system bind is a
+    jail break, and the driver's contract is to refuse to start rather
+    than weaken the sandbox.  Submount failures (locked mount flags
+    inherited from the parent userns) are tolerated: the kernel locks
+    such flags precisely because they were already applied."""
+    for mp in _mounts_under(tgt):
+        try:
+            _mount(None, mp, None,
+                   MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
+        except IsolationError:
+            continue
+    _mount(None, tgt, None,
+           MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
+
+
 def build_chroot_binds(rootfs: str, task_dir: str, alloc_dir: str,
                        secrets_dir: str = "",
                        extra_paths: Optional[List[str]] = None) -> None:
@@ -130,9 +184,11 @@ def build_chroot_binds(rootfs: str, task_dir: str, alloc_dir: str,
         os.makedirs(tgt, exist_ok=True)
         _mount(p, tgt, None, MS_BIND | MS_REC)
         if p != "/dev":
-            # remount the bind read-only (two-step per mount(2))
-            _mount(None, tgt, None,
-                   MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
+            # remount the bind read-only (two-step per mount(2));
+            # MS_REMOUNT applies only to the top mount, so walk every
+            # submount the recursive bind dragged in (e.g. a host
+            # mount under /usr) and pin each read-only too
+            _remount_ro_tree(tgt)
     rw = [("/local", task_dir), ("/alloc", alloc_dir)]
     if secrets_dir:
         rw.append(("/secrets", secrets_dir))

@@ -307,18 +307,42 @@ class RawExecDriver(DriverPlugin):
         t = self._get(task_id)
         self._kill_group(t.handle.driver_state["pid"], _signum(signal_name))
 
+    def _exec_env(self, cfg: Optional[TaskConfig]) -> Dict[str, str]:
+        """Hook: env an `alloc exec` command sees.  raw_exec tasks run
+        unisolated in the agent's environment; exec overrides this to
+        hand out ONLY the task's env (the jail must not leak agent
+        variables)."""
+        env = dict(os.environ)
+        if cfg:
+            env.update(cfg.env or {})
+        return env
+
+    def _exec_jail(self, t: _Task):
+        """Hook: (preexec, pass_fds, cwd, cleanup) placing an exec'd
+        command next to the task.  raw_exec: no jail, run in the task
+        dir.  exec overrides this to enter the task's namespaces and
+        chroot (reference: drivers/exec runs ExecTaskStreaming inside
+        the container via the shared executor)."""
+        cfg = t.handle.config
+        cwd = cfg.task_dir if cfg and cfg.task_dir else None
+        return None, (), cwd, (lambda: None)
+
     def exec_task(self, task_id: str, cmd: List[str],
                   timeout_s: float = 30.0) -> Tuple[bytes, int]:
         t = self._get(task_id)
         cfg = t.handle.config
+        preexec, pass_fds, cwd, cleanup = self._exec_jail(t)
         try:
             out = subprocess.run(
-                cmd, cwd=cfg.task_dir if cfg else None,
+                cmd, cwd=cwd, env=self._exec_env(cfg),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                timeout=timeout_s)
+                timeout=timeout_s, preexec_fn=preexec,
+                pass_fds=pass_fds)
             return out.stdout, out.returncode
         except subprocess.TimeoutExpired as e:
             return (e.stdout or b"") + b"\n(timed out)", 124
+        finally:
+            cleanup()
 
     def exec_task_streaming(self, task_id: str, cmd: List[str],
                             tty: bool = True, width: int = 80,
@@ -337,34 +361,46 @@ class RawExecDriver(DriverPlugin):
 
         t = self._get(task_id)
         cfg = t.handle.config
-        cwd = cfg.task_dir if cfg and cfg.task_dir else None
-        env = dict(os.environ)
-        if cfg:
-            env.update(cfg.env or {})
+        jail_preexec, pass_fds, cwd, cleanup = self._exec_jail(t)
+        env = self._exec_env(cfg)
         env.setdefault("TERM", "xterm")
 
-        if tty:
-            import pty
-            master, slave = pty.openpty()
-            fcntl.ioctl(slave, termios.TIOCSWINSZ,
-                        _struct.pack("HHHH", height, width, 0, 0))
+        try:
+            if tty:
+                import pty
+                master, slave = pty.openpty()
+                fcntl.ioctl(slave, termios.TIOCSWINSZ,
+                            _struct.pack("HHHH", height, width, 0, 0))
 
-            def preexec():
-                os.setsid()
-                fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+                def preexec():
+                    os.setsid()
+                    fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+                    if jail_preexec is not None:
+                        jail_preexec()
 
+                try:
+                    proc = subprocess.Popen(
+                        cmd, cwd=cwd, env=env, stdin=slave, stdout=slave,
+                        stderr=slave, preexec_fn=preexec, close_fds=True,
+                        pass_fds=pass_fds)
+                except BaseException:
+                    # a failing preexec (e.g. jail entry) re-raises in
+                    # the parent; the raw pty ints have no finalizer
+                    os.close(master)
+                    os.close(slave)
+                    raise
+                os.close(slave)
+                return ExecStream(fd=master, pid=proc.pid, tty=True,
+                                  popen=proc)
+
+            parent, child = _socket.socketpair()
             proc = subprocess.Popen(
-                cmd, cwd=cwd, env=env, stdin=slave, stdout=slave,
-                stderr=slave, preexec_fn=preexec, close_fds=True)
-            os.close(slave)
-            return ExecStream(fd=master, pid=proc.pid, tty=True,
+                cmd, cwd=cwd, env=env, stdin=child.fileno(),
+                stdout=child.fileno(), stderr=child.fileno(),
+                start_new_session=True, close_fds=True,
+                pass_fds=pass_fds, preexec_fn=jail_preexec)
+            child.close()
+            return ExecStream(fd=parent.detach(), pid=proc.pid, tty=False,
                               popen=proc)
-
-        parent, child = _socket.socketpair()
-        proc = subprocess.Popen(
-            cmd, cwd=cwd, env=env, stdin=child.fileno(),
-            stdout=child.fileno(), stderr=child.fileno(),
-            start_new_session=True, close_fds=True)
-        child.close()
-        return ExecStream(fd=parent.detach(), pid=proc.pid, tty=False,
-                          popen=proc)
+        finally:
+            cleanup()

@@ -1,0 +1,237 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r3).
+
+1 (high)   — `alloc exec` against an exec-driver task must run INSIDE
+             the task's jail with only the task's env (reference:
+             drivers/exec/driver.go ExecTaskStreaming runs through the
+             shared executor in the task's namespaces).
+2 (medium) — CSI stage refcounting must serialize per volume: two
+             concurrent mounts may stage only once.
+3 (low)    — a failed CSI volume setup must release what it already
+             staged/published.
+4 (low)    — the exec websocket must not spawn a process for a request
+             that cannot complete its upgrade handshake.
+5 (low)    — read-only chroot binds pin every submount, not just the
+             top of the tree.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.drivers import isolation
+from nomad_tpu.drivers.exec import ExecDriver
+from nomad_tpu.plugins.drivers import TaskConfig
+
+needs_ns = pytest.mark.skipif(
+    not isolation.probe()["namespaces"],
+    reason="kernel denies mount/pid namespaces")
+
+
+def _exec_task_cfg(tmp_path, command="/bin/sh", args=None):
+    task_dir = str(tmp_path / "t1")
+    logs = str(tmp_path / "logs")
+    os.makedirs(os.path.join(task_dir, "local"), exist_ok=True)
+    os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
+    os.makedirs(logs, exist_ok=True)
+    return TaskConfig(
+        id="alloc1/t1", name="t1", alloc_id="alloc1",
+        env={"TASKVAR": "task-value"},
+        config={"command": command,
+                "args": args or ["-c", "sleep 60"]},
+        cpu_mhz=0, memory_mb=0,
+        task_dir=task_dir, alloc_dir=str(tmp_path),
+        stdout_path=os.path.join(logs, "out"),
+        stderr_path=os.path.join(logs, "err"))
+
+
+@needs_ns
+def test_exec_alloc_exec_runs_inside_the_jail(tmp_path, monkeypatch):
+    """One-shot exec sees the chroot view, the task env, and none of
+    the agent's environment."""
+    monkeypatch.setenv("AGENT_SECRET", "should-not-leak")
+    drv = ExecDriver()
+    cfg = _exec_task_cfg(tmp_path)
+    drv.start_task(cfg)
+    try:
+        out, rc = drv.exec_task(cfg.id, [
+            "/bin/sh", "-c",
+            "ls / && pwd && echo task=$TASKVAR agent=$AGENT_SECRET"])
+        text = out.decode()
+        assert rc == 0, text
+        entries = set(text.split())
+        assert "local" in entries and "alloc" in entries
+        assert "root" not in entries and "home" not in entries
+        assert "/local" in text                  # cwd is the jail's /local
+        assert "task=task-value" in text
+        assert "should-not-leak" not in text     # agent env must not leak
+        # the jail's read-only system paths hold for exec'd commands too
+        out2, _ = drv.exec_task(cfg.id, [
+            "/bin/sh", "-c", "touch /etc/owned 2>&1 || echo DENIED"])
+        assert b"DENIED" in out2
+        assert not os.path.exists("/etc/owned")
+    finally:
+        drv.stop_task(cfg.id, timeout_s=2.0)
+        drv.destroy_task(cfg.id, force=True)
+
+
+@needs_ns
+def test_exec_streaming_exec_runs_inside_the_jail(tmp_path):
+    drv = ExecDriver()
+    cfg = _exec_task_cfg(tmp_path)
+    drv.start_task(cfg)
+    try:
+        stream = drv.exec_task_streaming(
+            cfg.id, ["/bin/sh", "-c", "ls / && echo v=$TASKVAR"],
+            tty=False)
+        buf = b""
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                chunk = os.read(stream.fd, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+        stream.close()
+        text = buf.decode()
+        assert "local" in text.split() and "v=task-value" in text
+        assert "root" not in text.split()
+    finally:
+        drv.stop_task(cfg.id, timeout_s=2.0)
+        drv.destroy_task(cfg.id, force=True)
+
+
+# ---------------------------------------------------------------- CSI
+class _CountingCSIClient:
+    """Stage/unstage counter with a slow stage to widen the race."""
+
+    def __init__(self):
+        self.stages = 0
+        self.unstages = 0
+        self.publishes = 0
+
+    def node_stage(self, vol, staging):
+        time.sleep(0.05)       # let a racing mount observe refs==0
+        self.stages += 1
+
+    def node_publish(self, vol, staging, target, read_only=False):
+        self.publishes += 1
+
+    def node_unpublish(self, vol, target):
+        pass
+
+    def node_unstage(self, vol, staging):
+        self.unstages += 1
+
+
+def test_csi_concurrent_mounts_stage_once(tmp_path):
+    from nomad_tpu.client.csimanager import CSIManager
+    mgr = CSIManager(str(tmp_path))
+    fake = _CountingCSIClient()
+    mgr._plugins["p"] = fake
+    threads = [threading.Thread(target=mgr.mount,
+                                args=("p", "vol-1", f"alloc-{i}"))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fake.stages == 1
+    assert fake.publishes == 4
+    for i in range(4):
+        mgr.unmount("p", "vol-1", f"alloc-{i}")
+    assert fake.unstages == 1
+    # a fresh mount after full release stages again
+    mgr.mount("p", "vol-1", "alloc-new")
+    assert fake.stages == 2
+
+
+def test_alloc_runner_failed_csi_setup_releases_mounts(tmp_path):
+    """run() must unmount already-staged volumes when a later volume
+    fails (ADVICE r3 low: allocrunner.py:176)."""
+    from nomad_tpu.client.allocrunner import AllocRunner
+
+    calls = []
+
+    class _Probe(AllocRunner):
+        def __init__(self):
+            # bypass the full constructor: exercise only run()'s
+            # csi-failure path
+            self.task_runners = []
+            self._done = threading.Event()
+            self._csi_mounts = [("p", "v1")]
+            self._vol_binds = []
+            self.csi_manager = None
+            self.alloc_dir = type("D", (), {"build": lambda s: None})()
+
+        def _mount_csi_volumes(self):
+            raise RuntimeError("second volume unknown")
+
+        def _unmount_csi_volumes(self):
+            calls.append("unmount")
+
+        def _report(self):
+            pass
+
+    _Probe().run()
+    assert calls == ["unmount"]
+
+
+# ----------------------------------------------------------- websocket
+def test_exec_ws_rejects_before_spawning(monkeypatch):
+    """A request without Sec-WebSocket-Key is refused with 400 and the
+    driver is never asked to spawn (ADVICE r3 low: http_server.py:714)."""
+    import socket
+
+    from nomad_tpu.api.http_server import HTTPAgentServer
+
+    spawned = []
+
+    class _FakeDriver:
+        def exec_task_streaming(self, *a, **kw):
+            spawned.append(a)
+            raise AssertionError("must not spawn")
+
+    class _FakeTR:
+        driver = _FakeDriver()
+        task_id = "x"
+
+    srv = HTTPAgentServer.__new__(HTTPAgentServer)
+    srv._resolve_task_runner = lambda alloc_id, task: _FakeTR()
+    srv._enforce_acl = lambda *a, **kw: None
+
+    a, b = socket.socketpair()
+
+    class _FakeHandler:
+        path = ('/v1/client/allocation/abc/exec'
+                '?command=%5B%22sh%22%5D&task=t')
+        headers = {}
+        connection = a
+
+    srv.handle_exec_ws(_FakeHandler())
+    a.close()
+    resp = b.recv(65536).decode()
+    b.close()
+    assert resp.startswith("HTTP/1.1 400")
+    assert "Sec-WebSocket-Key" in resp
+    assert spawned == []
+
+
+# ----------------------------------------------------------- submounts
+def test_mounts_under_orders_deepest_first():
+    from nomad_tpu.drivers.isolation import _mounts_under
+    mounts = _mounts_under("/")
+    assert "/" not in mounts                  # strictly below the prefix
+    assert mounts == sorted(mounts, key=len, reverse=True)
+    assert all(m.startswith("/") and m != "/" for m in mounts)
+
+
+def test_unescape_mount_path_decodes_octal():
+    from nomad_tpu.drivers.isolation import _unescape_mount_path
+    assert _unescape_mount_path(rb"/mnt/with\040space") == "/mnt/with space"
+    assert _unescape_mount_path(rb"/plain") == "/plain"
+    # non-ASCII (UTF-8) mount points survive the round trip
+    assert (_unescape_mount_path("/mnt/datos-ñ".encode())
+            == "/mnt/datos-ñ")
