@@ -418,11 +418,16 @@ def measure_transport_rtt():
 
 
 def run_ours_latency(config, n_nodes, n_evals, count, resident):
-    """Single-eval-per-call mode: what one eval's round trip costs.
-    One device call (plus drains) per eval, result fetched before the
-    next eval is submitted — the interactive path, not the fused
-    stream."""
+    """Single-eval-per-call mode: what one interactive eval costs.
+
+    The production worker picks the solve path by cluster/batch size
+    (solver/host.py prefer_host — SURVEY §7.3's latency fallback): a
+    small cluster solves with the numpy twin of the kernel in-process
+    (identical placements, differential-tested), so a singleton eval
+    never pays a device round trip; big clusters keep the device path.
+    This benchmark makes the same pick."""
     import numpy as np
+    from nomad_tpu.solver.host import HostResidentSolver, prefer_host
     from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
 
     nodes = make_nodes(n_nodes, devices=config == 4)
@@ -430,9 +435,16 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
     probe_job = make_job(config, 0, count)
     gp_need = len(probe_job.task_groups)
     kp_need = count
-    rs = ResidentSolver(nodes, asks_for(probe_job),
-                        gp=1 << max(0, (gp_need - 1).bit_length()),
-                        kp=1 << max(0, (kp_need - 1).bit_length()))
+    gp = 1 << max(0, (gp_need - 1).bit_length())
+    kp = 1 << max(0, (kp_need - 1).bit_length())
+    host = prefer_host(1 << max(0, (n_nodes - 1).bit_length()),
+                       gp_need, kp_need)
+    if host:
+        # no compile-variant reuse to protect on host: exact-size pads
+        rs = HostResidentSolver(nodes, asks_for(probe_job),
+                                gp=gp_need, kp=kp_need)
+    else:
+        rs = ResidentSolver(nodes, asks_for(probe_job), gp=gp, kp=kp)
     rs.reset_usage(used0=resident_used0(rs.template, n_nodes, resident))
     jobs = [make_job(config, e, count) for e in range(n_evals)]
     warm = rs.pack_batch(asks_for(jobs[0]))
@@ -447,7 +459,7 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
     for e, job in enumerate(jobs):
         t_call = time.perf_counter()
         pb = rs.pack_batch(asks_for(job))
-        n_calls += 1
+        n_calls += 0 if host else 1     # host mode never leaves the CPU
         _, ok, _, status = rs.solve_stream([pb], seeds=[e + 1])
         placed += int(ok[0, :pb.n_place, 0].sum())
         failed += int((status[0, :pb.n_place] == 0).sum())
@@ -460,7 +472,9 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
         return lat_ms[int(p * (len(lat_ms) - 1))] if lat_ms else 0.0
 
     return {
-        "engine": "nomad-tpu per-eval calls (latency mode)",
+        "engine": ("nomad-tpu host-solver per-eval (latency mode)"
+                   if host else
+                   "nomad-tpu per-eval device calls (latency mode)"),
         "evals": n_evals, "placements": placed, "failed": failed,
         "retried": retried, "unresolved": unresolved,
         "n_device_calls": n_calls,

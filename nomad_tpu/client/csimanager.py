@@ -35,12 +35,29 @@ class CSIManager:
         self._vol_locks: Dict[Tuple[str, str], threading.Lock] = {}
         self._lock = threading.Lock()
 
-    def _vol_lock(self, key: Tuple[str, str]) -> threading.Lock:
-        with self._lock:
-            lock = self._vol_locks.get(key)
-            if lock is None:
-                lock = self._vol_locks[key] = threading.Lock()
-            return lock
+    def _acquire_vol(self, key: Tuple[str, str]) -> threading.Lock:
+        """Acquire the per-volume lock.  Entries are dropped when the
+        last reference unstages, so re-check identity after acquiring:
+        a waiter that won a deleted lock must retry against the fresh
+        one or two mounts could interleave."""
+        while True:
+            with self._lock:
+                lock = self._vol_locks.get(key)
+                if lock is None:
+                    lock = self._vol_locks[key] = threading.Lock()
+            lock.acquire()
+            with self._lock:
+                if self._vol_locks.get(key) is lock:
+                    return lock
+            lock.release()
+
+    def _release_vol(self, key: Tuple[str, str], lock: threading.Lock,
+                     drop: bool) -> None:
+        if drop:
+            with self._lock:
+                if self._vol_locks.get(key) is lock:
+                    del self._vol_locks[key]
+        lock.release()
 
     # ------------------------------------------------------- plugins
     def register_plugin(self, name: str, addr) -> CSIPluginClient:
@@ -78,13 +95,27 @@ class CSIManager:
         staging = self._staging_path(plugin_name, volume_id)
         target = self._target_path(alloc_id, volume_id)
         key = (plugin_name, volume_id)
-        with self._vol_lock(key):
+        lock = self._acquire_vol(key)
+        try:
             refs = self._stage_refs.get(key, 0)
             if refs == 0:
                 client.node_stage(volume_id, staging)
-            client.node_publish(volume_id, staging, target,
-                                read_only=read_only)
+            try:
+                client.node_publish(volume_id, staging, target,
+                                    read_only=read_only)
+            except BaseException:
+                # a first-reference stage with no publish would leak:
+                # nothing records it, so nothing would ever unstage it
+                if refs == 0:
+                    try:
+                        client.node_unstage(volume_id, staging)
+                    except CSIError:
+                        pass
+                raise
             self._stage_refs[key] = refs + 1
+        finally:
+            self._release_vol(key, lock,
+                              drop=self._stage_refs.get(key, 0) == 0)
         return target
 
     def unmount(self, plugin_name: str, volume_id: str,
@@ -94,7 +125,9 @@ class CSIManager:
             return
         target = self._target_path(alloc_id, volume_id)
         key = (plugin_name, volume_id)
-        with self._vol_lock(key):
+        lock = self._acquire_vol(key)
+        refs = 1
+        try:
             try:
                 client.node_unpublish(volume_id, target)
             except CSIError:
@@ -102,9 +135,12 @@ class CSIManager:
             refs = max(0, self._stage_refs.get(key, 1) - 1)
             self._stage_refs[key] = refs
             if refs == 0:
+                self._stage_refs.pop(key, None)
                 try:
                     client.node_unstage(volume_id,
                                         self._staging_path(plugin_name,
                                                            volume_id))
                 except CSIError:
                     pass
+        finally:
+            self._release_vol(key, lock, drop=refs == 0)

@@ -127,12 +127,37 @@ class ExecDriver(RawExecDriver):
             raise DriverError(f"exec: cannot enter task namespaces: {e}")
 
         def enter():
+            import signal as _sig
+
             if user_fd is not None:
                 os.setns(user_fd, os.CLONE_NEWUSER)
             os.setns(mnt_fd, os.CLONE_NEWNS)
-            os.setns(pid_fd, os.CLONE_NEWPID)  # children land in the jail
+            os.setns(pid_fd, os.CLONE_NEWPID)
             os.chroot(rootfs)
             os.chdir("/local")
+            # setns(CLONE_NEWPID) applies only to CHILDREN: fork once
+            # more so the exec'd command itself is a member of the task
+            # pid namespace (its /proc view, `kill`, and lifetime are
+            # the jail's — it dies with the task's pid 1).  The
+            # intermediate stays outside, forwarding signals and exit
+            # status.
+            pid = os.fork()
+            if pid == 0:
+                return                 # grandchild: execs the command
+            for s in (_sig.SIGTERM, _sig.SIGINT, _sig.SIGHUP,
+                      _sig.SIGQUIT):
+                _sig.signal(s, lambda n, f, p=pid: os.kill(p, n))
+            while True:
+                try:
+                    _, st = os.waitpid(pid, 0)
+                except InterruptedError:
+                    continue
+                except ChildProcessError:
+                    os._exit(127)
+                if os.WIFEXITED(st):
+                    os._exit(os.WEXITSTATUS(st))
+                if os.WIFSIGNALED(st):
+                    os._exit(128 + os.WTERMSIG(st))
 
         def cleanup():
             for fd in fds:

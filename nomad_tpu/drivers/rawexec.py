@@ -373,10 +373,14 @@ class RawExecDriver(DriverPlugin):
                             _struct.pack("HHHH", height, width, 0, 0))
 
                 def preexec():
-                    os.setsid()
-                    fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+                    # jail first: the exec jail forks an intermediate
+                    # and only the final command process returns here,
+                    # so it — not the intermediate — becomes the
+                    # session leader owning the pty
                     if jail_preexec is not None:
                         jail_preexec()
+                    os.setsid()
+                    fcntl.ioctl(0, termios.TIOCSCTTY, 0)
 
                 try:
                     proc = subprocess.Popen(

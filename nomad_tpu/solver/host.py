@@ -1,0 +1,577 @@
+"""Host (numpy) mirror of the wave-solve kernel, for latency mode.
+
+The tunneled TPU transport costs ~100ms per device round trip; an
+interactive singleton eval (one job, a small cluster) finishes its
+entire solve in well under a millisecond of arithmetic.  SURVEY §7.3
+prescribes a host fallback for exactly this regime (reference analog:
+the in-process Go solve, scheduler/generic_sched.go:427) — the worker
+picks the path by batch/cluster size, and the semantics MUST be the
+kernel's: this module is a line-for-line numpy port of
+`kernel.solve_kernel` (same wave loop, same scoring formulas, same
+tie-breaks), differential-tested to produce identical placements.
+
+Scope: exact only where the device kernel is exact — the dispatch
+gate (`prefer_host`) excludes padded node counts that would take the
+device's `approx_max_k` path, so host argsort and device top_k agree.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from .kernel import (MAX_WAVES, MERGED_GP_MAX, NEG_INF, TOP_K, WAVE_K,
+                     _APPROX_MIN_NP, _MERGED_W_CAP, _WIDE_W_CAP,
+                     SolveResult)
+from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT,
+                        OP_NE, OP_NOT_SET, R_CPU, R_MEM)
+
+# dispatch gate defaults: the host path wins whenever the numpy wave
+# loop (microseconds per wave at these sizes) beats one transport
+# round trip.  Above these sizes the device's fused throughput takes
+# over; at/above _APPROX_MIN_NP the device kernel switches to
+# approx_max_k and exactness would be lost anyway.
+HOST_MAX_PLACE = 1024
+HOST_MAX_CELLS = 1 << 18         # Gp * Np budget per wave
+
+
+def prefer_host(n_nodes_padded: int, n_asks: int, n_place: int) -> bool:
+    """Should this problem solve on host?  (The worker's path pick —
+    reference: the always-in-process scheduler, nomad/worker.go.)"""
+    return (n_nodes_padded < _APPROX_MIN_NP
+            and n_place <= HOST_MAX_PLACE
+            and n_nodes_padded * max(n_asks, 1) <= HOST_MAX_CELLS)
+
+
+def _op_eval(vals: np.ndarray, op: np.ndarray, rank: np.ndarray
+             ) -> np.ndarray:
+    """Numpy twin of kernel._op_eval (feasible.go:671 semantics)."""
+    found = vals >= 0
+    eq = found & (vals == rank[None, :])
+    res = np.ones_like(found)
+    opb = op[None, :]
+    res = np.where(opb == OP_EQ, eq, res)
+    res = np.where(opb == OP_NE, ~eq, res)
+    res = np.where(opb == OP_LT, found & (vals < rank[None, :]), res)
+    res = np.where(opb == OP_LE, found & (vals <= rank[None, :]), res)
+    res = np.where(opb == OP_GT, found & (vals > rank[None, :]), res)
+    res = np.where(opb == OP_GE, found & (vals >= rank[None, :]), res)
+    res = np.where(opb == OP_IS_SET, found, res)
+    res = np.where(opb == OP_NOT_SET, ~found, res)
+    return res
+
+
+def _top_k(score: np.ndarray, k: int):
+    """Exact descending top-k per row, ties broken by LOWER index first
+    — lax.top_k's documented order."""
+    order = np.argsort(-score, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(score, order, axis=1), order.astype(np.int32)
+
+
+def _static_program(avail, valid, node_dc, attr_rank, dc_ok,
+                    host_ok, c_op, c_col, c_rank, a_op, a_col, a_rank,
+                    a_weight, a_host, sp_col, sp_desired, sp_implicit,
+                    has_spread, cache=None):
+    """The wave-invariant tensors: static feasibility + per-constraint
+    filtered counts, affinity scores, hoisted spread lookups.  These
+    depend only on the ask programs and the node template, so repeated
+    evals with identical programs (the steady-state service workload)
+    hit `cache` instead of recomputing — the host path's analog of the
+    kernel's one-compile-many-calls amortization."""
+    f32 = np.float32
+    key = None
+    if cache is not None:
+        key = hash((c_op.tobytes(), c_col.tobytes(), c_rank.tobytes(),
+                    a_op.tobytes(), a_col.tobytes(), a_rank.tobytes(),
+                    a_weight.tobytes(), a_host.tobytes(),
+                    dc_ok.tobytes(), host_ok.tobytes(),
+                    sp_col.tobytes(), sp_desired.tobytes(),
+                    sp_implicit.tobytes(), bool(has_spread)))
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    Np = avail.shape[0]
+    Gp = c_op.shape[0]
+    S = sp_col.shape[1]
+    V = sp_desired.shape[2]
+
+    # vals3[g, n, c] = attr_rank[n, c_col[g, c]] — one gather for all
+    # groups (the per-group loop dominated the solve cost)
+    vals3 = attr_rank[:, c_col].transpose(1, 0, 2)       # [Gp, Np, C]
+    ok3 = _op_eval3(vals3, c_op, c_rank)
+    base = valid[None, :] & dc_ok[:, node_dc] & host_ok
+    passed_prev = np.cumprod(
+        np.concatenate([np.ones((Gp, Np, 1), bool), ok3[:, :, :-1]],
+                       axis=2), axis=2).astype(bool)
+    first_fail = base[:, :, None] & passed_prev & ~ok3
+    cons_filtered = first_fail.sum(axis=1).astype(np.int32)  # [Gp, C]
+    feas = base & ok3.all(axis=2)
+
+    avals3 = attr_rank[:, a_col].transpose(1, 0, 2)
+    match3 = _op_eval3(avals3, a_op, a_rank)
+    aff_score = ((match3 * a_weight[:, None, :]).sum(axis=2)
+                 + np.asarray(a_host, f32)).astype(f32)
+
+    if has_spread:
+        sp_vnode = np.full((S, Gp, Np), -1, np.int32)
+        sp_des = np.zeros((S, Gp, Np), f32)
+        for s in range(S):
+            col = sp_col[:, s]
+            has = col >= 0
+            v = attr_rank[:, np.maximum(col, 0)].T.astype(np.int32)
+            v = np.where(has[:, None], v, -1)
+            # XLA gather semantics: out-of-range indices CLAMP
+            desired = np.take_along_axis(
+                np.asarray(sp_desired[:, s], f32),
+                np.clip(v, 0, V - 1), axis=1)
+            desired = np.where(v >= 0, desired, f32(-1.0))
+            desired = np.where(desired < 0,
+                               np.asarray(sp_implicit[:, s],
+                                          f32)[:, None], desired)
+            sp_vnode[s] = v
+            sp_des[s] = desired
+    else:
+        sp_vnode = sp_des = None
+
+    out = (feas, cons_filtered, aff_score, sp_vnode, sp_des)
+    if cache is not None:
+        if len(cache) > 256:
+            cache.clear()
+        cache[key] = out
+    return out
+
+
+def _op_eval3(vals: np.ndarray, op: np.ndarray, rank: np.ndarray
+              ) -> np.ndarray:
+    """[Gp, Np, C] variant of _op_eval (same semantics, one pass)."""
+    found = vals >= 0
+    rk = rank[:, None, :]
+    eq = found & (vals == rk)
+    res = np.ones_like(found)
+    opb = op[:, None, :]
+    res = np.where(opb == OP_EQ, eq, res)
+    res = np.where(opb == OP_NE, ~eq, res)
+    res = np.where(opb == OP_LT, found & (vals < rk), res)
+    res = np.where(opb == OP_LE, found & (vals <= rk), res)
+    res = np.where(opb == OP_GT, found & (vals > rk), res)
+    res = np.where(opb == OP_GE, found & (vals >= rk), res)
+    res = np.where(opb == OP_IS_SET, found, res)
+    res = np.where(opb == OP_NOT_SET, ~found, res)
+    return res
+
+
+def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
+                      ask_res, ask_desired, distinct, dc_ok, host_ok,
+                      coll0, penalty,
+                      c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight,
+                      a_host, sp_col, sp_weight, sp_targeted, sp_desired,
+                      sp_implicit, sp_used0, dev_cap, dev_used0, dev_ask,
+                      p_ask, n_place, seed=0, *, has_spread=True,
+                      group_count_hint=0, max_waves=0,
+                      static_cache=None) -> SolveResult:
+    """Numpy port of kernel.solve_kernel — see that docstring for the
+    wave semantics.  Every formula, window size, and tie-break matches;
+    tests/test_host_solver.py asserts bitwise-equal placements."""
+    f32 = np.float32
+    avail = np.asarray(avail, f32)
+    reserved = np.asarray(reserved, f32)
+    used = np.array(used0, f32)
+    ask_res = np.asarray(ask_res, f32)
+    dev_cap = np.asarray(dev_cap, f32)
+    dev_used = np.array(dev_used0, f32)
+    dev_ask = np.asarray(dev_ask, f32)
+    sp_used = np.array(sp_used0, f32)
+    max_waves = max_waves or MAX_WAVES
+
+    Np = avail.shape[0]
+    Gp = ask_res.shape[0]
+    S = sp_col.shape[1]
+    R = avail.shape[1]
+    K = p_ask.shape[0]
+    per_group = group_count_hint if group_count_hint > 0 else K // 8
+    w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
+    TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, Np)
+    W = max(TK - TOP_K, 1)
+    ks = np.arange(K)
+    gs = np.arange(Gp)
+    g_idx = np.asarray(p_ask, np.int64)
+
+    # ---------- wave-invariant program (cached across evals) ----------
+    V = sp_desired.shape[2]
+    feas, cons_filtered, aff_score, sp_vnode, sp_des = _static_program(
+        avail, valid, node_dc, attr_rank, dc_ok, host_ok,
+        c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
+        sp_col, sp_desired, sp_implicit, has_spread, cache=static_cache)
+    pen_score = np.where(penalty, f32(-1.0), f32(0.0))
+    pen_counts = penalty
+
+    # tie-break jitter (kernel's uint32 hash, bit-exact)
+    u32 = np.uint32
+    with np.errstate(over="ignore"):
+        h = (np.arange(Np, dtype=u32)[None, :] * u32(2654435761)
+             + (gs.astype(u32)[:, None] * u32(7919)
+                + u32(seed)) * u32(40503))
+        h = (h ^ (h >> u32(16))) * u32(2246822519)
+    SCORE_BIN = 0.05
+    jitter = (np.zeros((Gp, Np), f32) if seed == 0 else
+              (h & u32(1023)).astype(f32) * f32(SCORE_BIN / 1023.0))
+
+    def group_scores(used, dev_used, coll, sp_used, blocked):
+        after = used[None, :, :] + ask_res[:, None, :]
+        fit_dims = after <= avail[None, :, :]
+        fit = fit_dims.all(axis=-1)
+        dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
+                   <= dev_cap[None, :, :]).all(axis=-1)
+        feas_b = feas & ~blocked
+        placeable = feas_b & fit & dev_fit
+
+        denom_cpu = avail[None, :, R_CPU]
+        denom_mem = avail[None, :, R_MEM]
+        util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
+        util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
+        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+        free_cpu = f32(1.0) - util_cpu / np.maximum(denom_cpu, f32(1.0))
+        free_mem = f32(1.0) - util_mem / np.maximum(denom_mem, f32(1.0))
+        raw = f32(20.0) - (f32(10.0) ** free_cpu + f32(10.0) ** free_mem)
+        binpack = np.where(ok_denoms,
+                           np.clip(raw, f32(0.0), f32(18.0)) / f32(18.0),
+                           f32(0.0))
+
+        anti = np.where(coll > 0,
+                        -(coll + f32(1.0)) / ask_desired[:, None],
+                        f32(0.0))
+        anti_counts = coll > 0
+
+        if has_spread:
+            spread_total = np.zeros((Gp, Np), f32)
+            for s in range(S):
+                col = sp_col[:, s]
+                has = col >= 0
+                v = sp_vnode[s]
+                has_v = v >= 0
+                used_vec = sp_used[:, s]
+                cur = np.where(v >= 0, np.take_along_axis(
+                    used_vec, np.clip(v, 0, V - 1), axis=1), f32(0.0))
+                desired = sp_des[s]
+                boost = ((desired - (cur + f32(1.0)))
+                         / np.maximum(desired, f32(1e-9))
+                         ) * np.asarray(sp_weight[:, s], f32)[:, None]
+                targeted = np.where(~has_v, f32(-1.0),
+                                    np.where(desired <= 0, f32(-1.0),
+                                             boost))
+                present = used_vec > 0
+                any_present = present.any(axis=1)[:, None]
+                minc = np.min(np.where(present, used_vec, np.inf),
+                              axis=1)[:, None].astype(f32)
+                maxc = np.max(np.where(present, used_vec, -np.inf),
+                              axis=1)[:, None].astype(f32)
+                delta_boost = (minc - cur) / np.maximum(minc, f32(1e-9))
+                even = np.where(cur != minc, delta_boost,
+                                np.where(minc == maxc, f32(-1.0),
+                                         (maxc - minc)
+                                         / np.maximum(minc, f32(1e-9))))
+                even = np.where(~has_v, f32(-1.0), even)
+                even = np.where(any_present, even, f32(0.0))
+                contrib = np.where(sp_targeted[:, s][:, None], targeted,
+                                   even)
+                spread_total += np.where(has[:, None], contrib, f32(0.0))
+            spread_counts = spread_total != 0.0
+        else:
+            spread_total = f32(0.0)
+            spread_counts = False
+
+        aff_counts = aff_score != 0.0
+        n_scorers = (f32(1.0) + anti_counts + pen_counts + aff_counts
+                     + spread_counts).astype(f32)
+        total = (binpack + anti + pen_score + aff_score
+                 + spread_total) / n_scorers
+        if seed != 0:
+            total = np.floor(total / f32(SCORE_BIN)) * f32(SCORE_BIN)
+        total = total + jitter
+        score = np.where(placeable, total, f32(NEG_INF))
+        return score, placeable, feas_b, fit, fit_dims, dev_fit
+
+    # ---------- wave loop state ----------
+    done = np.zeros(K, bool)
+    out_idx = np.zeros((K, TOP_K), np.int32)
+    out_ok = np.zeros((K, TOP_K), bool)
+    out_score = np.full((K, TOP_K), NEG_INF, f32)
+    out_nfeas = np.zeros(K, np.int32)
+    out_nexh = np.zeros(K, np.int32)
+    out_dimexh = np.zeros((K, R), np.int32)
+    wave = 0
+    Vs = sp_desired.shape[2]
+
+    while wave < max_waves:
+        active = ~done & (ks < n_place)
+        if not active.any():
+            break
+
+        committed = done & out_ok[:, 0]
+        chosen = np.where(committed, out_idx[:, 0], 0).astype(np.int64)
+        coll = coll0.astype(f32).copy()
+        np.add.at(coll, (g_idx, chosen), committed.astype(f32))
+        dg_all = np.asarray(distinct)[g_idx]
+        hit = np.zeros((Gp, Np), np.int32)
+        np.add.at(hit, (np.maximum(dg_all, 0), chosen),
+                  (committed & (dg_all >= 0)).astype(np.int32))
+        hit = hit > 0
+        blocked = (hit[np.maximum(distinct, 0)]
+                   & (distinct >= 0)[:, None])
+
+        score, placeable, feas_b, fit, fit_dims, dev_fit = group_scores(
+            used, dev_used, coll, sp_used, blocked)
+        top_score, top_idx = _top_k(score, TK)
+
+        # spread-aware candidate interleaving (kernel's slot-0 path)
+        if has_spread and Vs <= 8:
+            has0 = sp_col[:, 0] >= 0
+            vnode = sp_vnode[0]
+            TKv = -(-TK // (Vs + 1))
+            tabs_i, tabs_s = [], []
+            for v in range(Vs + 1):
+                vmask = (vnode == v) if v < Vs else (vnode < 0)
+                sv = np.where(vmask, score, f32(NEG_INF))
+                ts, ti = _top_k(sv, TKv)
+                tabs_i.append(ti)
+                tabs_s.append(ts)
+            tab_i = np.stack(tabs_i, axis=1)
+            tab_s = np.stack(tabs_s, axis=1)
+            vord = np.argsort(-tab_s[:, :, 0], axis=1,
+                              kind="stable").astype(np.int64)
+            j = np.arange(TK)
+            vj = vord[:, j % (Vs + 1)]
+            inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            order = np.argsort((inter_s <= NEG_INF / 2).astype(np.int32),
+                               axis=1, kind="stable")
+            inter_i = np.take_along_axis(inter_i, order, axis=1)
+            inter_s = np.take_along_axis(inter_s, order, axis=1)
+            top_idx = np.where(has0[:, None], inter_i, top_idx)
+            top_score = np.where(has0[:, None], inter_s, top_score)
+
+        grp_any = placeable.any(axis=1)
+
+        n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
+        n_exh_g = (feas_b & valid[None, :] & ~(fit & dev_fit)).sum(axis=1)
+        dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
+                     & ~fit_dims).sum(axis=1)
+
+        grp_onehot = ((g_idx[None, :] == gs[:, None])
+                      & active[None, :]).astype(np.int32)
+        act_g = grp_onehot.sum(axis=1)
+        rank = (np.cumsum(grp_onehot, axis=1) - grp_onehot)[g_idx, ks]
+        n_cand = (top_score > NEG_INF / 2).sum(axis=1)
+        M = np.clip(np.minimum(n_cand, W), 1, W)
+        with np.errstate(over="ignore"):
+            g_hash = ((gs.astype(u32) * u32(2654435761))
+                      ^ (u32(seed) * u32(2246822519)))
+        g_off = (np.zeros(Gp, np.int32) if seed == 0 else
+                 ((g_hash >> u32(8)) % u32(W)).astype(np.int32))
+        rot = 0 if seed == 0 else wave
+        cr = (rank + g_off[g_idx] + rot) % M[g_idx]
+        cand = top_idx[g_idx, cr].astype(np.int64)
+        cand_score = top_score[g_idx, cr]
+        cand_ok = active & (cand_score > NEG_INF / 2)
+
+        fail_now = active & ~grp_any[g_idx]
+
+        # -- same-wave conflict checks (exact serial accumulation) --
+        def prior_sum_node(vals):
+            out = np.zeros_like(vals)
+            acc = {}
+            for p in range(K):
+                if not cand_ok[p]:
+                    continue
+                key = int(cand[p])
+                prev = acc.get(key)
+                if prev is not None:
+                    out[p] = prev
+                acc[key] = (prev if prev is not None
+                            else np.zeros(vals.shape[1], vals.dtype)
+                            ) + vals[p]
+            return out
+
+        def prior_rank(key, member):
+            out = np.zeros(K, np.int32)
+            counts = {}
+            m = member & cand_ok
+            for p in range(K):
+                if not m[p]:
+                    continue
+                kk = int(key[p])
+                out[p] = counts.get(kk, 0)
+                counts[kk] = out[p] + 1
+            return out
+
+        res_k = ask_res[g_idx] * cand_ok[:, None]
+        prior = prior_sum_node(res_k)
+        fits = ((used[cand] + prior + ask_res[g_idx])
+                <= avail[cand]).all(axis=-1)
+        dev_k = dev_ask[g_idx] * cand_ok[:, None]
+        prior_dev = prior_sum_node(dev_k)
+        dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
+                    <= dev_cap[cand]).all(axis=-1)
+
+        dg = np.asarray(distinct)[g_idx]
+        dg_key = cand * np.int64(Gp) + np.maximum(dg, 0)
+        dg_ok = prior_rank(dg_key, dg >= 0) == 0
+
+        sp_ok = np.ones(K, bool)
+        for s in (range(S) if has_spread else range(0)):
+            cols = sp_col[g_idx, s]
+            vs = attr_rank[cand, np.maximum(cols, 0)]
+            has_s = (cols >= 0) & (vs >= 0)
+            vsc = np.maximum(vs, 0).astype(np.int64)
+            des_s = np.asarray(sp_desired[:, s], f32)
+            use_s = sp_used[:, s]
+            des_eff = np.where(
+                des_s < 0, np.asarray(sp_implicit[:, s], f32)[:, None],
+                des_s)
+            present = use_s > 0
+            maxc = np.max(np.where(present, use_s, f32(0.0)),
+                          axis=1)[:, None]
+            minc = np.min(np.where(present, use_s,
+                                   np.where(present.any(axis=1)[:, None],
+                                            np.inf, 0.0)),
+                          axis=1)[:, None]
+            minc = np.where(np.isfinite(minc), minc, 0.0).astype(f32)
+            share = np.ceil(act_g.astype(f32) / V)[:, None]
+            level = np.maximum(maxc, minc + share)
+            quota = np.where(
+                np.asarray(sp_targeted[:, s])[:, None],
+                np.maximum(f32(1.0), des_eff - use_s),
+                np.maximum(f32(1.0), level - use_s))
+            gv_key = (g_idx * np.int64(V) + vsc) * np.int64(2) + 1
+            gv_rank = prior_rank(gv_key, has_s).astype(f32)
+            # gather clamps (XLA OOB semantics) — the key stays exact
+            sp_ok &= ~has_s | (gv_rank
+                               < quota[g_idx, np.minimum(vsc, V - 1)])
+
+        commit = cand_ok & fits & dev_fits & dg_ok & sp_ok
+        cm = commit[:, None]
+
+        np.add.at(used, cand, ask_res[g_idx] * cm)
+        np.add.at(dev_used, cand, dev_ask[g_idx] * cm)
+        if has_spread:
+            svals = attr_rank[cand[:, None],
+                              np.maximum(sp_col[g_idx], 0)]
+            # XLA scatter semantics: out-of-range updates are DROPPED
+            okslot = ((sp_col[g_idx] >= 0) & (svals >= 0)
+                      & (svals < V) & cm)
+            np.add.at(sp_used,
+                      (g_idx[:, None], np.arange(S)[None, :],
+                       np.clip(svals, 0, V - 1)),
+                      okslot.astype(f32))
+
+        offs = cr[:, None] + np.arange(TOP_K)[None, :]
+        pk_idx = top_idx[g_idx[:, None], offs]
+        pk_score = top_score[g_idx[:, None], offs]
+        pk_ok = pk_score > NEG_INF / 2
+        newly = commit | fail_now
+        upd = newly[:, None]
+        out_idx = np.where(upd, pk_idx, out_idx)
+        out_score = np.where(upd, pk_score, out_score)
+        out_ok = np.where(upd, pk_ok & cm, out_ok)
+        out_nfeas = np.where(newly, n_feas_g[g_idx], out_nfeas)
+        out_nexh = np.where(newly, n_exh_g[g_idx], out_nexh)
+        out_dimexh = np.where(newly[:, None], dim_exh_g[g_idx],
+                              out_dimexh)
+        done = done | newly
+        wave += 1
+
+    unfinished = ~done & (ks < n_place)
+    return SolveResult(
+        choice=out_idx, choice_ok=out_ok, score=out_score,
+        n_feasible=out_nfeas, n_exhausted=out_nexh,
+        dim_exhausted=out_dimexh, feas=feas,
+        cons_filtered=cons_filtered, used_final=used,
+        dev_used_final=dev_used, n_waves=np.int32(wave),
+        unfinished=unfinished)
+
+
+class HostResidentSolver:
+    """Host twin of resident.ResidentSolver for the interactive path:
+    same pack-once / stream-asks surface and the same carried-usage
+    semantics, but every solve runs the numpy kernel in-process — one
+    singleton eval costs microseconds of arithmetic instead of a
+    transport round trip.  Differential-tested batch-for-batch against
+    the device stream (tests/test_host_solver.py)."""
+
+    def __init__(self, nodes, probe_asks, allocs_by_node=None,
+                 gp=None, kp=None, max_waves: int = 0):
+        from .tensorize import Tensorizer
+        self.nodes = list(nodes)
+        self.max_waves = max_waves
+        self._tz = Tensorizer()
+        self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
+        self.gp = gp or self.template.ask_res.shape[0]
+        self.kp = kp or self.template.p_ask.shape[0]
+        self._drv_cache = {}
+        self._row_cache = {}
+        # program cache for _static_program: sound because the node
+        # template is fixed for this solver's lifetime
+        self._static_cache = {}
+        t = self.template
+        self._used = np.array(t.used0, np.float32)
+        self._dev_used = np.array(t.dev_used0, np.float32)
+
+    def pack_batch(self, asks, job_keys=None):
+        pb = self._tz.repack_asks(self.nodes, asks, self.template,
+                                  gp=self.gp, kp=self.kp,
+                                  drv_cache=self._drv_cache,
+                                  row_cache=self._row_cache)
+        if pb is not None:
+            pb.job_keys = (job_keys if job_keys is not None else
+                           {(a.job.namespace, a.job.id) for a in asks})
+        return pb
+
+    def reset_usage(self, used0=None, dev_used0=None) -> None:
+        t = self.template
+        self._used = np.array(
+            t.used0 if used0 is None else used0, np.float32)
+        self._dev_used = np.array(
+            t.dev_used0 if dev_used0 is None else dev_used0, np.float32)
+
+    def usage(self):
+        return self._used.copy(), self._dev_used.copy()
+
+    def solve_stream(self, batches, seeds=None):
+        """Same contract as ResidentSolver.solve_stream: returns
+        (choice [B, K, TOP_K], ok, score, status [B, K]); usage carries
+        batch to batch and across calls."""
+        # STATUS_* live in resident.py; import here to avoid a cycle
+        from .resident import (STATUS_COMMITTED, STATUS_FAILED,
+                               STATUS_RETRY, ResidentSolver)
+        hint = ResidentSolver._group_count_hint(batches)
+        t = self.template
+        B = len(batches)
+        K = self.kp
+        choice = np.zeros((B, K, TOP_K), np.int32)
+        ok = np.zeros((B, K, TOP_K), bool)
+        score = np.full((B, K, TOP_K), NEG_INF, np.float32)
+        status = np.zeros((B, K), np.int32)
+        has_spread = bool(any((pb.sp_col[:, 0] >= 0).any()
+                              for pb in batches))
+        for b, pb in enumerate(batches):
+            seed = 0 if seeds is None else int(seeds[b])
+            res = host_solve_kernel(
+                t.avail, t.reserved, self._used, t.valid, t.node_dc,
+                t.attr_rank, pb.ask_res, pb.ask_desired, pb.distinct,
+                pb.dc_ok, pb.host_ok, pb.coll0, pb.penalty, pb.c_op,
+                pb.c_col, pb.c_rank, pb.a_op, pb.a_col, pb.a_rank,
+                pb.a_weight, pb.a_host, pb.sp_col, pb.sp_weight,
+                pb.sp_targeted, pb.sp_desired, pb.sp_implicit,
+                pb.sp_used0, t.dev_cap, self._dev_used, pb.dev_ask,
+                pb.p_ask, pb.n_place, seed, has_spread=has_spread,
+                group_count_hint=hint, max_waves=self.max_waves,
+                static_cache=self._static_cache)
+            self._used = res.used_final
+            self._dev_used = res.dev_used_final
+            choice[b] = res.choice
+            score[b] = res.score
+            ok[b] = res.score > NEG_INF / 2
+            status[b] = np.where(
+                res.choice_ok[:, 0], STATUS_COMMITTED,
+                np.where(res.unfinished, STATUS_RETRY, STATUS_FAILED))
+        return choice, ok, score, status

@@ -41,10 +41,16 @@ class SolveOutput:
 
 class Solver:
     """Stateful wrapper owning tensorizer memoization. One per scheduler
-    worker (reference analog: the Stack owned by each scheduler)."""
+    worker (reference analog: the Stack owned by each scheduler).
 
-    def __init__(self) -> None:
+    `host` picks the compute path: "auto" (default) solves small
+    problems with the numpy twin of the kernel (host.py — identical
+    placements, no device round trip; SURVEY §7.3's latency fallback),
+    "never"/"always" pin a path (tests, benchmarks)."""
+
+    def __init__(self, host: str = "auto") -> None:
         self._tensorizer = Tensorizer()
+        self._host = host
 
     def solve(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
               allocs_by_node: Optional[Dict[str, list]] = None,
@@ -52,7 +58,7 @@ class Solver:
         if not asks:
             return SolveOutput(placements=[])
         pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
-        res = _run_kernel(pb)
+        res = _run_kernel(pb, host_mode=self._host)
 
         choice = np.asarray(res.choice)
         choice_ok = np.asarray(res.choice_ok)
@@ -265,11 +271,16 @@ class Solver:
         return None
 
 
-def _run_kernel(pb: PackedBatch):
+def _run_kernel(pb: PackedBatch, host_mode: str = "auto"):
     import numpy as _np
-    return solve_kernel(*_kernel_args(pb),
-                        has_spread=bool((_np.asarray(pb.sp_col[:, 0])
-                                         >= 0).any()))
+    has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
+    if host_mode != "never":
+        from .host import host_solve_kernel, prefer_host
+        if host_mode == "always" or prefer_host(
+                pb.avail.shape[0], pb.n_asks, pb.n_place):
+            return host_solve_kernel(*_kernel_args(pb),
+                                     has_spread=has_spread)
+    return solve_kernel(*_kernel_args(pb), has_spread=has_spread)
 
 
 def _kernel_args(pb: PackedBatch):

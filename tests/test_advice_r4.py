@@ -76,6 +76,29 @@ def test_exec_alloc_exec_runs_inside_the_jail(tmp_path, monkeypatch):
 
 
 @needs_ns
+def test_exec_alloc_exec_joins_task_pid_namespace(tmp_path):
+    """The exec'd command must be a MEMBER of the task's pid namespace
+    (not just its mount ns): /proc/self resolves in the jail's /proc,
+    and pids it sees are the jail's."""
+    drv = ExecDriver()
+    cfg = _exec_task_cfg(tmp_path)
+    drv.start_task(cfg)
+    try:
+        out, rc = drv.exec_task(cfg.id, [
+            "/bin/sh", "-c",
+            "cat /proc/self/stat >/dev/null && echo INNS pid=$$"])
+        text = out.decode()
+        assert rc == 0, text
+        assert "INNS" in text
+        # pids inside a fresh pid ns are tiny; a host pid would be huge
+        pid = int(text.split("pid=")[1].split()[0])
+        assert pid < 1000
+    finally:
+        drv.stop_task(cfg.id, timeout_s=2.0)
+        drv.destroy_task(cfg.id, force=True)
+
+
+@needs_ns
 def test_exec_streaming_exec_runs_inside_the_jail(tmp_path):
     drv = ExecDriver()
     cfg = _exec_task_cfg(tmp_path)
@@ -146,6 +169,38 @@ def test_csi_concurrent_mounts_stage_once(tmp_path):
     # a fresh mount after full release stages again
     mgr.mount("p", "vol-1", "alloc-new")
     assert fake.stages == 2
+
+
+def test_csi_publish_failure_unstages_first_reference(tmp_path):
+    """mount() must not leak a staged volume when publish fails on the
+    first reference (nothing records it, so nothing would unstage)."""
+    from nomad_tpu.client.csimanager import CSIManager
+    from nomad_tpu.plugins.csi import CSIError
+
+    class _FailingPublish(_CountingCSIClient):
+        def node_publish(self, vol, staging, target, read_only=False):
+            raise CSIError("bad target")
+
+    mgr = CSIManager(str(tmp_path))
+    fake = _FailingPublish()
+    mgr._plugins["p"] = fake
+    with pytest.raises(CSIError):
+        mgr.mount("p", "vol-x", "alloc-1")
+    assert fake.stages == 1 and fake.unstages == 1
+    assert mgr._stage_refs.get(("p", "vol-x"), 0) == 0
+    assert ("p", "vol-x") not in mgr._vol_locks     # bounded lock table
+
+
+def test_csi_vol_lock_table_is_bounded(tmp_path):
+    from nomad_tpu.client.csimanager import CSIManager
+    mgr = CSIManager(str(tmp_path))
+    fake = _CountingCSIClient()
+    mgr._plugins["p"] = fake
+    for i in range(10):
+        mgr.mount("p", f"vol-{i}", "alloc-1")
+        mgr.unmount("p", f"vol-{i}", "alloc-1")
+    assert not mgr._vol_locks
+    assert not mgr._stage_refs
 
 
 def test_alloc_runner_failed_csi_setup_releases_mounts(tmp_path):

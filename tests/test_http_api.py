@@ -99,8 +99,12 @@ def test_alloc_and_node_endpoints(agent):
     server, client, http, api = agent
     allocs, _ = api.allocations.list()
     assert allocs
-    a = api.allocations.info(allocs[0]["ID"])
-    assert a["id"] == allocs[0]["ID"]
+    # pin to the httpd job: other tests' mock jobs leave allocs the
+    # client never runs (unknown driver), whose task_states stay empty
+    httpd = [al for al in allocs if al["JobID"] == "httpd"]
+    assert httpd
+    a = api.allocations.info(httpd[0]["ID"])
+    assert a["id"] == httpd[0]["ID"]
     assert a["task_states"]
     nodes, _ = api.nodes.list()
     assert len(nodes) == 1
@@ -124,6 +128,19 @@ def test_node_eligibility_and_drain_via_http(agent):
 def test_job_plan_dry_run_does_not_mutate(agent):
     server, client, http, api = agent
     job = api.jobs.parse(HCL.replace('"httpd"', '"planonly"'))
+    # wait out async writes from earlier tests (client alloc-status
+    # sync for the mock job's failed allocs) before snapshotting
+    stable = {}
+
+    def quiesced():
+        cur = server.store.latest_index()
+        if stable.get("idx") != cur:
+            stable["idx"] = cur
+            stable["t"] = time.monotonic()
+            return False
+        return time.monotonic() - stable["t"] > 1.0
+
+    wait_until(quiesced, timeout=15)
     before = server.store.latest_index()
     resp = api.jobs.plan("planonly", job)
     ann = resp["annotations"]
