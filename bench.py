@@ -66,6 +66,14 @@ def make_nodes(n_nodes, devices=False):
     nodes = []
     for i in range(n_nodes):
         n = mock.node(datacenter=f"dc{i % 4}")
+        # identical effective capacity on both engines: the stock C++
+        # generator models no reserved carve-out, and a 100-cpu/node
+        # difference alone decides the pack-to-capacity duel (256
+        # placements at 512 nodes) — zero it here rather than compare
+        # engines against different clusters
+        n.reserved_resources.cpu = 0
+        n.reserved_resources.memory_mb = 0
+        n.reserved_resources.disk_mb = 0
         n.attributes["kernel.name"] = "linux"
         n.attributes["rack"] = f"r{i % 64}"
         n.attributes["zone"] = f"z{i % 16}"
@@ -203,44 +211,39 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     gp_need = (MERGED_GP_MAX if merge
                else len(probe_job.task_groups) * epc)
     kp_need = count * epc
+    # exact mode uses serial-fidelity stacking commits (the reference's
+    # per-placement best-fit packing — placement QUALITY over wave
+    # count), with a budget deep enough to stack a full group
     rs = ResidentSolver(nodes, asks_for(probe_job),
                         gp=1 << max(0, (gp_need - 1).bit_length()),
                         kp=1 << max(0, (kp_need - 1).bit_length()),
-                        max_waves=18)   # deeper budget: fewer drain calls
+                        max_waves=(24 if exact else 18),
+                        stack_commit=exact)
     rs.reset_usage(used0=resident_used0(rs.template, n_nodes, resident))
 
     # build the whole eval workload up front (job objects are cheap)
     jobs = [make_job(config, e, count) for e in range(n_evals)]
 
-    # stacked single-fetch helpers (one D2H round trip for all chunks)
+    # stacked single-fetch helper for drain rounds
     stack_jit = jax.jit(lambda *xs: jnp.stack(xs))
-    concat_jit = jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
 
-    # Each async dispatch costs ~15-20ms of fixed transport overhead on
-    # top of its work, so per-batch calls are ruinous for light configs;
-    # a single fused call can't overlap host packing with device
-    # compute.  TWO fused calls split the difference: pack half 2 while
-    # half 1 solves, then one concatenated fetch.
+    # Ask packing is cheap relative to the transport round trip
+    # (~45ms of pack vs ~90ms RTT per call at config-2 scale), so the
+    # fastest schedule is ONE fused call for the whole workload: pack
+    # everything, dispatch once, fetch once.  (The previous two-call
+    # pipeline paid a second RTT to hide half the pack time — a net
+    # loss; measured 287K -> 390K placements/s on config 2.)
     NB = -(-n_evals // epc)
-    H1 = NB - NB // 2
-    H2 = NB - H1
-    # warm the compiles with the real batch shapes, then reset:
-    # both half-stream sizes, the concat fetch, and the drain-path
-    # variants (B=1 streams, small per-group counts -> the kernel's
-    # floor group_count_hint bucket)
+    # warm the compiles with the real batch shapes, then reset: the
+    # full-stream size and the drain-path variants (B=1 streams, small
+    # per-group counts -> the kernel's floor group_count_hint bucket)
     warm_asks = sum((asks_for(j) for j in jobs[:epc]), [])
     if merge:
         warm_asks, _wk = rs.merge_asks(warm_asks)
     warm = rs.pack_batch(warm_asks)
     warm.job_keys = None        # compile-only: bypass the same-job guard
-    wout1 = rs.solve_stream_async([warm] * H1,
-                                  seeds=None if exact else list(range(H1)))
-    if H2:
-        wout2 = rs.solve_stream_async(
-            [warm] * H2, seeds=None if exact else list(range(H2)))
-        np.asarray(concat_jit(wout1, wout2))
-    else:
-        np.asarray(wout1)
+    np.asarray(rs.solve_stream_async(
+        [warm] * NB, seeds=None if exact else list(range(NB))))
     wout_b1 = rs.solve_stream_async([warm], seeds=None if exact else [1])
     for nd in (1, 2, 3, 4):     # drain fetch stacks (B=1 calls)
         np.asarray(stack_jit(*([wout_b1] * nd)))
@@ -256,7 +259,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     placed = failed = retried = unresolved = 0
     n_calls = 0
     t_start = time.perf_counter()
-    # pipelined main stream: two fused half-calls, pack overlapping solve
+    # single-fused-call main stream: pack all, dispatch once, fetch once
     asks_all = []
     batches = []
 
@@ -274,18 +277,11 @@ def run_ours(config, n_nodes, n_evals, count, resident,
             out.append(pb)
         return out
 
-    g1 = pack_range(0, H1 * epc)
+    g1 = pack_range(0, n_evals)
     out1 = rs.solve_stream_async(
-        g1, seeds=None if exact else list(range(1, H1 + 1)))
+        g1, seeds=None if exact else list(range(1, NB + 1)))
     n_calls += 1
-    if H2:
-        g2 = pack_range(H1 * epc, n_evals)
-        out2 = rs.solve_stream_async(
-            g2, seeds=None if exact else list(range(H1 + 1, NB + 1)))
-        n_calls += 1
-        packed = np.asarray(concat_jit(out1, out2))    # ONE fetch
-    else:
-        packed = np.asarray(out1)
+    packed = np.asarray(out1)                          # ONE fetch
     status = packed[:, :, -1].astype(np.int32)         # [NB, K]
 
     # wave-budget leftovers: resubmit ONLY the undecided counts, all
@@ -510,29 +506,19 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
         [make_nodes(n_nodes) for _ in range(n_regions)],
         asks_for(probe_job), gp=MERGED_GP_MAX,
         kp=1 << max(0, (count * epc - 1).bit_length()), max_waves=18)
-    concat_jit = jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
     used0_region = resident_used0(fed.solvers[0].template, n_nodes,
                                   resident)
     used0 = np.stack([used0_region] * n_regions)
 
-    # two fused half-calls (see run_ours: per-call transport overhead vs
-    # pack/compute overlap), each covering every region's half-stream
-    H1 = NB - NB // 2
-    H2 = NB - H1
+    # single fused call covering every region's full stream (see
+    # run_ours: packing is cheap next to the per-call round trip)
     wasks, _wk = fed.merge_asks(0, sum(
         (asks_for(make_job(5, 9000 + e, count)) for e in range(epc)), []))
     warm = fed.pack_batch(0, wasks)
     warm.job_keys = None
-    wout1 = fed.solve_stream_async(
-        [[warm] * H1] * n_regions,
-        seeds=[list(range(1, H1 + 1))] * n_regions)
-    if H2:
-        wout2 = fed.solve_stream_async(
-            [[warm] * H2] * n_regions,
-            seeds=[list(range(1, H2 + 1))] * n_regions)
-        np.asarray(concat_jit(wout1, wout2))
-    else:
-        np.asarray(wout1)
+    np.asarray(fed.solve_stream_async(
+        [[warm] * NB] * n_regions,
+        seeds=[list(range(1, NB + 1))] * n_regions))
     fed.reset_usage(used0=used0)
     startup_s = time.perf_counter() - t0
 
@@ -553,18 +539,11 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
                 per_region[r].append(pb)
         return per_region
 
-    g1 = pack_steps(0, H1)
+    g1 = pack_steps(0, NB)
     out1 = fed.solve_stream_async(
-        g1, seeds=[[r * NB + b + 1 for b in range(H1)]
+        g1, seeds=[[r * NB + b + 1 for b in range(NB)]
                    for r in range(n_regions)])
-    if H2:
-        g2 = pack_steps(H1, NB)
-        out2 = fed.solve_stream_async(
-            g2, seeds=[[r * NB + H1 + b + 1 for b in range(H2)]
-                       for r in range(n_regions)])
-        packed = np.asarray(concat_jit(out1, out2))   # ONE fetch
-    else:
-        packed = np.asarray(out1)
+    packed = np.asarray(out1)                         # ONE fetch
     status = packed[:, :, :, -1].astype(np.int32)     # [NB, R, K]
 
     placed = failed = unresolved = 0
@@ -581,7 +560,7 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
                   "region-fused device calls",
         "evals": total_evals, "placements": placed, "failed": failed,
         "retried": 0, "unresolved": unresolved,
-        "n_device_calls": 2 if H2 else 1,
+        "n_device_calls": 1,
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
@@ -705,13 +684,21 @@ def main():
             [sys.executable, os.path.abspath(__file__), "--one", str(c)],
             capture_output=True, text=True)
         rec = None
-        if out.returncode == 0:
-            for line in out.stdout.splitlines():
-                if line.startswith("\x1e"):
+        # a teardown crash AFTER the record printed must not discard
+        # the measurement (r3 ran config 5 twice for this reason):
+        # trust the record line regardless of exit code
+        for line in out.stdout.splitlines():
+            if line.startswith("\x1e"):
+                try:
                     rec = json.loads(line[1:])
+                except json.JSONDecodeError:
+                    rec = None
+        if out.returncode != 0:
+            sys.stderr.write(
+                f"config {c} subprocess exited {out.returncode} "
+                f"({'record salvaged' if rec else 'no record'}):\n"
+                f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}\n")
         if rec is None:
-            sys.stderr.write(f"config {c} subprocess failed:\n"
-                             f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}\n")
             rec = run_config(c)        # in-process fallback
         results.append(rec)
     rtt = measure_transport_rtt()

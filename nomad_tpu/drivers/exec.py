@@ -144,6 +144,16 @@ class ExecDriver(RawExecDriver):
             pid = os.fork()
             if pid == 0:
                 return                 # grandchild: execs the command
+            # drop every inherited fd: the intermediate never execs,
+            # so subprocess's CLOEXEC error pipe (and the pty master /
+            # sockets) would otherwise stay open here and the parent's
+            # Popen() would block until the command EXITS — a deadlock
+            # for interactive exec
+            try:
+                hi = os.sysconf("SC_OPEN_MAX")
+            except (ValueError, OSError):
+                hi = 65536
+            os.closerange(3, min(max(hi, 4096), 1 << 20))
             for s in (_sig.SIGTERM, _sig.SIGINT, _sig.SIGHUP,
                       _sig.SIGQUIT):
                 _sig.signal(s, lambda n, f, p=pid: os.kill(p, n))

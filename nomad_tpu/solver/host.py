@@ -16,13 +16,13 @@ device's `approx_max_k` path, so host argsort and device top_k agree.
 """
 from __future__ import annotations
 
-from typing import NamedTuple
+import os
 
 import numpy as np
 
 from .kernel import (MAX_WAVES, MERGED_GP_MAX, NEG_INF, TOP_K, WAVE_K,
-                     _APPROX_MIN_NP, _MERGED_W_CAP, _WIDE_W_CAP,
-                     SolveResult)
+                     _APPROX_MIN_NP, _MERGED_W_CAP, _SELECT_SUM_MAX_V,
+                     _WIDE_W_CAP, SolveResult)
 from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT,
                         OP_NE, OP_NOT_SET, R_CPU, R_MEM)
 
@@ -41,24 +41,6 @@ def prefer_host(n_nodes_padded: int, n_asks: int, n_place: int) -> bool:
     return (n_nodes_padded < _APPROX_MIN_NP
             and n_place <= HOST_MAX_PLACE
             and n_nodes_padded * max(n_asks, 1) <= HOST_MAX_CELLS)
-
-
-def _op_eval(vals: np.ndarray, op: np.ndarray, rank: np.ndarray
-             ) -> np.ndarray:
-    """Numpy twin of kernel._op_eval (feasible.go:671 semantics)."""
-    found = vals >= 0
-    eq = found & (vals == rank[None, :])
-    res = np.ones_like(found)
-    opb = op[None, :]
-    res = np.where(opb == OP_EQ, eq, res)
-    res = np.where(opb == OP_NE, ~eq, res)
-    res = np.where(opb == OP_LT, found & (vals < rank[None, :]), res)
-    res = np.where(opb == OP_LE, found & (vals <= rank[None, :]), res)
-    res = np.where(opb == OP_GT, found & (vals > rank[None, :]), res)
-    res = np.where(opb == OP_GE, found & (vals >= rank[None, :]), res)
-    res = np.where(opb == OP_IS_SET, found, res)
-    res = np.where(opb == OP_NOT_SET, ~found, res)
-    return res
 
 
 def _top_k(score: np.ndarray, k: int):
@@ -81,12 +63,14 @@ def _static_program(avail, valid, node_dc, attr_rank, dc_ok,
     f32 = np.float32
     key = None
     if cache is not None:
-        key = hash((c_op.tobytes(), c_col.tobytes(), c_rank.tobytes(),
-                    a_op.tobytes(), a_col.tobytes(), a_rank.tobytes(),
-                    a_weight.tobytes(), a_host.tobytes(),
-                    dc_ok.tobytes(), host_ok.tobytes(),
-                    sp_col.tobytes(), sp_desired.tobytes(),
-                    sp_implicit.tobytes(), bool(has_spread)))
+        # the bytes themselves key the dict (equality-checked) — a
+        # 64-bit pre-hash could silently collide two programs
+        key = (c_op.tobytes(), c_col.tobytes(), c_rank.tobytes(),
+               a_op.tobytes(), a_col.tobytes(), a_rank.tobytes(),
+               a_weight.tobytes(), a_host.tobytes(),
+               dc_ok.tobytes(), host_ok.tobytes(),
+               sp_col.tobytes(), sp_desired.tobytes(),
+               sp_implicit.tobytes(), bool(has_spread))
         hit = cache.get(key)
         if hit is not None:
             return hit
@@ -168,6 +152,7 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                       sp_implicit, sp_used0, dev_cap, dev_used0, dev_ask,
                       p_ask, n_place, seed=0, *, has_spread=True,
                       group_count_hint=0, max_waves=0,
+                      stack_commit=False,
                       static_cache=None) -> SolveResult:
     """Numpy port of kernel.solve_kernel — see that docstring for the
     wave semantics.  Every formula, window size, and tie-break matches;
@@ -323,8 +308,9 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             used, dev_used, coll, sp_used, blocked)
         top_score, top_idx = _top_k(score, TK)
 
-        # spread-aware candidate interleaving (kernel's slot-0 path)
-        if has_spread and Vs <= 8:
+        # spread-aware candidate interleaving (kernel's slot-0 path;
+        # bypassed in stack mode — see kernel.py)
+        if has_spread and Vs <= 8 and not stack_commit:
             has0 = sp_col[:, 0] >= 0
             vnode = sp_vnode[0]
             TKv = -(-TK // (Vs + 1))
@@ -369,7 +355,11 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         g_off = (np.zeros(Gp, np.int32) if seed == 0 else
                  ((g_hash >> u32(8)) % u32(W)).astype(np.int32))
         rot = 0 if seed == 0 else wave
-        cr = (rank + g_off[g_idx] + rot) % M[g_idx]
+        if stack_commit:
+            # serial-fidelity commits (kernel.py stack_commit note)
+            cr = np.zeros_like(rank)
+        else:
+            cr = (rank + g_off[g_idx] + rot) % M[g_idx]
         cand = top_idx[g_idx, cr].astype(np.int64)
         cand_score = top_score[g_idx, cr]
         cand_ok = active & (cand_score > NEG_INF / 2)
@@ -436,19 +426,42 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                                             np.inf, 0.0)),
                           axis=1)[:, None]
             minc = np.where(np.isfinite(minc), minc, 0.0).astype(f32)
+            # even-spread quota for the first half of the wave budget
+            # only (kernel.py quota block note)
             share = np.ceil(act_g.astype(f32) / V)[:, None]
             level = np.maximum(maxc, minc + share)
+            even_q = (np.maximum(f32(1.0), level - use_s)
+                      if wave < max(max_waves // 2, 1)
+                      else np.full_like(use_s, np.inf))
             quota = np.where(
                 np.asarray(sp_targeted[:, s])[:, None],
                 np.maximum(f32(1.0), des_eff - use_s),
-                np.maximum(f32(1.0), level - use_s))
+                even_q)
             gv_key = (g_idx * np.int64(V) + vsc) * np.int64(2) + 1
             gv_rank = prior_rank(gv_key, has_s).astype(f32)
+            if os.environ.get("NOMAD_TPU_HOST_DEBUG") == "quota":
+                g = int(os.environ.get("NOMAD_TPU_HOST_DEBUG_G", "2"))
+                cand_vals = np.where((g_idx == g) & has_s, vsc, -1)
+                print(f"  w{wave} s{s} g{g}: use {use_s[g]} "
+                      f"quota {quota[g]} "
+                      f"cand-per-val {[int((cand_vals == v).sum()) for v in range(V)]}")
             # gather clamps (XLA OOB semantics) — the key stays exact
             sp_ok &= ~has_s | (gv_rank
                                < quota[g_idx, np.minimum(vsc, V - 1)])
 
         commit = cand_ok & fits & dev_fits & dg_ok & sp_ok
+        if os.environ.get("NOMAD_TPU_HOST_DEBUG"):
+            for g in range(Gp):
+                m = active & (g_idx == g)
+                if not m.any():
+                    continue
+                print(f"  w{wave} g{g}: act {int(m.sum())} "
+                      f"placeable {int(placeable[g].sum())} "
+                      f"n_cand {int(n_cand[g])} M {int(M[g])} "
+                      f"cand_ok {int((m & cand_ok).sum())} "
+                      f"fits {int((m & cand_ok & fits).sum())} "
+                      f"sp_ok {int((m & cand_ok & sp_ok).sum())} "
+                      f"commit {int((m & commit).sum())}")
         cm = commit[:, None]
 
         np.add.at(used, cand, ask_res[g_idx] * cm)
@@ -499,10 +512,12 @@ class HostResidentSolver:
     the device stream (tests/test_host_solver.py)."""
 
     def __init__(self, nodes, probe_asks, allocs_by_node=None,
-                 gp=None, kp=None, max_waves: int = 0):
+                 gp=None, kp=None, max_waves: int = 0,
+                 stack_commit: bool = False):
         from .tensorize import Tensorizer
         self.nodes = list(nodes)
         self.max_waves = max_waves
+        self.stack_commit = stack_commit
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
         self.gp = gp or self.template.ask_res.shape[0]
@@ -565,6 +580,7 @@ class HostResidentSolver:
                 pb.sp_used0, t.dev_cap, self._dev_used, pb.dev_ask,
                 pb.p_ask, pb.n_place, seed, has_spread=has_spread,
                 group_count_hint=hint, max_waves=self.max_waves,
+                stack_commit=self.stack_commit,
                 static_cache=self._static_cache)
             self._used = res.used_final
             self._dev_used = res.dev_used_final

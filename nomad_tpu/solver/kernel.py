@@ -114,7 +114,8 @@ class SolveResult(NamedTuple):
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "wave_mode",
-                                    "has_distinct", "has_devices"))
+                                    "has_distinct", "has_devices",
+                                    "stack_commit"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -124,7 +125,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  seed=0, *, has_spread=True,
                  group_count_hint=0, max_waves=0,
                  wave_mode="scan", has_distinct=True,
-                 has_devices=True) -> SolveResult:
+                 has_devices=True, stack_commit=False) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -391,8 +392,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # holes (exhausted values) compact to the tail to keep the
         # rank-wrap contiguous. Skipped for huge vocabularies where
         # per-value extraction would dominate.
+        # (skipped in stack_commit mode: stacking aims every placement
+        # at slot 0, and the reference picks the max TOTAL score — the
+        # spread term is already inside the score; forcing slot 0 to
+        # the spread-preferred value would override the argmax)
         Vs = sp_desired.shape[2]
-        if has_spread and Vs <= 8:
+        if has_spread and Vs <= 8 and not stack_commit:
             has0 = sp_col[:, 0] >= 0                       # [Gp]
             vnode = sp_vnode[0]                            # [Gp, Np]
             # one class per value PLUS a class for nodes MISSING the
@@ -471,7 +476,19 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # step of 1 is coprime with every window size M (a fixed larger
         # step would be a no-op for groups where M divides it)
         rot = jnp.where(jnp.int32(seed) == 0, 0, wave)
-        cr = (rank + g_off[g_idx] + rot) % M[g_idx]
+        if stack_commit:
+            # serial-fidelity mode (quality/exact path): every active
+            # placement of a group aims at the group's CURRENT best
+            # node; the cumulative per-node fit below commits as many
+            # as actually fit and the rest re-score next wave against
+            # updated usage — the reference's per-placement best-fit
+            # stacking (rank.go:149 BinPackIterator), wave-batched.
+            # Fan-out mode spreads a group across its top-W nodes in
+            # one wave (fast), but fragments capacity near the packing
+            # limit; stacking trades waves for the reference's quality.
+            cr = jnp.zeros_like(rank)
+        else:
+            cr = (rank + g_off[g_idx] + rot) % M[g_idx]
         cand = top_idx[g_idx, cr]                          # [K]
         cand_score = top_score[g_idx, cr]
         cand_ok = active & (cand_score > NEG_INF / 2)
@@ -574,16 +591,24 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             minc = jnp.where(jnp.isfinite(minc), minc, 0.0)
             # even spread: every value may grow to a common level L =
             # max(current max, min + fair share of this wave's active
-            # placements). A balanced group fills all values in one
-            # wave; per-wave imbalance is bounded by the share and
-            # corrected by the next wave's rescoring (the serial
-            # reference corrects per placement instead).
+            # placements) — for the FIRST HALF of the wave budget.
+            # Near capacity the min value may be almost exhausted yet
+            # keep absorbing a node or two per wave; anchored to it,
+            # every other value's quota collapses to 1/wave and the
+            # batch stalls (config 3's retry storm).  The serial
+            # reference only ever steers by SCORE (spread.go penalizes
+            # an overfilled value, never hard-blocks), so after the
+            # balanced half-budget the quota relaxes and the remaining
+            # placements fill whatever capacity exists, score-steered.
             share = jnp.ceil(act_g.astype(jnp.float32) / V)[:, None]
             level = jnp.maximum(maxc, minc + share)
+            even_q = jnp.where(wave < jnp.int32(max(max_waves // 2, 1)),
+                               jnp.maximum(1.0, level - use_s),
+                               jnp.inf)
             quota = jnp.where(
                 sp_targeted[:, s][:, None],
                 jnp.maximum(1.0, des_eff - use_s),
-                jnp.maximum(1.0, level - use_s))           # [Gp, V]
+                even_q)                                    # [Gp, V]
             gv_key = (g_idx * jnp.int32(V) + vsc) * jnp.int32(2) + 1
             gv_rank = prior_rank(gv_key, has_s).astype(jnp.float32)
             sp_ok &= ~has_s | (gv_rank < quota[g_idx, vsc])

@@ -58,7 +58,7 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
                group_count_hint=0, max_waves=0, wave_mode="scan",
-               has_distinct=True, has_devices=True):
+               has_distinct=True, has_devices=True, stack_commit=False):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -71,7 +71,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         dev_cap, dev_used, batch["dev_ask"], batch["p_ask"], n_place,
         seed, has_spread=has_spread, group_count_hint=group_count_hint,
         max_waves=max_waves, wave_mode=wave_mode,
-        has_distinct=has_distinct, has_devices=has_devices)
+        has_distinct=has_distinct, has_devices=has_devices,
+        stack_commit=stack_commit)
 
 
 @functools.partial(jax.jit,
@@ -151,12 +152,13 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "wave_mode",
-                                    "has_distinct", "has_devices"))
+                                    "has_distinct", "has_devices",
+                                    "stack_commit"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
                    has_spread=True, group_count_hint=0, max_waves=0,
                    wave_mode="scan", has_distinct=True,
-                   has_devices=True):
+                   has_devices=True, stack_commit=False):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device."""
 
@@ -166,7 +168,8 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used, dev_used, batch, n_place, seed,
                          has_spread, group_count_hint, max_waves,
-                         wave_mode, has_distinct, has_devices)
+                         wave_mode, has_distinct, has_devices,
+                         stack_commit)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -195,10 +198,12 @@ class ResidentSolver:
                  probe_asks: Sequence[PlacementAsk],
                  allocs_by_node: Optional[Dict[str, list]] = None,
                  gp: Optional[int] = None, kp: Optional[int] = None,
-                 max_waves: int = 0, wave_mode: str = "scan"):
+                 max_waves: int = 0, wave_mode: str = "scan",
+                 stack_commit: bool = False):
         self.nodes = list(nodes)
         self.max_waves = max_waves        # 0 = kernel default
         self.wave_mode = wave_mode        # see kernel.py loop-shape note
+        self.stack_commit = stack_commit  # serial-fidelity commits
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
         self.gp = gp or self.template.ask_res.shape[0]
@@ -329,7 +334,8 @@ class ResidentSolver:
             group_count_hint=self._group_count_hint(batches),
             max_waves=self.max_waves, wave_mode=self.wave_mode,
             has_distinct=self._has_distinct(batches),
-            has_devices=self._has_devices(batches))
+            has_devices=self._has_devices(batches),
+            stack_commit=self.stack_commit)
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
