@@ -167,6 +167,28 @@ class Allocations(_Sub):
     def stop(self, alloc_id: str) -> dict:
         return self.c.post(f"/v1/allocation/{alloc_id}/stop")[0]
 
+    def logs(self, alloc_id: str, task: str = "",
+             type: str = "stdout", tail_lines: int = 0) -> str:
+        """Task log contents (routed to the owning agent by the server
+        — reference: api/fs.go Logs)."""
+        params = {"type": type}
+        if task:
+            params["task"] = task
+        if tail_lines:
+            params["tail_lines"] = tail_lines
+        out, _ix = self.c.get(f"/v1/client/fs/logs/{alloc_id}", **params)
+        return out.get("data", "")
+
+    def exec(self, alloc_id: str, cmd, task: str = "",
+             timeout_s: float = 30.0) -> dict:
+        """One-shot exec in the task's context; returns
+        {"output", "exit_code"} (routed to the owning agent)."""
+        body = {"cmd": [str(c) for c in cmd], "timeout_s": timeout_s}
+        if task:
+            body["task"] = task
+        return self.c.post(
+            f"/v1/client/allocation/{alloc_id}/exec", body)[0]
+
     def exec_stream(self, alloc_id: str, command, task: str = "",
                     tty: bool = True, stdin_fd=None, stdout_fd=1,
                     tty_size=None, timeout: float = 3600.0) -> int:

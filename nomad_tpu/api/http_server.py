@@ -23,6 +23,10 @@ from ..structs import Evaluation, Job, Plan, PlanResult
 from ..utils.codec import from_wire, to_wire
 from ..utils.metrics import global_metrics
 
+import logging
+
+_log = logging.getLogger(__name__)
+
 MAX_BLOCK_S = 300.0     # reference: nomad/rpc.go:35 maxQueryTime
 
 
@@ -137,6 +141,7 @@ class HTTPAgentServer:
 
             def do_DELETE(self): self._handle("DELETE")
 
+        self._tl = threading.local()     # per-request token (for proxying)
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -151,6 +156,27 @@ class HTTPAgentServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        # advertise this agent's HTTP address on its node so any server
+        # can route client endpoints (logs/exec/fs/stats) to the owning
+        # agent (reference: servers reach clients over persistent
+        # nodeConns, nomad/server.go:151-153 + nomad/client_rpc.go; the
+        # TPU build routes over the agent HTTP surface instead — unique.
+        # prefix keeps it out of the computed class)
+        if self.client is not None:
+            host, port = self._httpd.server_address[:2]
+            if host in ("0.0.0.0", "::", ""):
+                # wildcard bind: advertise the node's fingerprinted
+                # address so cross-host routing reaches THIS machine
+                nets = self.client.node.node_resources.networks
+                host = (nets[0].ip if nets and nets[0].ip
+                        else "127.0.0.1")
+            self.client.node.attributes["unique.advertise.http"] = \
+                f"{host}:{port}"
+            try:
+                self.client.servers.register_node(self.client.node)
+            except Exception:
+                _log.warning("could not re-register node with advertise "
+                             "address", exc_info=True)
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -170,6 +196,7 @@ class HTTPAgentServer:
             if fn is None:
                 raise HTTPError(405, f"method {method} not allowed")
             self._enforce_acl(method, url.path, q, body, token)
+            self._tl.token = token
             return fn(q, body, *m.groups())
         raise HTTPError(404, f"no handler for {url.path}")
 
@@ -620,10 +647,14 @@ class HTTPAgentServer:
             self.server.store.latest_index()
 
     def client_logs(self, q, body, alloc_id):
-        """Task log contents from the local agent (reference:
-        client/fs_endpoint.go logs; plain read of the alloc dir's
-        rotated log files, ?task= and ?type=stdout|stderr, tail via
-        ?offset/?limit or ?tail_lines)."""
+        """Task log contents (reference: client/fs_endpoint.go logs;
+        plain read of the alloc dir's rotated log files, ?task= and
+        ?type=stdout|stderr, tail via ?offset/?limit or ?tail_lines).
+        Routed to the owning agent when the alloc is not local."""
+        remote = self._client_route(alloc_id, q)
+        if remote is not None:
+            return self._proxy_client_http(
+                remote, "GET", f"/v1/client/fs/logs/{alloc_id}", q, None)
         if self.client is None:
             raise HTTPError(400, "no client agent on this node")
         runner = self.client.get_alloc_runner(alloc_id)
@@ -688,6 +719,8 @@ class HTTPAgentServer:
         parts = urlsplit(handler.path)
         q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
         token = handler.headers.get("X-Nomad-Token", "")
+        if handler.headers.get("X-Nomad-Routed"):
+            q["_routed"] = "1"      # never bounce a forwarded upgrade
 
         def refuse(code: int, msg: str) -> None:
             data = json.dumps({"error": msg}).encode()
@@ -696,10 +729,13 @@ class HTTPAgentServer:
                     f"Content-Length: {len(data)}\r\n\r\n")
             handler.connection.sendall(resp.encode() + data)
 
+        remote = None
         try:
             self._enforce_acl("POST", parts.path, q, None, token)
             alloc_id = parts.path.split("/")[4]
-            tr = self._resolve_task_runner(alloc_id, q.get("task"))
+            remote = self._client_route(alloc_id, q)
+            if remote is None:
+                tr = self._resolve_task_runner(alloc_id, q.get("task"))
             cmd = json.loads(q.get("command") or "[]")
             if not isinstance(cmd, list) or not cmd:
                 raise HTTPError(400, "query param 'command' must be a "
@@ -712,6 +748,16 @@ class HTTPAgentServer:
             return
         except Exception as e:
             refuse(500, str(e))
+            return
+
+        if remote is not None:
+            # splice the upgrade through to the owning agent
+            # (reference: the alloc-exec stream forwarded over
+            # nodeConns — nomad/client_alloc_endpoint.go)
+            try:
+                self._tunnel_ws(handler, remote)
+            except OSError as e:
+                refuse(502, f"routing to {remote} failed: {e}")
             return
 
         # spawn only after the request is fully validated; if the
@@ -793,6 +839,127 @@ class HTTPAgentServer:
             out_t.join(timeout=6.0)
             stream.close()
 
+    # -------------------------------------------- server->client routing
+    def _client_route(self, alloc_prefix: str,
+                      q: Optional[Dict[str, str]] = None
+                      ) -> Optional[str]:
+        """Which agent owns this alloc?  None = this one (serve
+        locally); otherwise the owning node's advertised HTTP address
+        to route to (reference: nomad/client_rpc.go — any server
+        forwards client RPCs to the node over a persistent connection;
+        here the agent's advertised HTTP surface is the conduit)."""
+        if q and q.get("_routed"):
+            # already forwarded once: answer locally or fail — never
+            # bounce a request around the cluster
+            return None
+        if self.client is not None:
+            if (self.client.get_alloc_runner(alloc_prefix) is not None
+                or any(aid.startswith(alloc_prefix)
+                       for aid in list(self.client.runners))):
+                return None
+        matches = [al for al in self.server.store.allocs()
+                   if al.id.startswith(alloc_prefix)]
+        # prefer live allocs, but still route terminal ones — the
+        # owning agent keeps terminal runners (and their logs) around
+        live = [al for al in matches if not al.terminal_status()]
+        pool = live or matches
+        if len(pool) > 1:
+            raise HTTPError(400, f"ambiguous alloc prefix "
+                                 f"{alloc_prefix!r}")
+        if not pool:
+            raise HTTPError(404, f"alloc {alloc_prefix} not found")
+        alloc = pool[0]
+        if (self.client is not None
+                and alloc.node_id == self.client.node.id):
+            return None
+        node = self.server.store.node_by_id(alloc.node_id)
+        addr = (node.attributes.get("unique.advertise.http", "")
+                if node else "")
+        if not addr:
+            raise HTTPError(
+                502, f"node {alloc.node_id[:8]} has no advertised "
+                     "agent address to route to")
+        return addr
+
+    def _proxy_client_http(self, remote: str, method: str, path: str,
+                           q: Dict[str, str], body):
+        """Forward one client-endpoint request to the owning agent and
+        relay its JSON reply."""
+        import http.client as hc
+        from urllib.parse import urlencode
+        qs = urlencode(dict(q, _routed="1"))
+        # the forwarded request may itself run a command with a
+        # caller-chosen timeout; allow it to finish plus slack
+        try:
+            budget = float((body or {}).get("timeout_s", 0)) + 30.0
+        except (TypeError, ValueError):
+            budget = 30.0
+        conn = hc.HTTPConnection(remote, timeout=max(60.0, budget))
+        try:
+            conn.request(
+                method, f"{path}?{qs}",
+                body=(json.dumps(body) if body is not None else None),
+                headers={"X-Nomad-Token":
+                         getattr(self._tl, "token", "") or "",
+                         "Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+        except OSError as e:
+            raise HTTPError(502, f"routing to {remote} failed: {e}")
+        finally:
+            conn.close()
+        try:
+            payload = json.loads(data) if data else {}
+        except json.JSONDecodeError:
+            payload = {"error": data.decode("utf-8", "replace")}
+        if resp.status != 200:
+            raise HTTPError(resp.status,
+                            payload.get("error", f"agent {remote} "
+                                                 f"replied {resp.status}"))
+        idx = resp.getheader("X-Nomad-Index")
+        return 200, payload, (int(idx) if idx else None)
+
+    def _tunnel_ws(self, handler, remote: str) -> None:
+        """Splice a websocket upgrade through to the owning agent:
+        replay the request bytes, then pump both directions until
+        either side closes (the exec stream's routed form)."""
+        import socket as _socket
+        host, _, port = remote.rpartition(":")
+        rsock = _socket.create_connection((host, int(port)), timeout=60)
+        rsock.settimeout(None)   # connect-only timeout: an idle
+        # interactive session must not be torn down after 60s of quiet
+        lines = [f"{handler.command} {handler.path} HTTP/1.1",
+                 f"Host: {remote}", "X-Nomad-Routed: 1"]
+        for k, v in handler.headers.items():
+            if k.lower() in ("host", "x-nomad-routed"):
+                continue
+            lines.append(f"{k}: {v}")
+        rsock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        csock = handler.connection
+
+        def pump(src, dst):
+            try:
+                while True:
+                    chunk = src.recv(65536)
+                    if not chunk:
+                        break
+                    dst.sendall(chunk)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=pump, args=(rsock, csock),
+                             daemon=True)
+        t.start()
+        pump(csock, rsock)
+        t.join(timeout=10.0)
+        rsock.close()
+
     def _resolve_task_runner(self, alloc_id: str, task):
         """Find the local task runner for (alloc prefix, task name)."""
         if self.client is None:
@@ -818,9 +985,15 @@ class HTTPAgentServer:
     def client_exec(self, q, body, alloc_id):
         """One-shot command execution inside a task's context
         (reference: alloc exec, plugins/drivers ExecTask — the one-shot
-        form; see handle_exec_ws for the interactive pty stream)."""
+        form; see handle_exec_ws for the interactive pty stream).
+        Routed to the owning agent when the alloc is not local."""
         if not body or not body.get("cmd"):
             raise HTTPError(400, "body must carry 'cmd' (list)")
+        remote = self._client_route(alloc_id, q)
+        if remote is not None:
+            return self._proxy_client_http(
+                remote, "POST", f"/v1/client/allocation/{alloc_id}/exec",
+                q, body)
         tr = self._resolve_task_runner(alloc_id, body.get("task"))
         try:
             timeout_s = float(body.get("timeout_s", 30.0))

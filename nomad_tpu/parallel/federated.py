@@ -98,10 +98,23 @@ class FederatedResidentSolver:
                  max_waves: int = 0):
         if not region_nodes:
             raise ValueError("need at least one region")
-        self.solvers: List[ResidentSolver] = [
-            ResidentSolver(nodes, probe_asks, gp=gp, kp=kp,
-                           max_waves=max_waves)
-            for nodes in region_nodes]
+        # regions passed the SAME node-list object share one packed
+        # template and tensorizer (packing a 10K-node universe costs
+        # ~1s; usage stays per-region in the fed-level stacks, so
+        # sharing is purely a pack-once optimization)
+        # keep the keyed list object alive alongside its solver: a
+        # freed list's id could be reused by a different region's list
+        # and silently alias their universes
+        shared: Dict[int, Tuple[object, ResidentSolver]] = {}
+        self.solvers = []
+        for nodes in region_nodes:
+            entry = shared.get(id(nodes))
+            if entry is None or entry[0] is not nodes:
+                entry = (nodes, ResidentSolver(nodes, probe_asks,
+                                               gp=gp, kp=kp,
+                                               max_waves=max_waves))
+                shared[id(nodes)] = entry
+            self.solvers.append(entry[1])
         self.R = len(self.solvers)
         self.gp = self.solvers[0].gp
         self.kp = self.solvers[0].kp

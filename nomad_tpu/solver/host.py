@@ -16,8 +16,6 @@ device's `approx_max_k` path, so host argsort and device top_k agree.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 from .kernel import (MAX_WAVES, MERGED_GP_MAX, NEG_INF, TOP_K, WAVE_K,
@@ -439,29 +437,11 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 even_q)
             gv_key = (g_idx * np.int64(V) + vsc) * np.int64(2) + 1
             gv_rank = prior_rank(gv_key, has_s).astype(f32)
-            if os.environ.get("NOMAD_TPU_HOST_DEBUG") == "quota":
-                g = int(os.environ.get("NOMAD_TPU_HOST_DEBUG_G", "2"))
-                cand_vals = np.where((g_idx == g) & has_s, vsc, -1)
-                print(f"  w{wave} s{s} g{g}: use {use_s[g]} "
-                      f"quota {quota[g]} "
-                      f"cand-per-val {[int((cand_vals == v).sum()) for v in range(V)]}")
             # gather clamps (XLA OOB semantics) — the key stays exact
             sp_ok &= ~has_s | (gv_rank
                                < quota[g_idx, np.minimum(vsc, V - 1)])
 
         commit = cand_ok & fits & dev_fits & dg_ok & sp_ok
-        if os.environ.get("NOMAD_TPU_HOST_DEBUG"):
-            for g in range(Gp):
-                m = active & (g_idx == g)
-                if not m.any():
-                    continue
-                print(f"  w{wave} g{g}: act {int(m.sum())} "
-                      f"placeable {int(placeable[g].sum())} "
-                      f"n_cand {int(n_cand[g])} M {int(M[g])} "
-                      f"cand_ok {int((m & cand_ok).sum())} "
-                      f"fits {int((m & cand_ok & fits).sum())} "
-                      f"sp_ok {int((m & cand_ok & sp_ok).sum())} "
-                      f"commit {int((m & commit).sum())}")
         cm = commit[:, None]
 
         np.add.at(used, cand, ask_res[g_idx] * cm)

@@ -256,6 +256,7 @@ def test_exec_ws_rejects_before_spawning(monkeypatch):
     srv = HTTPAgentServer.__new__(HTTPAgentServer)
     srv._resolve_task_runner = lambda alloc_id, task: _FakeTR()
     srv._enforce_acl = lambda *a, **kw: None
+    srv._client_route = lambda alloc_id, q=None: None   # local alloc
 
     a, b = socket.socketpair()
 
