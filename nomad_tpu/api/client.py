@@ -155,6 +155,12 @@ class Nodes(_Sub):
             f"/v1/node/{node_id}/eligibility",
             {"eligibility": "eligible" if eligible else "ineligible"})[0]
 
+    def stats(self, node_id: str = "") -> dict:
+        """Host resource gauges from a node's agent (reference:
+        /v1/client/stats; ?node_id routes to that node)."""
+        params = {"node_id": node_id} if node_id else {}
+        return self.c.get("/v1/client/stats", **params)[0]
+
 
 class Allocations(_Sub):
     def list(self, prefix: str = "", index: int = 0, wait: str = ""):
@@ -188,6 +194,58 @@ class Allocations(_Sub):
             body["task"] = task
         return self.c.post(
             f"/v1/client/allocation/{alloc_id}/exec", body)[0]
+
+    # alloc filesystem (reference: api/fs.go — routed by the server)
+    def _fs_get(self, verb: str, alloc_id: str, fs_path: str,
+                **extra):
+        # request() directly: the kwarg-based get() collides with a
+        # file param literally named "path"
+        return self.c.request(
+            "GET", f"/v1/client/fs/{verb}/{alloc_id}",
+            params=dict(extra, path=fs_path))[0]
+
+    def fs_ls(self, alloc_id: str, path: str = "/") -> List[dict]:
+        return self._fs_get("ls", alloc_id, path)["files"]
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        return self._fs_get("stat", alloc_id, path)["file"]
+
+    def fs_cat(self, alloc_id: str, path: str) -> bytes:
+        """Full file contents; pages past the server's single-response
+        cap with readat so large files come back complete."""
+        import base64
+        out = self._fs_get("cat", alloc_id, path)
+        data = base64.b64decode(out.get("data", ""))
+        total = out.get("size", len(data))
+        while out.get("truncated") and len(data) < total:
+            chunk = self.fs_readat(alloc_id, path, offset=len(data),
+                                   limit=1 << 20)
+            if not chunk:
+                break
+            data += chunk
+        return data
+
+    def fs_readat(self, alloc_id: str, path: str, offset: int = 0,
+                  limit: int = 1 << 20) -> bytes:
+        import base64
+        out = self._fs_get("readat", alloc_id, path, offset=offset,
+                           limit=limit)
+        return base64.b64decode(out.get("data", ""))
+
+    def fs_stream(self, alloc_id: str, path: str, offset: int = 0,
+                  wait: float = 2.0) -> dict:
+        """One long-poll step of a file follow; returns
+        {"data": bytes, "offset": next_offset, "size": file_size}."""
+        import base64
+        out = self._fs_get("stream", alloc_id, path, offset=offset,
+                           wait=wait)
+        out["data"] = base64.b64decode(out.get("data", ""))
+        return out
+
+    def stats(self, alloc_id: str) -> dict:
+        """Per-task resource usage (routed to the owning agent)."""
+        return self.c.get(
+            f"/v1/client/allocation/{alloc_id}/stats")[0]
 
     def exec_stream(self, alloc_id: str, command, task: str = "",
                     tty: bool = True, stdin_fd=None, stdout_fd=1,

@@ -205,6 +205,9 @@ class HTTPAgentServer:
         AMBIGUOUS prefix is rejected here so the capability check can
         never authorize against a different alloc than the handler
         resolves (both layers demand uniqueness)."""
+        exact = self.server.store.alloc_by_id(prefix)
+        if exact is not None:
+            return exact.namespace
         matches = {al.namespace for al in self.server.store.allocs()
                    if al.id.startswith(prefix)}
         if len(matches) > 1:
@@ -258,6 +261,26 @@ class HTTPAgentServer:
             if not a.allow_namespace_op(target_ns,
                                         aclmod.CAP_READ_LOGS):
                 raise HTTPError(403, "missing capability read-logs")
+            return
+        if path.startswith("/v1/client/fs/"):
+            # ls/stat/cat/readat/stream over the alloc dir: read-fs in
+            # the alloc's namespace (reference: fs_endpoint.go ACL)
+            target_ns = self._alloc_namespace(path.rsplit("/", 1)[-1])
+            if not a.allow_namespace_op(target_ns, aclmod.CAP_READ_FS):
+                raise HTTPError(403, "missing capability read-fs")
+            return
+        if (path == "/v1/client/stats"
+                or path.endswith("/stats")
+                and path.startswith("/v1/client/allocation/")):
+            # host stats = node:read; alloc stats = read-job in ns
+            if path == "/v1/client/stats":
+                if not a.allow_node_read():
+                    raise HTTPError(403, "node permission denied")
+            else:
+                target_ns = self._alloc_namespace(path.split("/")[4])
+                if not a.allow_namespace_op(target_ns,
+                                            aclmod.CAP_READ_JOB):
+                    raise HTTPError(403, "missing capability read-job")
             return
         if path.startswith("/v1/secret"):
             # secrets are write-class EVEN TO READ: a read-only job
@@ -655,17 +678,7 @@ class HTTPAgentServer:
         if remote is not None:
             return self._proxy_client_http(
                 remote, "GET", f"/v1/client/fs/logs/{alloc_id}", q, None)
-        if self.client is None:
-            raise HTTPError(400, "no client agent on this node")
-        runner = self.client.get_alloc_runner(alloc_id)
-        if runner is None:
-            # allow prefix match like the other id endpoints
-            matches = [r for aid, r in
-                       list(self.client.runners.items())
-                       if aid.startswith(alloc_id)]
-            if len(matches) != 1:
-                raise HTTPError(404, f"alloc {alloc_id} not on node")
-            runner = matches[0]
+        runner = self._local_runner(alloc_id)
         names = [t.name for t in
                  (runner.alloc.job.lookup_task_group(
                      runner.alloc.task_group).tasks
@@ -700,6 +713,145 @@ class HTTPAgentServer:
         text = data.decode("utf-8", errors="replace")
         return 200, {"task": task, "type": kind, "data": text,
                      "size": len(data)}, None
+
+    # ---------------------------------------------- fs + stats surface
+    def _local_runner(self, alloc_id: str):
+        """The local alloc runner for an id/prefix, or 404."""
+        if self.client is None:
+            raise HTTPError(400, "no client agent on this node")
+        runner = self.client.get_alloc_runner(alloc_id)
+        if runner is None:
+            matches = [r for aid, r in list(self.client.runners.items())
+                       if aid.startswith(alloc_id)]
+            if len(matches) != 1:
+                raise HTTPError(404, f"alloc {alloc_id} not on node")
+            runner = matches[0]
+        return runner
+
+    def _fs_call(self, q, alloc_id: str, verb: str, fn):
+        """Route-or-serve shared shell for the fs verbs (reference:
+        command/agent/fs_endpoint.go dispatching to the owning client
+        via server RPC)."""
+        from ..client import fs as fsmod
+        remote = self._client_route(alloc_id, q)
+        if remote is not None:
+            return self._proxy_client_http(
+                remote, "GET", f"/v1/client/fs/{verb}/{alloc_id}",
+                q, None)
+        runner = self._local_runner(alloc_id)
+        try:
+            return fn(fsmod, runner)
+        except fsmod.FSError as e:
+            raise HTTPError(e.code, e.msg)
+
+    def client_fs_ls(self, q, body, alloc_id):
+        """Directory listing inside the alloc dir (reference:
+        client/fs_endpoint.go List)."""
+        return self._fs_call(q, alloc_id, "ls", lambda fsmod, r: (
+            200, {"files": fsmod.list_dir(r.alloc_dir.root,
+                                          q.get("path", "/"))}, None))
+
+    def client_fs_stat(self, q, body, alloc_id):
+        """Stat one path (reference: client/fs_endpoint.go Stat)."""
+        return self._fs_call(q, alloc_id, "stat", lambda fsmod, r: (
+            200, {"file": fsmod.stat_path(r.alloc_dir.root,
+                                          q.get("path", "/"))}, None))
+
+    def client_fs_cat(self, q, body, alloc_id):
+        """Whole-file read (reference: fs_endpoint.go Cat) — base64 in
+        JSON so it survives the routing proxy byte-exact.  `size` is
+        the FILE's size and `truncated` is explicit so callers can
+        page the remainder with readat (the SDK does)."""
+        import base64
+
+        def run(fsmod, r):
+            st = fsmod.stat_path(r.alloc_dir.root, q.get("path", "/"))
+            data = fsmod.read_at(r.alloc_dir.root, q.get("path", "/"),
+                                 0, 1 << 24)
+            return 200, {"data": base64.b64encode(data).decode(),
+                         "encoding": "base64", "size": st["size"],
+                         "truncated": len(data) < st["size"]}, None
+        return self._fs_call(q, alloc_id, "cat", run)
+
+    def client_fs_readat(self, q, body, alloc_id):
+        """Bounded range read (reference: fs_endpoint.go ReadAt)."""
+        import base64
+
+        def run(fsmod, r):
+            try:
+                offset = int(q.get("offset", 0))
+                limit = int(q.get("limit", 1 << 20))
+            except ValueError:
+                raise HTTPError(400, "offset/limit must be integers")
+            data = fsmod.read_at(r.alloc_dir.root, q.get("path", "/"),
+                                 offset, limit)
+            return 200, {"data": base64.b64encode(data).decode(),
+                         "encoding": "base64", "offset": offset,
+                         "size": len(data)}, None
+        return self._fs_call(q, alloc_id, "readat", run)
+
+    def client_fs_stream(self, q, body, alloc_id):
+        """Follow a growing file: long-poll returning bytes past
+        ?offset (reference: fs_endpoint.go Stream's follow frames,
+        JSON-framed so it routes like everything else)."""
+        import base64
+
+        def run(fsmod, r):
+            try:
+                offset = int(q.get("offset", 0))
+                wait_s = float(q.get("wait", 2.0))
+            except ValueError:
+                raise HTTPError(400, "offset/wait must be numeric")
+            res = fsmod.stream_from(r.alloc_dir.root,
+                                    q.get("path", "/"), offset, wait_s)
+            return 200, {"data": base64.b64encode(res["data"]).decode(),
+                         "encoding": "base64",
+                         "offset": res["offset"],
+                         "size": res["size"]}, None
+        return self._fs_call(q, alloc_id, "stream", run)
+
+    def client_host_stats(self, q, body):
+        """Host resource gauges (reference: /v1/client/stats,
+        client/stats/host.go); ?node_id= routes to that node's agent."""
+        from ..client import fs as fsmod
+        node_prefix = q.get("node_id", "")
+        if (node_prefix and not q.get("_routed")
+                and (self.client is None
+                     or not self.client.node.id.startswith(node_prefix))):
+            nodes = [n for n in self.server.store.nodes()
+                     if n.id.startswith(node_prefix)]
+            if len(nodes) != 1:
+                raise HTTPError(404 if not nodes else 400,
+                                f"node {node_prefix!r} "
+                                + ("not found" if not nodes
+                                   else "is ambiguous"))
+            addr = nodes[0].attributes.get("unique.advertise.http", "")
+            if not addr:
+                raise HTTPError(502, "node has no advertised agent "
+                                     "address")
+            return self._proxy_client_http(addr, "GET",
+                                           "/v1/client/stats", q, None)
+        if self.client is None:
+            raise HTTPError(400, "no client agent on this node")
+        return 200, fsmod.host_stats(self.client.data_dir), None
+
+    def client_alloc_stats(self, q, body, alloc_id):
+        """Per-task resource usage for one alloc (reference:
+        client/allocrunner stats hooks + pid_collector)."""
+        from ..client import fs as fsmod
+        remote = self._client_route(alloc_id, q)
+        if remote is not None:
+            return self._proxy_client_http(
+                remote, "GET",
+                f"/v1/client/allocation/{alloc_id}/stats", q, None)
+        runner = self._local_runner(alloc_id)
+        tasks = {}
+        for tr in runner.task_runners:
+            ds = (tr.handle.driver_state if tr.handle else None) or {}
+            pid = ds.get("pid")
+            tasks[tr.task.name] = (fsmod.task_stats(pid) if pid
+                                   else None)
+        return 200, {"alloc_id": runner.alloc.id, "tasks": tasks}, None
 
     def handle_exec_ws(self, handler) -> None:
         """Interactive exec over a websocket (reference: the alloc-exec
@@ -857,8 +1009,10 @@ class HTTPAgentServer:
                 or any(aid.startswith(alloc_prefix)
                        for aid in list(self.client.runners))):
                 return None
-        matches = [al for al in self.server.store.allocs()
-                   if al.id.startswith(alloc_prefix)]
+        exact = self.server.store.alloc_by_id(alloc_prefix)
+        matches = [exact] if exact is not None else [
+            al for al in self.server.store.allocs()
+            if al.id.startswith(alloc_prefix)]
         # prefer live allocs, but still route terminal ones — the
         # owning agent keeps terminal runners (and their logs) around
         live = [al for al in matches if not al.terminal_status()]
@@ -1247,6 +1401,16 @@ def _build_routes(s: HTTPAgentServer):
         (R(r"^/v1/acl/token/([^/]+)$"), {"GET": s.acl_token_get,
                                          "DELETE": s.acl_token_delete}),
         (R(r"^/v1/client/fs/logs/([^/]+)$"), {"GET": s.client_logs}),
+        (R(r"^/v1/client/fs/ls/([^/]+)$"), {"GET": s.client_fs_ls}),
+        (R(r"^/v1/client/fs/stat/([^/]+)$"), {"GET": s.client_fs_stat}),
+        (R(r"^/v1/client/fs/cat/([^/]+)$"), {"GET": s.client_fs_cat}),
+        (R(r"^/v1/client/fs/readat/([^/]+)$"),
+         {"GET": s.client_fs_readat}),
+        (R(r"^/v1/client/fs/stream/([^/]+)$"),
+         {"GET": s.client_fs_stream}),
+        (R(r"^/v1/client/stats$"), {"GET": s.client_host_stats}),
+        (R(r"^/v1/client/allocation/([^/]+)/stats$"),
+         {"GET": s.client_alloc_stats}),
         (R(r"^/v1/client/allocation/([^/]+)/exec$"),
          {"POST": s.client_exec, "PUT": s.client_exec}),
         (R(r"^/v1/client/csi/plugin/([^/]+)$"),

@@ -281,6 +281,70 @@ def cmd_alloc_logs(args) -> int:
     return 0
 
 
+def cmd_alloc_fs(args) -> int:
+    """`alloc fs` (reference: command/alloc_fs.go — ls by default,
+    -stat for metadata, file paths print contents, -tail/-f follow)."""
+    api = _client(args)
+    path = args.path or "/"
+    if args.stat:
+        f = api.allocations.fs_stat(args.alloc_id, path)
+        print(f"{f['file_mode']}  {f['size']:>10}  {f['mod_time']}  "
+              f"{f['name']}")
+        return 0
+    if args.follow:
+        res = api.allocations.fs_stat(args.alloc_id, path)
+        offset = max(0, res["size"] - 2048)
+        try:
+            while True:
+                step = api.allocations.fs_stream(args.alloc_id, path,
+                                                 offset=offset, wait=2.0)
+                if step["data"]:
+                    sys.stdout.buffer.write(step["data"])
+                    sys.stdout.flush()
+                offset = step["offset"]
+        except KeyboardInterrupt:
+            return 0
+    f = api.allocations.fs_stat(args.alloc_id, path)
+    if f["is_dir"]:
+        for e in api.allocations.fs_ls(args.alloc_id, path):
+            print(f"{e['file_mode']}  {e['size']:>10}  {e['mod_time']}"
+                  f"  {e['name']}")
+    else:
+        sys.stdout.buffer.write(
+            api.allocations.fs_cat(args.alloc_id, path))
+    return 0
+
+
+def cmd_alloc_stats(args) -> int:
+    api = _client(args)
+    st = api.allocations.stats(args.alloc_id)
+    print(f"Alloc {_short(st['alloc_id'])}")
+    for task, ts in (st.get("tasks") or {}).items():
+        if ts is None:
+            print(f"  {task:<16} (not running)")
+            continue
+        rss_mb = ts["rss_bytes"] / (1 << 20)
+        print(f"  {task:<16} procs={ts['num_procs']} "
+              f"rss={rss_mb:.1f}MiB cpu_ticks={ts['cpu_ticks']}")
+    return 0
+
+
+def cmd_node_stats(args) -> int:
+    api = _client(args)
+    st = api.nodes.stats(args.node_id or "")
+    mem = st.get("memory") or {}
+    disk = st.get("disk") or {}
+    print(f"Uptime      = {st.get('uptime_s', 0):.0f}s")
+    if mem:
+        print(f"Memory used = {mem.get('used', 0) / (1 << 30):.2f}"
+              f"/{mem.get('total', 0) / (1 << 30):.2f} GiB")
+    if disk:
+        print(f"Disk used   = {disk.get('used', 0) / (1 << 30):.2f}"
+              f"/{disk.get('total', 0) / (1 << 30):.2f} GiB "
+              f"({disk.get('path', '')})")
+    return 0
+
+
 def cmd_alloc_exec(args) -> int:
     api = _client(args)
     if args.interactive or args.tty:
@@ -502,6 +566,9 @@ def build_parser() -> argparse.ArgumentParser:
     nd.add_argument("-ignore-system", dest="ignore_system",
                     action="store_true")
     nd.set_defaults(fn=cmd_node_drain)
+    nst = node.add_parser("stats", help="host resource gauges")
+    nst.add_argument("node_id", nargs="?", default=None)
+    nst.set_defaults(fn=cmd_node_stats)
     ne = node.add_parser("eligibility")
     ne.add_argument("node_id")
     grp = ne.add_mutually_exclusive_group(required=True)
@@ -540,6 +607,17 @@ def build_parser() -> argparse.ArgumentParser:
     al.add_argument("-stderr", action="store_true")
     al.add_argument("-tail", type=int, default=None)
     al.set_defaults(fn=cmd_alloc_logs)
+    af = alloc.add_parser("fs", help="inspect the allocation directory")
+    af.add_argument("alloc_id")
+    af.add_argument("path", nargs="?", default="/")
+    af.add_argument("-stat", action="store_true",
+                    help="print metadata instead of contents")
+    af.add_argument("-f", dest="follow", action="store_true",
+                    help="follow a growing file")
+    af.set_defaults(fn=cmd_alloc_fs)
+    asx = alloc.add_parser("stats", help="task resource usage")
+    asx.add_argument("alloc_id")
+    asx.set_defaults(fn=cmd_alloc_stats)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="eval_cmd", required=True)

@@ -1,0 +1,150 @@
+"""Client fs API + stats endpoints (VERDICT r3 missing item 2).
+
+Reference: client/fs_endpoint.go {List,Stat,ReadAt,Stream},
+command/agent/fs_endpoint.go routes, client/stats/host.go host gauges,
+and the task stats hooks.  Exercised through the SDK against both the
+owning agent and a routing (non-owning) agent, plus the CLI verbs.
+"""
+import io
+import os
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient, APIError
+from nomad_tpu.api.http_server import HTTPAgentServer
+from nomad_tpu.cli.main import main as cli_main
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.server.server import Server
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    server = Server(num_workers=2)
+    server.start()
+    c1 = Client(server, data_dir=str(tmp_path_factory.mktemp("fs_a")))
+    c1.start()
+    c2 = Client(server, data_dir=str(tmp_path_factory.mktemp("fs_b")))
+    c2.start()
+    h1 = HTTPAgentServer(server, c1, port=0)
+    h1.start()
+    h2 = HTTPAgentServer(server, c2, port=0)
+    h2.start()
+    api1 = ApiClient(address=h1.address)
+
+    from nomad_tpu.structs import Constraint
+    job = mock.job()
+    job.id = "fsjob"
+    job.name = "fsjob"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": [
+        "-c", "echo payload > $NOMAD_TASK_DIR/out.txt; "
+              "echo line1; sleep 120"]}
+    task.resources.networks = []
+    # pin to agent 2 so requests through agent 1 must route
+    job.constraints = [Constraint("${node.unique.id}", c2.node.id, "=")]
+    server.register_job(job)
+    assert wait_until(lambda: any(
+        a.client_status == "running"
+        for a in server.store.allocs_by_job(job.namespace, job.id)),
+        timeout=60)
+    alloc = next(a for a in server.store.allocs_by_job(
+        job.namespace, job.id) if a.client_status == "running")
+    assert wait_until(lambda: "line1" in api1.allocations.logs(
+        alloc.id, task="web"), timeout=20)
+    yield server, c1, c2, h1, h2, api1, alloc
+    h1.stop()
+    h2.stop()
+    c1.shutdown(halt_tasks=True)
+    c2.shutdown(halt_tasks=True)
+    server.stop()
+
+
+def test_fs_ls_and_stat(cluster):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    entries = api1.allocations.fs_ls(alloc.id, "/")
+    names = {e["name"] for e in entries}
+    assert "alloc" in names and "web" in names
+    logs = api1.allocations.fs_ls(alloc.id, "alloc/logs")
+    assert any(e["name"].startswith("web.stdout") for e in logs)
+    st = api1.allocations.fs_stat(alloc.id, "web/local/out.txt")
+    assert not st["is_dir"] and st["size"] >= len("payload\n")
+
+
+def test_fs_cat_and_readat(cluster):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    data = api1.allocations.fs_cat(alloc.id, "web/local/out.txt")
+    assert data == b"payload\n"
+    part = api1.allocations.fs_readat(alloc.id, "web/local/out.txt",
+                                      offset=3, limit=4)
+    assert part == b"load"
+
+
+def test_fs_stream_follows_growth(cluster):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    path = "alloc/logs/web.stdout.0"
+    st = api1.allocations.fs_stat(alloc.id, path)
+    # append through the running task's own stdout file on disk
+    runner = c2.get_alloc_runner(alloc.id)
+    step0 = api1.allocations.fs_stream(alloc.id, path,
+                                       offset=st["size"], wait=0.2)
+    assert step0["data"] == b""
+    with open(runner.alloc_dir.stdout_path("web"), "ab") as f:
+        f.write(b"line2\n")
+    step1 = api1.allocations.fs_stream(alloc.id, path,
+                                       offset=st["size"], wait=5.0)
+    assert b"line2" in step1["data"]
+    assert step1["offset"] == st["size"] + len(step1["data"])
+
+
+def test_fs_denies_secrets_and_escape(cluster):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    with pytest.raises(APIError) as e:
+        api1.allocations.fs_ls(alloc.id, "web/secrets")
+    assert e.value.code == 403
+    with pytest.raises(APIError) as e:
+        api1.allocations.fs_cat(alloc.id, "../../../../etc/passwd")
+    assert e.value.code == 403
+
+
+def test_host_and_alloc_stats(cluster):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    st = api1.nodes.stats()          # local agent (agent 1)
+    assert st["memory"]["total"] > 0
+    assert st["uptime_s"] > 0
+    # routed host stats for node 2 via agent 1
+    st2 = api1.nodes.stats(c2.node.id)
+    assert st2["memory"]["total"] > 0
+    # alloc stats route to the owning agent
+    astats = api1.allocations.stats(alloc.id)
+    ts = astats["tasks"]["web"]
+    assert ts is not None and ts["num_procs"] >= 1
+    assert ts["rss_bytes"] > 0
+
+
+def test_cli_fs_and_stats(cluster, capsys):
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    addr = h1.address
+    rc = cli_main(["-address", addr, "alloc", "fs", alloc.id])
+    out = capsys.readouterr().out
+    assert rc == 0 and "alloc" in out and "web" in out
+    rc = cli_main(["-address", addr, "alloc", "fs", alloc.id,
+                   "web/local/out.txt"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "payload" in out
+    rc = cli_main(["-address", addr, "alloc", "fs", alloc.id,
+                   "web/local/out.txt", "-stat"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "out.txt" in out
+    rc = cli_main(["-address", addr, "alloc", "stats", alloc.id])
+    out = capsys.readouterr().out
+    assert rc == 0 and "web" in out
+    rc = cli_main(["-address", addr, "node", "stats"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Memory used" in out
