@@ -129,6 +129,30 @@ class Jobs(_Sub):
     def periodic_force(self, job_id: str) -> dict:
         return self.c.post(f"/v1/job/{job_id}/periodic/force")[0]
 
+    def dispatch(self, job_id: str, payload: bytes = b"",
+                 meta: Optional[Dict[str, str]] = None) -> dict:
+        """Instantiate a parameterized job (reference: api/jobs.go
+        Dispatch); returns {dispatched_job_id, eval_id}."""
+        import base64
+        body: Dict[str, Any] = {}
+        if payload:
+            body["payload"] = base64.b64encode(payload).decode()
+        if meta:
+            body["meta"] = dict(meta)
+        return self.c.post(f"/v1/job/{job_id}/dispatch", body)[0]
+
+    def revert(self, job_id: str, version: int,
+               enforce_prior_version: Optional[int] = None) -> dict:
+        body: Dict[str, Any] = {"job_version": version}
+        if enforce_prior_version is not None:
+            body["enforce_prior_version"] = enforce_prior_version
+        return self.c.post(f"/v1/job/{job_id}/revert", body)[0]
+
+    def stable(self, job_id: str, version: int,
+               stable: bool = True) -> dict:
+        return self.c.post(f"/v1/job/{job_id}/stable",
+                           {"job_version": version, "stable": stable})[0]
+
 
 class Nodes(_Sub):
     def list(self, prefix: str = "", index: int = 0, wait: str = ""):

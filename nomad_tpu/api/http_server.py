@@ -288,6 +288,12 @@ class HTTPAgentServer:
             if not a.allow_namespace_op(ns, aclmod.CAP_SUBMIT_JOB):
                 raise HTTPError(403, "secrets require namespace write")
             return
+        if path.startswith("/v1/job/") and path.endswith("/dispatch"):
+            # dispatching is its own capability (reference:
+            # job_endpoint.go Dispatch requires dispatch-job)
+            if not a.allow_namespace_op(ns, aclmod.CAP_DISPATCH_JOB):
+                raise HTTPError(403, "missing capability dispatch-job")
+            return
         if path.startswith(("/v1/jobs", "/v1/job/", "/v1/allocation",
                             "/v1/evaluation", "/v1/deployment",
                             "/v1/search", "/v1/volume", "/v1/service")):
@@ -1173,6 +1179,67 @@ class HTTPAgentServer:
             raise HTTPError(502, f"plugin registration failed: {e}")
         return 200, {"registered": name}, None
 
+    def job_dispatch(self, q, body, job_id):
+        """Instantiate a parameterized job with a payload + meta
+        (reference: command/agent/job_endpoint.go Dispatch →
+        nomad/job_endpoint.go Job.Dispatch)."""
+        import base64
+        body = body or {}
+        payload = b""
+        if body.get("payload"):
+            try:
+                payload = base64.b64decode(body["payload"])
+            except Exception:
+                raise HTTPError(400, "payload must be base64")
+        meta = body.get("meta") or {}
+        if not isinstance(meta, dict):
+            raise HTTPError(400, "meta must be an object")
+        ns = q.get("namespace", "default")
+        try:
+            child, ev = self.server.dispatch_job(ns, job_id,
+                                                 payload=payload,
+                                                 meta=meta)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return 200, {"dispatched_job_id": child.id,
+                     "eval_id": ev.id if ev else "",
+                     "job_create_index": child.create_index}, \
+            self.server.store.latest_index()
+
+    def job_revert(self, q, body, job_id):
+        """Manual revert to a retained job version (reference:
+        Job.Revert — /v1/job/:id/revert)."""
+        body = body or {}
+        if "job_version" not in body:
+            raise HTTPError(400, "body must carry 'job_version'")
+        ns = q.get("namespace", "default")
+        try:
+            new_version, ev = self.server.revert_job_version(
+                ns, job_id, int(body["job_version"]),
+                enforce_prior_version=body.get("enforce_prior_version"))
+        except (ValueError, TypeError) as e:
+            raise HTTPError(400, str(e))
+        return 200, {"job_version": new_version,
+                     "eval_id": ev.id if ev else ""}, \
+            self.server.store.latest_index()
+
+    def job_stable(self, q, body, job_id):
+        """Mark a job version (un)stable (reference: Job.Stable —
+        /v1/job/:id/stable)."""
+        body = body or {}
+        if "job_version" not in body:
+            raise HTTPError(400, "body must carry 'job_version'")
+        ns = q.get("namespace", "default")
+        try:
+            self.server.set_job_stability(
+                ns, job_id, int(body["job_version"]),
+                bool(body.get("stable", True)))
+        except (ValueError, TypeError) as e:
+            raise HTTPError(400, str(e))
+        return 200, {"job_version": int(body["job_version"]),
+                     "stable": bool(body.get("stable", True))}, \
+            self.server.store.latest_index()
+
     def job_scale(self, q, body, job_id):
         """Adjust a task group's count (reference: Job.Scale,
         nomad/job_endpoint.go ScaleStatus/Scale — registers the updated
@@ -1418,6 +1485,12 @@ def _build_routes(s: HTTPAgentServer):
           "PUT": s.client_csi_plugin_register}),
         (R(r"^/v1/job/([^/]+)/scale$"), {"POST": s.job_scale,
                                          "PUT": s.job_scale}),
+        (R(r"^/v1/job/([^/]+)/dispatch$"), {"POST": s.job_dispatch,
+                                            "PUT": s.job_dispatch}),
+        (R(r"^/v1/job/([^/]+)/revert$"), {"POST": s.job_revert,
+                                          "PUT": s.job_revert}),
+        (R(r"^/v1/job/([^/]+)/stable$"), {"POST": s.job_stable,
+                                          "PUT": s.job_stable}),
         (R(r"^/v1/services$"), {"GET": s.services_list}),
         (R(r"^/v1/service/([^/]+)$"), {"GET": s.service_get}),
         (R(r"^/v1/secrets$"), {"GET": s.secrets_list}),

@@ -183,6 +183,48 @@ def cmd_job_plan(args) -> int:
     return 0
 
 
+def cmd_job_dispatch(args) -> int:
+    """`job dispatch` (reference: command/job_dispatch.go)."""
+    api = _client(args)
+    payload = b""
+    if args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    meta = {}
+    for kv in args.meta or []:
+        if "=" not in kv:
+            print(f"invalid -meta {kv!r} (want key=value)",
+                  file=sys.stderr)
+            return 1
+        k, v = kv.split("=", 1)
+        meta[k] = v
+    out = api.jobs.dispatch(args.job_id, payload=payload, meta=meta)
+    print(f"Dispatched Job ID = {out['dispatched_job_id']}")
+    if out.get("eval_id"):
+        print(f"Evaluation ID     = {_short(out['eval_id'])}")
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    """`job revert` (reference: command/job_revert.go)."""
+    api = _client(args)
+    out = api.jobs.revert(args.job_id, args.version)
+    print(f"Job reverted; now at version {out['job_version']}")
+    if out.get("eval_id"):
+        print(f"Evaluation ID = {_short(out['eval_id'])}")
+    return 0
+
+
+def cmd_job_history(args) -> int:
+    """`job history` (reference: command/job_history.go)."""
+    api = _client(args)
+    for v in api.jobs.versions(args.job_id):
+        stable = "stable" if v.get("stable") else ""
+        print(f"Version {v['version']:>3}  modify_index="
+              f"{v['job_modify_index']:<8} {stable}")
+    return 0
+
+
 def cmd_job_periodic_force(args) -> int:
     api = _client(args)
     resp = api.jobs.periodic_force(args.job_id)
@@ -548,6 +590,21 @@ def build_parser() -> argparse.ArgumentParser:
     jp = job.add_parser("plan")
     jp.add_argument("file")
     jp.set_defaults(fn=cmd_job_plan)
+    jd = job.add_parser("dispatch", help="instantiate a parameterized "
+                                         "job")
+    jd.add_argument("job_id")
+    jd.add_argument("-meta", action="append", default=[],
+                    help="key=value dispatch meta (repeatable)")
+    jd.add_argument("-payload-file", dest="payload_file", default=None,
+                    help="file whose contents become the payload")
+    jd.set_defaults(fn=cmd_job_dispatch)
+    jrv = job.add_parser("revert", help="revert to a prior version")
+    jrv.add_argument("job_id")
+    jrv.add_argument("version", type=int)
+    jrv.set_defaults(fn=cmd_job_revert)
+    jh = job.add_parser("history", help="list retained versions")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
     jpf = job.add_parser("periodic-force")
     jpf.add_argument("job_id")
     jpf.set_defaults(fn=cmd_job_periodic_force)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
 import random
 import threading
 import time as _time
@@ -302,8 +303,23 @@ class TaskRunner:
         self._emit(EVENT_SETUP, message="Building Task Directory")
         self.alloc_dir.build()
         self.alloc_dir.build_task_dir(self.task.name)
+        self._write_dispatch_payload()
         self._persist()
         self.on_state_change(self)
+
+    def _write_dispatch_payload(self) -> None:
+        """Deliver a dispatched job's payload into the task dir
+        (reference: taskrunner/dispatch_hook.go — writes the payload to
+        local/<dispatch_payload.file> before the task starts)."""
+        dp = getattr(self.task, "dispatch_payload", None)
+        job = self.alloc.job
+        if not dp or not dp.file or job is None or not job.payload:
+            return
+        dest = os.path.join(self.alloc_dir.task_dir(self.task.name),
+                            "local", dp.file.lstrip("/"))
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "wb") as f:
+            f.write(job.payload)
 
     def _resolve_secrets(self, env: dict) -> dict:
         """Resolve ${secret.<path>.<key>} references in task env values

@@ -104,6 +104,11 @@ class StateFSM:
         job = from_wire(Job, p["job"]) if p.get("job") is not None else None
         self.store.upsert_plan_results(index, result, job)
 
+    def _ap_job_stability(self, index, p):
+        self.store.update_job_stability(index, p["namespace"],
+                                        p["job_id"], p["version"],
+                                        p["stable"])
+
     def _ap_deployment_status(self, index, p):
         self.store.upsert_deployment_updates(
             index,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time as _time
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..raft import NotLeaderError, RaftConfig, RaftNode, StateFSM
 from ..utils.codec import to_wire
@@ -629,6 +629,96 @@ class Server:
         j = _copy.deepcopy(stable_job)
         j.create_index = j.modify_index = j.job_modify_index = 0
         return self.register_job(j)
+
+    def revert_job_version(self, namespace: str, job_id: str,
+                           version: int,
+                           enforce_prior_version: Optional[int] = None
+                           ) -> Tuple[int, Optional[Evaluation]]:
+        """Manual revert to a retained version (reference:
+        nomad/job_endpoint.go Job.Revert — validates the target exists,
+        optionally CAS-checks the current version, then registers the
+        old version forward as a NEW version)."""
+        cur = self.store.job_by_id(namespace, job_id)
+        if cur is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        if enforce_prior_version is not None \
+                and cur.version != enforce_prior_version:
+            raise ValueError(
+                f"current version is {cur.version}, "
+                f"not {enforce_prior_version}")
+        if version == cur.version:
+            raise ValueError(
+                f"cannot revert to the current version ({version})")
+        target = self.store.job_by_id_and_version(namespace, job_id,
+                                                  version)
+        if target is None:
+            raise ValueError(f"job {job_id!r} has no version {version}")
+        ev = self.revert_job(target)
+        new = self.store.job_by_id(namespace, job_id)
+        return (new.version if new else 0), ev
+
+    def set_job_stability(self, namespace: str, job_id: str,
+                          version: int, stable: bool) -> None:
+        """Manually mark a job version (un)stable (reference:
+        Job.Stable — the auto-revert target set by hand)."""
+        if self.store.job_by_id_and_version(namespace, job_id,
+                                            version) is None:
+            raise ValueError(f"job {job_id!r} has no version {version}")
+        self._propose("job_stability", {
+            "namespace": namespace, "job_id": job_id,
+            "version": version, "stable": bool(stable)})
+
+    # reference: structs.DispatchPayloadSizeLimit (16 KiB)
+    DISPATCH_PAYLOAD_LIMIT = 16 * 1024
+
+    def dispatch_job(self, namespace: str, job_id: str,
+                     payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None
+                     ) -> Tuple[Job, Optional[Evaluation]]:
+        """Instantiate a parameterized job (reference:
+        nomad/job_endpoint.go Job.Dispatch): validate payload presence
+        against the template's constraint and the dispatch meta against
+        the declared keys, then register a child carrying the payload
+        (delivered to the task dir by the task runner's
+        dispatch_payload hook)."""
+        import copy as _copy
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        if not job.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        cfg = job.parameterized
+        payload = bytes(payload or b"")
+        if cfg.payload == "required" and not payload:
+            raise ValueError("job requires a dispatch payload")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("job forbids a dispatch payload")
+        if len(payload) > self.DISPATCH_PAYLOAD_LIMIT:
+            raise ValueError(
+                f"payload exceeds {self.DISPATCH_PAYLOAD_LIMIT} bytes")
+        meta = dict(meta or {})
+        missing = [k for k in cfg.meta_required if k not in meta]
+        if missing:
+            raise ValueError(f"missing required dispatch meta: "
+                             f"{sorted(missing)}")
+        allowed = set(cfg.meta_required) | set(cfg.meta_optional)
+        extra = [k for k in meta if k not in allowed]
+        if extra:
+            raise ValueError(f"dispatch meta keys not declared by the "
+                             f"job: {sorted(extra)}")
+        child = _copy.deepcopy(job)
+        child.id = (f"{job.id}/dispatch-{int(_time.time())}-"
+                    f"{generate_uuid()[:8]}")
+        child.name = child.id
+        child.parent_id = job.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta = {**(job.meta or {}), **meta}
+        child.create_index = child.modify_index = 0
+        child.job_modify_index = 0
+        ev = self.register_job(child)
+        stored = self.store.job_by_id(namespace, child.id) or child
+        return stored, ev
 
     # --------------------------------------------------- raft membership
     def add_server_peer(self, peer_id: str, addr=None,

@@ -1,0 +1,174 @@
+"""Parameterized job dispatch + manual revert/stable (VERDICT r3
+missing items 3-4).
+
+Reference: nomad/job_endpoint.go Job.Dispatch (payload/meta validation,
+child job naming, payload delivery via the taskrunner dispatch hook),
+Job.Revert (version copy-forward through an eval), Job.Stable.
+"""
+import io
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient, APIError
+from nomad_tpu.api.http_server import HTTPAgentServer
+from nomad_tpu.cli.main import main as cli_main
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.server.server import Server
+from nomad_tpu.structs import DispatchPayloadConfig, ParameterizedJobConfig
+
+
+def param_job(job_id="batcher", payload="required"):
+    job = mock.job()
+    job.id = job_id
+    job.name = job_id
+    job.type = "batch"
+    job.parameterized = ParameterizedJobConfig(
+        payload=payload, meta_required=["input"],
+        meta_optional=["mode"])
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "raw_exec"
+    task.dispatch_payload = DispatchPayloadConfig(file="input.bin")
+    task.config = {"command": "/bin/sh", "args": [
+        "-c", "cat $NOMAD_TASK_DIR/input.bin"]}
+    task.resources.networks = []
+    return job
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    server = Server(num_workers=2)
+    server.start()
+    client = Client(server,
+                    data_dir=str(tmp_path_factory.mktemp("dispatch")))
+    client.start()
+    http = HTTPAgentServer(server, client, port=0)
+    http.start()
+    api = ApiClient(address=http.address)
+    yield server, client, http, api
+    http.stop()
+    client.shutdown(halt_tasks=True)
+    server.stop()
+
+
+def test_parameterized_template_gets_no_eval(agent):
+    server, client, http, api = agent
+    ev = server.register_job(param_job("tmpl-only"))
+    assert ev is None
+    assert not server.store.allocs_by_job("default", "tmpl-only")
+
+
+def test_dispatch_validation(agent):
+    server, client, http, api = agent
+    server.register_job(param_job("validator"))
+    with pytest.raises(ValueError, match="requires a dispatch payload"):
+        server.dispatch_job("default", "validator",
+                            meta={"input": "x"})
+    with pytest.raises(ValueError, match="missing required"):
+        server.dispatch_job("default", "validator", payload=b"x")
+    with pytest.raises(ValueError, match="not declared"):
+        server.dispatch_job("default", "validator", payload=b"x",
+                            meta={"input": "x", "bogus": "y"})
+    with pytest.raises(ValueError, match="exceeds"):
+        server.dispatch_job("default", "validator",
+                            payload=b"x" * (17 * 1024),
+                            meta={"input": "x"})
+    with pytest.raises(ValueError, match="not parameterized"):
+        plain = mock.job()
+        plain.id = "plain-job"
+        plain.task_groups[0].count = 0   # don't occupy the node
+        server.register_job(plain)
+        server.dispatch_job("default", "plain-job")
+    forbid = param_job("forbidder", payload="forbidden")
+    forbid.parameterized.meta_required = []
+    server.register_job(forbid)
+    with pytest.raises(ValueError, match="forbids"):
+        server.dispatch_job("default", "forbidder", payload=b"x")
+
+
+def test_dispatch_runs_child_with_payload_delivered(agent):
+    server, client, http, api = agent
+    server.register_job(param_job("runner"))
+    out = api.jobs.dispatch("runner", payload=b"hello-payload",
+                            meta={"input": "task1", "mode": "fast"})
+    child_id = out["dispatched_job_id"]
+    assert child_id.startswith("runner/dispatch-")
+    assert out["eval_id"]
+    child = server.store.job_by_id("default", child_id)
+    assert child.dispatched and child.parent_id == "runner"
+    assert child.meta["input"] == "task1"
+    # the task cats the delivered payload file to stdout
+    assert wait_until(lambda: any(
+        a.client_status == "complete"
+        for a in server.store.allocs_by_job("default", child_id)),
+        timeout=60)
+    alloc = server.store.allocs_by_job("default", child_id)[0]
+    logs = api.allocations.logs(alloc.id, task="web")
+    assert "hello-payload" in logs
+
+
+def test_dispatch_via_cli(agent, tmp_path, capsys):
+    server, client, http, api = agent
+    server.register_job(param_job("cli-dispatch"))
+    pf = tmp_path / "payload.txt"
+    pf.write_text("cli-payload")
+    rc = cli_main(["-address", http.address, "job", "dispatch",
+                   "-meta", "input=abc", "-payload-file", str(pf),
+                   "cli-dispatch"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "cli-dispatch/dispatch-" in out
+
+
+def test_revert_and_stable(agent, capsys):
+    server, client, http, api = agent
+    job = mock.job()
+    job.id = "versioned"
+    job.name = "versioned"
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = {"run_for": "30s"}
+    task.resources.networks = []
+    server.register_job(job)
+    # v1: change an env knob
+    import copy
+    v1 = copy.deepcopy(server.store.job_by_id("default", "versioned"))
+    v1.task_groups[0].tasks[0].env = {"REV": "one"}
+    server.register_job(v1)
+    cur = server.store.job_by_id("default", "versioned")
+    assert cur.version == 1
+
+    # stable API marks a version
+    out = api.jobs.stable("versioned", 0, True)
+    assert out["stable"] is True
+    vs = {v["version"]: v for v in api.jobs.versions("versioned")}
+    assert vs[0]["stable"] is True
+
+    # cannot revert to the current version
+    with pytest.raises(APIError) as e:
+        api.jobs.revert("versioned", 1)
+    assert e.value.code == 400
+    # revert to v0 creates v2 with v0's contents + an eval
+    out = api.jobs.revert("versioned", 0)
+    assert out["job_version"] == 2 and out["eval_id"]
+    now = server.store.job_by_id("default", "versioned")
+    assert now.version == 2
+    assert not now.task_groups[0].tasks[0].env.get("REV")
+    # enforce_prior_version mismatch rejected
+    with pytest.raises(APIError):
+        api.jobs.revert("versioned", 1, enforce_prior_version=7)
+
+    rc = cli_main(["-address", http.address, "job", "history",
+                   "versioned"])
+    out_text = capsys.readouterr().out
+    assert rc == 0 and "Version" in out_text
+    rc = cli_main(["-address", http.address, "job", "revert",
+                   "versioned", "1"])
+    out_text = capsys.readouterr().out
+    assert rc == 0 and "version 3" in out_text
