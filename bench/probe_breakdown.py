@@ -1,0 +1,138 @@
+"""Per-config breakdown of the resident-stream schedule: pack, upload
+bytes, dispatch, device-solve, fetch.  Run on the real TPU:
+
+    python bench/probe_breakdown.py [config]
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import bench as B  # noqa: E402
+
+
+def breakdown(config):
+    import numpy as np
+    import jax
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.kernel import MERGED_GP_MAX
+
+    p = B.CONFIGS[config]
+    n_nodes, n_evals, count, resident = (p["n_nodes"], p["n_evals"],
+                                         p["count"], p["resident"])
+    epc = min(128, n_evals)
+    nodes = B.make_nodes(n_nodes, devices=config == 4)
+    probe_job = B.make_job(config, 0, count)
+    kp_need = count * epc
+    rs = ResidentSolver(nodes, B.asks_for(probe_job),
+                        gp=MERGED_GP_MAX,
+                        kp=1 << max(0, (kp_need - 1).bit_length()),
+                        max_waves=18)
+    rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes, resident))
+    jobs = [B.make_job(config, e, count) for e in range(n_evals)]
+    NB = -(-n_evals // epc)
+
+    # warm
+    warm_asks = sum((B.asks_for(j) for j in jobs[:epc]), [])
+    warm_asks, _ = rs.merge_asks(warm_asks)
+    warm = rs.pack_batch(warm_asks)
+    warm.job_keys = None
+    np.asarray(rs.solve_stream_async([warm] * NB,
+                                     seeds=list(range(NB))))
+    rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes, resident))
+
+    t0 = time.perf_counter()
+    batches = []
+    for i in range(0, n_evals, epc):
+        asks = sum((B.asks_for(j) for j in jobs[i:i + epc]), [])
+        asks, keys = rs.merge_asks(asks)
+        pb = rs.pack_batch(asks, job_keys=keys)
+        batches.append(pb)
+    t_pack = time.perf_counter() - t0
+
+    # measure what _stack_args would ship (host arrays only)
+    t0 = time.perf_counter()
+    stacked = rs._stack_args(batches)
+    t_stack = time.perf_counter() - t0
+    up_bytes = sum(v.nbytes for v in stacked.values()
+                   if isinstance(v, np.ndarray))
+    shapes = {k: (list(v.shape), str(v.dtype),
+                  "host" if isinstance(v, np.ndarray) else "resident")
+              for k, v in stacked.items()}
+
+    t0 = time.perf_counter()
+    out = rs.solve_stream_async(batches, seeds=list(range(1, NB + 1)))
+    t_dispatch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    packed = np.asarray(out)
+    t_fetch_wait = time.perf_counter() - t0
+    fetch_bytes = packed.nbytes
+
+    # device-only solve time: all args resident, time chained re-run
+    # (chained dispatches pipeline; subtract one RTT measured trivially)
+    import jax.numpy as jnp
+    f1 = jax.jit(lambda a: a + 1)
+    x = jax.device_put(jnp.zeros(16))
+    np.asarray(f1(x))
+    t0 = time.perf_counter()
+    np.asarray(f1(x))
+    rtt = time.perf_counter() - t0
+
+    dev_stacked = {k: jax.device_put(v) if isinstance(v, np.ndarray) else v
+                   for k, v in stacked.items()}
+    for v in dev_stacked.values():
+        getattr(v, "block_until_ready", lambda: None)()
+    n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+    seeds = np.asarray(list(range(1, NB + 1)), np.int32)
+    from nomad_tpu.solver.resident import _stream_kernel
+    kw = dict(has_spread=rs._has_spread(batches),
+              group_count_hint=rs._group_count_hint(batches),
+              max_waves=rs.max_waves, wave_mode=rs.wave_mode,
+              has_distinct=rs._has_distinct(batches),
+              has_devices=rs._has_devices(batches),
+              stack_commit=rs.stack_commit)
+    args = (rs._dev_node["avail"], rs._dev_node["reserved"],
+            rs._dev_node["valid"], rs._dev_node["node_dc"],
+            rs._dev_node["attr_rank"], rs._dev_node["dev_cap"])
+    rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes, resident))
+    _, _, o = _stream_kernel(*args, rs._used, rs._dev_used, dev_stacked,
+                             n_places, seeds, **kw)
+    np.asarray(o)
+    ts = []
+    for _ in range(3):
+        rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes,
+                                              resident))
+        t0 = time.perf_counter()
+        _, _, o = _stream_kernel(*args, rs._used, rs._dev_used,
+                                 dev_stacked, n_places, seeds, **kw)
+        np.asarray(o)
+        ts.append(time.perf_counter() - t0)
+    t_solve_resident = min(ts)
+
+    return {
+        "config": config, "NB": NB, "gp": rs.gp, "kp": rs.kp,
+        "n_place_total": int(n_places.sum()),
+        "pack_ms": round(1000 * t_pack, 1),
+        "stack_ms": round(1000 * t_stack, 1),
+        "upload_bytes": up_bytes,
+        "upload_MB": round(up_bytes / 1e6, 2),
+        "dispatch_ms": round(1000 * t_dispatch, 1),
+        "fetch_wait_ms": round(1000 * t_fetch_wait, 1),
+        "fetch_bytes": fetch_bytes,
+        "rtt_ms": round(1000 * rtt, 1),
+        "solve_resident_args_ms": round(1000 * t_solve_resident, 1),
+        "device_solve_est_ms": round(1000 * (t_solve_resident - rtt), 1),
+        "shapes": shapes,
+    }
+
+
+if __name__ == "__main__":
+    cfgs = ([int(sys.argv[1])] if len(sys.argv) > 1 else [2, 3, 4])
+    for c in cfgs:
+        r = breakdown(c)
+        shapes = r.pop("shapes")
+        print(json.dumps(r))
+        if c == cfgs[0]:
+            print(json.dumps(shapes, indent=1))

@@ -1,0 +1,114 @@
+"""Transport microprobe: what does one device call cost on this
+attach, and what does each extra argument array add?
+
+Run on the real TPU:  python bench/probe_transport.py
+"""
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/nomad_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def med(f, n=7):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main():
+    out = {}
+    dev = jax.devices()[0]
+    out["device"] = str(dev)
+
+    # 1. trivial call round trip (dispatch + fetch), resident arg
+    f1 = jax.jit(lambda a: a + 1)
+    x = jax.device_put(jnp.zeros(16))
+    np.asarray(f1(x))
+    out["rtt_trivial_resident_ms"] = round(1000 * med(
+        lambda: np.asarray(f1(x))), 2)
+
+    # 2. same but the arg is a fresh host numpy array (upload included)
+    hx = np.zeros(16, np.float32)
+    np.asarray(f1(hx))
+    out["rtt_trivial_hostarg_ms"] = round(1000 * med(
+        lambda: np.asarray(f1(hx))), 2)
+
+    # 3. dispatch-only cost (no fetch): how long until the host is free
+    def disp_only():
+        r = f1(x)
+        return r
+    out["dispatch_only_resident_ms"] = round(1000 * med(
+        lambda: disp_only()), 3)
+
+    def disp_only_host():
+        r = f1(hx)
+        return r
+    out["dispatch_only_hostarg_ms"] = round(1000 * med(
+        lambda: disp_only_host()), 3)
+
+    # 4. K separate host arrays as args vs one packed blob of same bytes
+    K, SZ = 24, 64 * 1024             # ~24 args x 64KB = 1.5MB
+    mats = [np.zeros(SZ // 4, np.float32) for _ in range(K)]
+    fk = jax.jit(lambda *xs: sum(x[0] for x in xs))
+    np.asarray(fk(*mats))
+    out[f"call_{K}args_64KB_each_ms"] = round(1000 * med(
+        lambda: np.asarray(fk(*mats))), 2)
+    blob = np.zeros(K * SZ // 4, np.float32)
+    fb = jax.jit(lambda b: b.reshape(K, -1)[:, 0].sum())
+    np.asarray(fb(blob))
+    out["call_1blob_same_bytes_ms"] = round(1000 * med(
+        lambda: np.asarray(fb(blob))), 2)
+
+    # 5. upload bandwidth: 64MB device_put
+    big = np.zeros(16 * 1024 * 1024, np.float32)
+    jax.device_put(big).block_until_ready()
+    t = med(lambda: jax.device_put(big).block_until_ready(), 3)
+    out["upload_64MB_ms"] = round(1000 * t, 1)
+    out["upload_GBps"] = round(big.nbytes / t / 1e9, 2)
+
+    # 6. fetch bandwidth: 64MB device->host
+    dbig = jax.device_put(big)
+    np.asarray(dbig)
+    t = med(lambda: np.asarray(dbig), 3)
+    out["fetch_64MB_ms"] = round(1000 * t, 1)
+    out["fetch_GBps"] = round(big.nbytes / t / 1e9, 2)
+
+    # 7. two sequential calls (dep chain) vs one: extra per-call cost
+    g = jax.jit(lambda a: a * 2 + 1)
+    r = g(x); np.asarray(r)
+    def two_calls():
+        return np.asarray(g(g(x)))
+    np.asarray(g(g(x)))
+    out["two_chained_calls_ms"] = round(1000 * med(two_calls), 2)
+    def one_call():
+        return np.asarray(g(x))
+    out["one_call_ms"] = round(1000 * med(one_call), 2)
+
+    # 8. two INDEPENDENT dispatches then two fetches (do RTTs overlap?)
+    y = jax.device_put(jnp.ones(16))
+    def two_indep():
+        a = g(x); b = g(y)
+        return np.asarray(a), np.asarray(b)
+    two_indep()
+    out["two_independent_calls_ms"] = round(1000 * med(two_indep), 2)
+
+    # 9. small-array device_put latency (one 1KB upload, synced)
+    s = np.zeros(256, np.float32)
+    jax.device_put(s).block_until_ready()
+    out["device_put_1KB_ms"] = round(1000 * med(
+        lambda: jax.device_put(s).block_until_ready()), 2)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,12 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 
+def _q(path_param: str) -> str:
+    """Percent-encode a path parameter; dispatched child job ids embed a
+    '/' (<parent>/dispatch-<...>) and must travel as one path segment."""
+    return urllib.parse.quote(path_param, safe="")
+
+
 class APIError(Exception):
     def __init__(self, code: int, msg: str):
         super().__init__(f"HTTP {code}: {msg}")
@@ -100,34 +106,34 @@ class Jobs(_Sub):
         return self.c.post("/v1/jobs/parse", {"job_hcl": hcl})[0]
 
     def info(self, job_id: str, index: int = 0, wait: str = ""):
-        return self.c.get(f"/v1/job/{job_id}", index=index or None,
+        return self.c.get(f"/v1/job/{_q(job_id)}", index=index or None,
                           wait=wait)
 
     def deregister(self, job_id: str, purge: bool = False) -> dict:
-        return self.c.delete(f"/v1/job/{job_id}",
+        return self.c.delete(f"/v1/job/{_q(job_id)}",
                              purge="true" if purge else None)[0]
 
     def allocations(self, job_id: str) -> List[dict]:
-        return self.c.get(f"/v1/job/{job_id}/allocations")[0]
+        return self.c.get(f"/v1/job/{_q(job_id)}/allocations")[0]
 
     def evaluations(self, job_id: str) -> List[dict]:
-        return self.c.get(f"/v1/job/{job_id}/evaluations")[0]
+        return self.c.get(f"/v1/job/{_q(job_id)}/evaluations")[0]
 
     def deployments(self, job_id: str) -> List[dict]:
-        return self.c.get(f"/v1/job/{job_id}/deployments")[0]
+        return self.c.get(f"/v1/job/{_q(job_id)}/deployments")[0]
 
     def summary(self, job_id: str) -> dict:
-        return self.c.get(f"/v1/job/{job_id}/summary")[0]
+        return self.c.get(f"/v1/job/{_q(job_id)}/summary")[0]
 
     def versions(self, job_id: str) -> List[dict]:
-        return self.c.get(f"/v1/job/{job_id}/versions")[0]
+        return self.c.get(f"/v1/job/{_q(job_id)}/versions")[0]
 
     def plan(self, job_id: str, job_wire: dict) -> dict:
-        return self.c.post(f"/v1/job/{job_id}/plan",
+        return self.c.post(f"/v1/job/{_q(job_id)}/plan",
                            {"job": job_wire})[0]
 
     def periodic_force(self, job_id: str) -> dict:
-        return self.c.post(f"/v1/job/{job_id}/periodic/force")[0]
+        return self.c.post(f"/v1/job/{_q(job_id)}/periodic/force")[0]
 
     def dispatch(self, job_id: str, payload: bytes = b"",
                  meta: Optional[Dict[str, str]] = None) -> dict:
@@ -139,19 +145,23 @@ class Jobs(_Sub):
             body["payload"] = base64.b64encode(payload).decode()
         if meta:
             body["meta"] = dict(meta)
-        return self.c.post(f"/v1/job/{job_id}/dispatch", body)[0]
+        return self.c.post(f"/v1/job/{_q(job_id)}/dispatch", body)[0]
 
     def revert(self, job_id: str, version: int,
                enforce_prior_version: Optional[int] = None) -> dict:
         body: Dict[str, Any] = {"job_version": version}
         if enforce_prior_version is not None:
             body["enforce_prior_version"] = enforce_prior_version
-        return self.c.post(f"/v1/job/{job_id}/revert", body)[0]
+        return self.c.post(f"/v1/job/{_q(job_id)}/revert", body)[0]
 
     def stable(self, job_id: str, version: int,
                stable: bool = True) -> dict:
-        return self.c.post(f"/v1/job/{job_id}/stable",
+        return self.c.post(f"/v1/job/{_q(job_id)}/stable",
                            {"job_version": version, "stable": stable})[0]
+
+    def scale(self, job_id: str, group: str, count: int) -> dict:
+        return self.c.post(f"/v1/job/{_q(job_id)}/scale",
+                           {"group": group, "count": count})[0]
 
 
 class Nodes(_Sub):

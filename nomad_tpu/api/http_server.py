@@ -16,9 +16,10 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from ..jobspec import JobspecParseError, parse_duration_s, parse_job
+from ..server.server import JobValidationError
 from ..structs import Evaluation, Job, Plan, PlanResult
 from ..utils.codec import from_wire, to_wire
 from ..utils.metrics import global_metrics
@@ -195,9 +196,17 @@ class HTTPAgentServer:
             fn = methods.get(method)
             if fn is None:
                 raise HTTPError(405, f"method {method} not allowed")
-            self._enforce_acl(method, url.path, q, body, token)
+            # Path params arrive percent-encoded; decode AFTER matching
+            # so an encoded '/' (dispatched child ids embed one:
+            # <parent>/dispatch-<...>) routes as one segment but reaches
+            # the handler as the real id (reference: the agent mux
+            # handles these ids the same way).  The ACL check receives
+            # each segment decoded the same way so it authorizes the
+            # exact id the handler will act on.
+            segs = [unquote(s) for s in url.path.split("/")]
+            self._enforce_acl(method, url.path, q, body, token, segs)
             self._tl.token = token
-            return fn(q, body, *m.groups())
+            return fn(q, body, *(unquote(g) for g in m.groups()))
         raise HTTPError(404, f"no handler for {url.path}")
 
     def _alloc_namespace(self, prefix: str) -> str:
@@ -215,13 +224,15 @@ class HTTPAgentServer:
         return next(iter(matches), "default")
 
     def _enforce_acl(self, method: str, path: str, q, body,
-                     token: str) -> None:
+                     token: str, segs=None) -> None:
         """Route-class capability checks (reference: each agent endpoint
         resolves the token and asserts one capability — e.g.
         job_endpoint.go requires submit-job to register, read-job to
         get). Disabled servers skip enforcement entirely."""
         if not self.acl_enabled or path == "/v1/acl/bootstrap":
             return
+        if segs is None:
+            segs = path.split("/")
         from ..acl import acl as aclmod
         a = self.server.resolve_token(token) if token else None
         if a is None:
@@ -249,7 +260,7 @@ class HTTPAgentServer:
         write = (method in ("POST", "PUT", "DELETE")
                  and path != "/v1/search")
         if "/exec" in path and path.startswith("/v1/client/allocation/"):
-            target_ns = self._alloc_namespace(path.split("/")[4])
+            target_ns = self._alloc_namespace(segs[4])
             if not a.allow_namespace_op(target_ns,
                                         aclmod.CAP_ALLOC_EXEC):
                 raise HTTPError(403, "missing capability alloc-exec")
@@ -257,7 +268,7 @@ class HTTPAgentServer:
         if path.startswith("/v1/client/fs/logs/"):
             # task logs often carry secrets: require read-logs in the
             # ALLOC's namespace (resolved server-side, not caller-said)
-            target_ns = self._alloc_namespace(path.rsplit("/", 1)[-1])
+            target_ns = self._alloc_namespace(segs[-1])
             if not a.allow_namespace_op(target_ns,
                                         aclmod.CAP_READ_LOGS):
                 raise HTTPError(403, "missing capability read-logs")
@@ -265,7 +276,7 @@ class HTTPAgentServer:
         if path.startswith("/v1/client/fs/"):
             # ls/stat/cat/readat/stream over the alloc dir: read-fs in
             # the alloc's namespace (reference: fs_endpoint.go ACL)
-            target_ns = self._alloc_namespace(path.rsplit("/", 1)[-1])
+            target_ns = self._alloc_namespace(segs[-1])
             if not a.allow_namespace_op(target_ns, aclmod.CAP_READ_FS):
                 raise HTTPError(403, "missing capability read-fs")
             return
@@ -277,7 +288,7 @@ class HTTPAgentServer:
                 if not a.allow_node_read():
                     raise HTTPError(403, "node permission denied")
             else:
-                target_ns = self._alloc_namespace(path.split("/")[4])
+                target_ns = self._alloc_namespace(segs[4])
                 if not a.allow_namespace_op(target_ns,
                                             aclmod.CAP_READ_JOB):
                     raise HTTPError(403, "missing capability read-job")
@@ -375,6 +386,8 @@ class HTTPAgentServer:
             ev = self.server.register_job(
                 job, enforce_index=bool(body.get("enforce_index")),
                 check_index=int(body.get("job_modify_index", 0)))
+        except JobValidationError as e:
+            raise HTTPError(400, str(e))
         except ValueError as e:
             raise HTTPError(409, str(e))
         return 200, {"eval_id": ev.id if ev else "",
@@ -1262,7 +1275,10 @@ class HTTPAgentServer:
         if tg is None:
             raise HTTPError(400, f"unknown group {body['group']!r}")
         tg.count = count
-        ev = self.server.register_job(j2)
+        try:
+            ev = self.server.register_job(j2)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
         return 200, {"eval_id": ev.id if ev else "",
                      "index": self.server.store.latest_index()}, None
 

@@ -120,10 +120,11 @@ function allocRows(allocs) {
 const ALLOC_HDR = ["ID", "Group", "Name", "Node", "Desired", "Client"];
 
 async function viewJob(id) {
+  const q = encodeURIComponent(id);   // dispatched child ids embed '/'
   const [job, allocs, evals, deps, versions] = await Promise.all([
-    j(`/v1/job/${id}`), j(`/v1/job/${id}/allocations`),
-    j(`/v1/job/${id}/evaluations`), j(`/v1/job/${id}/deployments`),
-    j(`/v1/job/${id}/versions`).catch(() => [])]);
+    j(`/v1/job/${q}`), j(`/v1/job/${q}/allocations`),
+    j(`/v1/job/${q}/evaluations`), j(`/v1/job/${q}/deployments`),
+    j(`/v1/job/${q}/versions`).catch(() => [])]);
   const groups = (job.task_groups || []).map(g => [
     esc(g.name), esc(g.count),
     esc((g.tasks || []).map(t => t.name + " (" + t.driver + ")")

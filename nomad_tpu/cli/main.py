@@ -431,8 +431,7 @@ def _alloc_exec_interactive(api, args) -> int:
 
 def cmd_job_scale(args) -> int:
     api = _client(args)
-    out, _ix = api.post(f"/v1/job/{args.job_id}/scale",
-                        {"group": args.group, "count": args.count})
+    out = api.jobs.scale(args.job_id, args.group, args.count)
     print(f"==> Scaled {args.job_id}/{args.group} to {args.count} "
           f"(eval {_short(out['eval_id'])})")
     return 0

@@ -39,6 +39,15 @@ def resolve(root: str, rel: str) -> str:
     rootr = os.path.realpath(root)
     if p != rootr and not p.startswith(rootr + os.sep):
         raise FSError(403, "path escapes the allocation directory")
+    # Re-check the *resolved* path's components: a symlink inside the
+    # alloc dir may point at a secrets dir that the raw-path check above
+    # never saw (reference: fs_endpoint.go checks the final joined path
+    # against SecretsDir).
+    if p != rootr:
+        for comp in os.path.relpath(p, rootr).split(os.sep):
+            if comp in _DENIED_COMPONENTS:
+                raise FSError(403, "secrets directories are not accessible "
+                                   "through the fs API")
     return p
 
 

@@ -315,10 +315,32 @@ class TaskRunner:
         job = self.alloc.job
         if not dp or not dp.file or job is None or not job.payload:
             return
-        dest = os.path.join(self.alloc_dir.task_dir(self.task.name),
-                            "local", dp.file.lstrip("/"))
+        local = os.path.join(self.alloc_dir.task_dir(self.task.name), "local")
+        dest = os.path.join(local, dp.file.lstrip("/"))
+        # Containment check: the jobspec validates this at registration
+        # (reference: structs/structs.go DispatchPayloadConfig.Validate →
+        # PathEscapesAllocDir), but a payload path must never escape the
+        # task dir even if a job bypassed validation (e.g. raw raft
+        # restore), so re-check the normalized destination here too.
+        localr = os.path.realpath(local)
+        destr = os.path.realpath(os.path.dirname(dest))
+        if destr != localr and not destr.startswith(localr + os.sep):
+            raise RuntimeError(
+                f"dispatch_payload file {dp.file!r} escapes the task's "
+                "local directory")
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        with open(dest, "wb") as f:
+        # The task itself can plant a symlink (agent writes outside the
+        # sandbox) or a FIFO (open blocks forever) at the payload path
+        # between runs: drop whatever is there and create fresh —
+        # O_EXCL+O_NOFOLLOW closes the unlink→open race.
+        dest = os.path.join(destr, os.path.basename(dest))
+        try:
+            os.unlink(dest)
+        except FileNotFoundError:
+            pass
+        fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_EXCL
+                     | os.O_NOFOLLOW, 0o644)
+        with os.fdopen(fd, "wb") as f:
             f.write(job.payload)
 
     def _resolve_secrets(self, env: dict) -> dict:

@@ -16,8 +16,19 @@ driver likewise requires root + cgroups: drivers/exec capabilities).
 """
 from __future__ import annotations
 
+import ctypes
 import os
 from typing import Dict
+
+# Resolved at import time: the post-fork child must not run `import` or
+# dlopen (either can deadlock on locks another agent thread held at
+# fork); it only CALLS this already-bound function.  prctl is
+# Linux-only; elsewhere the driver fingerprints as undetected anyway.
+_PR_SET_PDEATHSIG = 1
+try:
+    _libc_prctl = ctypes.CDLL(None, use_errno=True).prctl
+except (OSError, AttributeError):
+    _libc_prctl = None
 
 from ..plugins.drivers import (DriverCapabilities, DriverFingerprint,
                                HEALTH_HEALTHY, HEALTH_UNDETECTED,
@@ -143,6 +154,12 @@ class ExecDriver(RawExecDriver):
             # status.
             pid = os.fork()
             if pid == 0:
+                # Die with the intermediate: subprocess timeouts SIGKILL
+                # the intermediate (uncatchable, unforwardable), which
+                # would otherwise leave this command running inside the
+                # task's pid namespace until the task exits.
+                if _libc_prctl is not None:
+                    _libc_prctl(_PR_SET_PDEATHSIG, _sig.SIGKILL, 0, 0, 0)
                 return                 # grandchild: execs the command
             # drop every inherited fd: the intermediate never execs,
             # so subprocess's CLOEXEC error pipe (and the pty master /

@@ -26,10 +26,26 @@ _libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
 MS_RDONLY = 0x1
 MS_NOSUID = 0x2
 MS_NODEV = 0x4
+MS_NOEXEC = 0x8
 MS_REMOUNT = 0x20
+MS_NOATIME = 0x400
+MS_NODIRATIME = 0x800
 MS_BIND = 0x1000
 MS_REC = 0x4000
 MS_PRIVATE = 0x40000
+MS_RELATIME = 0x200000
+
+#: statvfs f_flag bit -> mount flag, for re-asserting a submount's
+#: EXISTING flags during remount (a user-ns locked flag that the
+#: remount drops is an EPERM; preserving them lets the remount succeed)
+_STATVFS_TO_MS = [
+    (getattr(os, "ST_NOSUID", 0x2), MS_NOSUID),
+    (getattr(os, "ST_NODEV", 0x4), MS_NODEV),
+    (getattr(os, "ST_NOEXEC", 0x8), MS_NOEXEC),
+    (getattr(os, "ST_NOATIME", 0x400), MS_NOATIME),
+    (getattr(os, "ST_NODIRATIME", 0x800), MS_NODIRATIME),
+    (getattr(os, "ST_RELATIME", 0x1000000), MS_RELATIME),
+]
 
 #: reference: drivers/exec chroot_env default allowlist
 #: (website docs chroot_env; executor_linux chroot build)
@@ -154,15 +170,43 @@ def _remount_ro_tree(tgt: str) -> None:
 
     The top-level remount must succeed — a writable system bind is a
     jail break, and the driver's contract is to refuse to start rather
-    than weaken the sandbox.  Submount failures (locked mount flags
-    inherited from the parent userns) are tolerated: the kernel locks
-    such flags precisely because they were already applied."""
+    than weaken the sandbox.  A submount remount failure is retried
+    with the mount's existing flags preserved (a userns-locked flag the
+    remount drops is an EPERM) and then tolerated ONLY if the submount
+    is verifiably already read-only; a submount left writable fails
+    task start."""
     for mp in _mounts_under(tgt):
+        flags = MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID
         try:
-            _mount(None, mp, None,
-                   MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
-        except IsolationError:
+            _mount(None, mp, None, flags)
             continue
+        except IsolationError:
+            pass
+        # A locked flag (inherited through a user namespace) that the
+        # remount DROPS is an EPERM: retry preserving the submount's
+        # existing flags, then verify.  Tolerate failure only if the
+        # mount is in fact read-only — a submount left writable for any
+        # other reason is a jail break and the task refuses to start.
+        try:
+            st_flag = os.statvfs(mp).f_flag
+        except OSError:
+            st_flag = 0
+        for st_bit, ms_bit in _STATVFS_TO_MS:
+            if st_flag & st_bit:
+                flags |= ms_bit
+        try:
+            _mount(None, mp, None, flags)
+            continue
+        except IsolationError:
+            pass
+        try:
+            ro = bool(os.statvfs(mp).f_flag & os.ST_RDONLY)
+        except OSError:
+            ro = False
+        if not ro:
+            raise IsolationError(
+                f"cannot pin submount {mp!r} read-only and it is "
+                "writable inside the chroot")
     _mount(None, tgt, None,
            MS_REMOUNT | MS_BIND | MS_RDONLY | MS_NOSUID)
 

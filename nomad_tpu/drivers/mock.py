@@ -40,7 +40,20 @@ class _MockTask:
             self.stop.wait()
             result = ExitResult()
         else:
-            finished = self.stop.wait(float(run_for))
+            try:
+                wait_s = float(run_for)          # unitless = seconds
+            except (TypeError, ValueError):
+                from ..jobspec.parse import parse_duration_s
+                try:
+                    wait_s = parse_duration_s(run_for)
+                except Exception:
+                    # a bad duration fails the task, never wedges it
+                    self.exit_result = ExitResult(
+                        exit_code=1, err=f"bad run_for: {run_for!r}")
+                    self.completed_at = _time.time()
+                    self.done.set()
+                    return
+            finished = self.stop.wait(wait_s)
             if finished:
                 result = ExitResult()
             else:

@@ -331,16 +331,38 @@ class RawExecDriver(DriverPlugin):
                   timeout_s: float = 30.0) -> Tuple[bytes, int]:
         t = self._get(task_id)
         cfg = t.handle.config
-        preexec, pass_fds, cwd, cleanup = self._exec_jail(t)
+        jail_preexec, pass_fds, cwd, cleanup = self._exec_jail(t)
+
+        def preexec():
+            # Own process group so a timeout can kill the command AND
+            # anything it spawned, not just the direct child.
+            os.setpgid(0, 0)
+            if jail_preexec:
+                jail_preexec()
+
         try:
-            out = subprocess.run(
-                cmd, cwd=cwd, env=self._exec_env(cfg),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                timeout=timeout_s, preexec_fn=preexec,
-                pass_fds=pass_fds)
-            return out.stdout, out.returncode
-        except subprocess.TimeoutExpired as e:
-            return (e.stdout or b"") + b"\n(timed out)", 124
+            with subprocess.Popen(
+                    cmd, cwd=cwd, env=self._exec_env(cfg),
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    preexec_fn=preexec, pass_fds=pass_fds) as proc:
+                try:
+                    out, _ = proc.communicate(timeout=timeout_s)
+                    return out, proc.returncode
+                except subprocess.TimeoutExpired:
+                    # Kill the whole group; in the jailed case the
+                    # intermediate's death also SIGKILLs the in-namespace
+                    # command via its PR_SET_PDEATHSIG.
+                    try:
+                        os.killpg(proc.pid, 9)
+                    except (ProcessLookupError, PermissionError):
+                        proc.kill()
+                    # a descendant that escaped the group (setsid) can
+                    # hold the pipe open; don't let it wedge this thread
+                    try:
+                        out, _ = proc.communicate(timeout=2.0)
+                    except subprocess.TimeoutExpired:
+                        out = b""
+                    return (out or b"") + b"\n(timed out)", 124
         finally:
             cleanup()
 

@@ -49,7 +49,10 @@ class RaftConfig:
     election_timeout_s: Tuple[float, float] = (0.15, 0.30)
     heartbeat_interval_s: float = 0.05
     snapshot_threshold: int = 8192      # log entries before compaction
-    fsync: bool = False
+    # Durable by default: committed entries must survive power loss
+    # (reference: raft-boltdb fsyncs every append).  Tests and
+    # benchmarks that churn thousands of throwaway entries may opt out.
+    fsync: bool = True
     # an empty-log member waits this long for an existing leader to
     # contact it before campaigning: a freshly ADDED server would
     # otherwise inflate its term pre-join and depose a healthy leader

@@ -100,6 +100,11 @@ class EvalBroker:
     def enabled(self) -> bool:
         return self._enabled
 
+    def ready_count(self) -> int:
+        """Evals ready for dequeue right now (not delayed/unacked)."""
+        with self._lock:
+            return sum(len(h) for h in self._ready.values())
+
     def flush(self) -> None:
         with self._lock:
             for u in self._unack.values():

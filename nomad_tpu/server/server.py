@@ -42,6 +42,11 @@ from .plan_queue import PlanQueue
 from .worker import Worker
 
 
+class JobValidationError(ValueError):
+    """A job failed structural validation at registration (maps to
+    HTTP 400, distinct from the check-and-set index conflict's 409)."""
+
+
 class Server:
     def __init__(self, num_workers: int = 2,
                  enabled_schedulers: Optional[List[str]] = None,
@@ -133,6 +138,15 @@ class Server:
         self.planner.start()
         for w in self.workers:
             w.start()
+        # Reserve leader CPU for raft + plan application by pausing 3/4
+        # of the scheduling workers (reference: leader.go:206-212 —
+        # len(s.workers)/4*3 of them are paused while leader); at least
+        # one worker always runs so scheduling can't stall
+        n_pause = len(self.workers) // 4 * 3
+        if n_pause >= len(self.workers):
+            n_pause = len(self.workers) - 1
+        for w in self.workers[:max(0, n_pause)]:
+            w.paused.set()
         self._stop_reapers.clear()
         self._dup_reaper = threading.Thread(
             target=self._reap_dup_blocked_evals, daemon=True)
@@ -172,6 +186,7 @@ class Server:
         self.periodic.set_enabled(False)
         self._stop_reapers.set()
         for w in self.workers:
+            w.paused.clear()
             w.shutdown()
         self.planner.stop()
         self.plan_queue.set_enabled(False)
@@ -381,6 +396,13 @@ class Server:
     def register_job(self, job: Job, enforce_index: bool = False,
                      check_index: int = 0) -> Optional[Evaluation]:
         job.canonicalize()
+        # validate server-side so every path (HTTP, RPC, direct) is
+        # covered (reference: job_endpoint.go Job.Register → Validate
+        # runs in the RPC, not just the agent)
+        errs = job.validate()
+        if errs:
+            raise JobValidationError(
+                "job validation failed: " + "; ".join(errs))
         # _cas_lock keeps the check-and-set registration atomic across
         # concurrent registrars (reference: job_endpoint.go Job.Register
         # EnforceIndex runs inside the raft apply's serialization)

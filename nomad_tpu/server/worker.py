@@ -38,8 +38,14 @@ class Worker(threading.Thread):
 
     def run(self) -> None:
         while not self._shutdown.is_set():
-            if self.paused.is_set():
-                self._shutdown.wait(0.1)
+            if self.paused.is_set() and \
+                    self.server.broker.ready_count() <= self.server.batch_size:
+                # Soft pause (leader CPU hygiene, reference:
+                # leader.go:206-212): unlike the reference there are no
+                # follower workers to absorb load in this architecture,
+                # so a paused worker still wakes while the broker backs
+                # up beyond one batch and returns to idle once drained.
+                self._shutdown.wait(0.05)
                 continue
             batch = self.server.broker.dequeue_batch(
                 self.sched_types, self.server.batch_size, DEQUEUE_TIMEOUT_S)

@@ -129,6 +129,18 @@ class ParameterizedJobConfig:
     meta_optional: List[str] = field(default_factory=list)
 
 
+def _path_escapes_sandbox(rel: str) -> bool:
+    """True when a user-supplied relative path climbs out of its sandbox
+    dir (reference: helper/funcs.go PathEscapesAllocDir — normalize then
+    check for a leading '..')."""
+    import posixpath
+    norm = posixpath.normpath("/" + rel.lstrip("/"))
+    # After anchoring at '/', normpath collapses every '..'; a path that
+    # still tries to climb shows up as a difference vs the raw join.
+    raw = posixpath.normpath(posixpath.join("/sandbox", rel.lstrip("/")))
+    return not (raw == "/sandbox" or raw.startswith("/sandbox/")) or norm == "/"
+
+
 @dataclass
 class DispatchPayloadConfig:
     file: str = ""
@@ -349,6 +361,11 @@ class Job:
                 tseen.add(t.name)
                 if not t.driver:
                     errs.append(f"task {t.name} missing driver")
+                dp = getattr(t, "dispatch_payload", None)
+                if dp and dp.file and _path_escapes_sandbox(dp.file):
+                    errs.append(
+                        f"task {t.name} dispatch_payload file "
+                        f"{dp.file!r} escapes the task directory")
         if self.type == JOB_TYPE_SYSTEM:
             if self.affinities:
                 errs.append("system jobs may not have an affinity stanza")

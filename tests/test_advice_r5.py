@@ -1,0 +1,163 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4).
+
+1 (high)   — a dispatch_payload file must never escape the task dir:
+             rejected at job validation (reference: structs.go
+             DispatchPayloadConfig.Validate -> PathEscapesAllocDir) and
+             re-checked at write time by the taskrunner.
+2 (medium) — the fs API must deny secrets reads reached THROUGH a
+             symlink inside the alloc dir, not just raw 'secrets'
+             components (reference: fs_endpoint.go checks the final
+             joined path against SecretsDir).
+3 (medium) — dispatched child job ids embed '/'; the HTTP API and SDK
+             must round-trip them (percent-encoded path segments).
+4 (low)    — leader worker pausing: 3/4 of workers idle on the leader
+             (reference: leader.go:206-212), all resume on revoke.
+"""
+import os
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import fs as clientfs
+from nomad_tpu.structs import DispatchPayloadConfig, ParameterizedJobConfig
+
+
+# ------------------------------------------------------------------ 1
+def _job_with_payload_file(file):
+    job = mock.job()
+    job.id = "dp-escape"
+    job.type = "batch"
+    job.parameterized = ParameterizedJobConfig(payload="required")
+    job.task_groups[0].tasks[0].dispatch_payload = \
+        DispatchPayloadConfig(file=file)
+    return job
+
+
+@pytest.mark.parametrize("bad", [
+    "../../../../etc/cron.d/x",
+    "a/../../escape",
+    "..",
+    "/../x",
+])
+def test_dispatch_payload_escaping_path_rejected_at_validation(bad):
+    errs = _job_with_payload_file(bad).validate()
+    assert any("escapes" in e for e in errs), errs
+
+
+@pytest.mark.parametrize("ok", ["input.bin", "sub/dir/payload.json",
+                                "a/./b", "/rooted.bin"])
+def test_dispatch_payload_sane_paths_accepted(ok):
+    assert not _job_with_payload_file(ok).validate()
+
+
+def test_taskrunner_refuses_escaping_payload_write(tmp_path):
+    """Even if validation were bypassed (raw raft restore), the write
+    itself must refuse to leave the task's local dir."""
+    from nomad_tpu.client.allocdir import AllocDir
+    from nomad_tpu.client.taskrunner import TaskRunner
+
+    job = _job_with_payload_file("../../../../evil")
+    job.payload = b"pwned"
+    alloc = mock.alloc()
+    alloc.job = job
+    task = job.task_groups[0].tasks[0]
+    tr = TaskRunner.__new__(TaskRunner)
+    tr.alloc = alloc
+    tr.task = task
+    tr.alloc_dir = AllocDir(str(tmp_path), alloc.id)
+    tr.alloc_dir.build()
+    tr.alloc_dir.build_task_dir(task.name)
+    with pytest.raises(RuntimeError, match="escapes"):
+        tr._write_dispatch_payload()
+    assert not (tmp_path / "evil").exists()
+
+
+# ------------------------------------------------------------------ 2
+def test_fs_denies_secrets_via_symlink(tmp_path):
+    root = tmp_path / "alloc"
+    sec = root / "web" / "secrets"
+    os.makedirs(sec)
+    (sec / "token").write_text("s3cret")
+    os.symlink(sec, root / "leak")
+    os.symlink(sec / "token", root / "leaktok")
+    with pytest.raises(clientfs.FSError) as ei:
+        clientfs.resolve(str(root), "leak/token")
+    assert ei.value.code == 403
+    with pytest.raises(clientfs.FSError):
+        clientfs.resolve(str(root), "leaktok")
+    with pytest.raises(clientfs.FSError):
+        clientfs.list_dir(str(root), "leak")
+    # non-secret symlinks inside the alloc dir still resolve
+    os.makedirs(root / "data")
+    (root / "data" / "f").write_text("ok")
+    os.symlink(root / "data", root / "datalink")
+    assert clientfs.read_at(str(root), "datalink/f") == b"ok"
+
+
+def test_fs_still_denies_raw_secrets_and_escape(tmp_path):
+    root = tmp_path / "alloc"
+    os.makedirs(root / "web" / "secrets")
+    with pytest.raises(clientfs.FSError):
+        clientfs.resolve(str(root), "web/secrets/x")
+    with pytest.raises(clientfs.FSError):
+        clientfs.resolve(str(root), "../outside")
+
+
+# ------------------------------------------------------------------ 4
+def test_leader_pauses_three_quarters_of_workers():
+    from nomad_tpu.server.server import Server
+
+    server = Server(num_workers=8)
+    server.start()
+    try:
+        paused = [w for w in server.workers if w.paused.is_set()]
+        running = [w for w in server.workers if not w.paused.is_set()]
+        assert len(paused) == 6          # 8 // 4 * 3
+        assert len(running) == 2
+    finally:
+        server.stop()
+    assert not any(w.paused.is_set() for w in server.workers)
+
+
+def test_single_worker_never_paused():
+    from nomad_tpu.server.server import Server
+
+    server = Server(num_workers=1)
+    server.start()
+    try:
+        assert not server.workers[0].paused.is_set()
+    finally:
+        server.stop()
+
+
+def test_paused_workers_wake_on_backlog():
+    """The pause is soft: there are no follower workers in this
+    architecture, so a backlogged broker must still reach full worker
+    parallelism (divergence from leader.go:206-212, documented in
+    worker.py)."""
+    import time
+
+    from nomad_tpu.client.sim import wait_until
+    from nomad_tpu.server.server import Server
+
+    server = Server(num_workers=4)
+    server.start()
+    try:
+        assert sum(w.paused.is_set() for w in server.workers) == 3
+        jobs = []
+        for i in range(12):
+            job = mock.job()
+            job.id = f"wake-{i}"
+            job.task_groups[0].count = 0   # no capacity needed
+            server.register_job(job)
+            jobs.append(job)
+        # every register eval completes even though 3/4 workers are
+        # "paused" (follow-up blocked evals are not the workers' to run)
+        assert wait_until(lambda: all(
+            ev.status == "complete"
+            for j in jobs
+            for ev in server.store.evals_by_job("default", j.id)
+            if ev.triggered_by == "job-register"),
+            timeout=20)
+    finally:
+        server.stop()
