@@ -61,7 +61,7 @@ R_VEC = [200.0, 256.0, 300.0, 0.0]       # resident alloc usage vector
 
 # ---------------- scenario (mirrors stock_engine.cc) ----------------
 
-def make_nodes(n_nodes, devices=False):
+def make_nodes(n_nodes, devices=False, gen_seed=0):
     from nomad_tpu import mock
     nodes = []
     for i in range(n_nodes):
@@ -77,8 +77,8 @@ def make_nodes(n_nodes, devices=False):
         n.attributes["kernel.name"] = "linux"
         n.attributes["rack"] = f"r{i % 64}"
         n.attributes["zone"] = f"z{i % 16}"
-        n.node_resources.cpu = 4000 + (i % 8) * 1000
-        n.node_resources.memory_mb = 8192 + (i % 4) * 4096
+        n.node_resources.cpu = 4000 + ((i + gen_seed) % 8) * 1000
+        n.node_resources.memory_mb = 8192 + ((i + gen_seed * 3) % 4) * 4096
         n.node_resources.disk_mb = 100_000
         for net in n.node_resources.networks:
             net.mbits = 1000
@@ -93,7 +93,7 @@ def make_nodes(n_nodes, devices=False):
     return nodes
 
 
-def make_job(config, eval_ix, count):
+def make_job(config, eval_ix, count, gen_seed=0):
     """Mirrors stock_engine.cc make_job exactly."""
     from nomad_tpu import mock
     from nomad_tpu.structs import Affinity, Constraint, RequestedDevice, \
@@ -128,7 +128,8 @@ def make_job(config, eval_ix, count):
         job.constraints = [Constraint("${attr.kernel.name}", "linux", "=")]
         job.task_groups = [
             group(f"g{g}", max(1, count // 10),
-                  400 + (g % 4) * 150, 256 + (g % 4) * 128)
+                  400 + ((g + gen_seed) % 4) * 150,
+                  256 + ((g + gen_seed) % 4) * 128)
             for g in range(10)]
         return job
     if config == 3:
@@ -141,7 +142,8 @@ def make_job(config, eval_ix, count):
         job.spreads = [Spread(attribute="${node.datacenter}", weight=50)]
         job.task_groups = [
             group(f"g{g}", count // 4,
-                  400 + (g % 4) * 150, 256 + (g % 4) * 128)
+                  400 + ((g + gen_seed) % 4) * 150,
+                  256 + ((g + gen_seed) % 4) * 128)
             for g in range(4)]
         return job
     dev = 1 if config == 4 else 0
@@ -183,14 +185,18 @@ def _harvest(status_row, pb, asks, STATUS_RETRY):
 
 
 def run_ours(config, n_nodes, n_evals, count, resident,
-             evals_per_call=128, exact=False):
+             evals_per_call=128, exact=False, gen_seed=0):
     """Drive the ResidentSolver streaming pipeline over the config's
-    eval workload, PIPELINED: each chunk of evals_per_call evals packs
-    on the host while the previous chunk's solve runs on device (JAX
-    dispatch is async; usage carries chunk-to-chunk on device), then ONE
-    stacked result fetch pays the transport round trip once for the
-    whole workload.  Wave-budget leftovers drain in follow-up calls.
-    Returns metrics dict."""
+    eval workload.
+
+    Throughput mode is PIPELINED: each chunk of evals packs on the host
+    and dispatches immediately as its own chained device call (JAX
+    dispatch is async and chained calls add no round trip — the carried
+    usage serializes them on device), so packing rides entirely under
+    the previous chunks' solve; ONE concatenated result fetch then pays
+    the transport round trip once for the whole workload.  Wave-budget
+    leftovers drain in follow-up calls.  Exact mode (quality duel)
+    keeps the single fused call.  Returns metrics dict."""
     import dataclasses
     import jax
     import jax.numpy as jnp
@@ -198,19 +204,28 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     from nomad_tpu.solver.resident import (ResidentSolver, STATUS_RETRY)
 
     devices = config == 4
-    nodes = make_nodes(n_nodes, devices=devices)
+    nodes = make_nodes(n_nodes, devices=devices, gen_seed=gen_seed)
     t0 = time.perf_counter()
-    probe_job = make_job(config, 0, count)
+    probe_job = make_job(config, 0, count, gen_seed=gen_seed)
     epc = min(evals_per_call, n_evals)
     # throughput mode merges identical fresh asks at pack time (the
     # columnar payoff of coalescing evals: G shrinks to the number of
     # DISTINCT ask shapes, and every per-wave [G, N] pass shrinks with
     # it); exact mode keeps one group per ask
-    from nomad_tpu.solver.kernel import MERGED_GP_MAX
     merge = not exact
-    gp_need = (MERGED_GP_MAX if merge
-               else len(probe_job.task_groups) * epc)
     kp_need = count * epc
+    if merge:
+        # size the group axis to the workload's REAL distinct-shape
+        # count: every per-wave [G, N] pass scales with gp, and the
+        # merged stream needs exactly one row per distinct signature
+        # (config 2/4: 1, config 3: 4) — not the MERGED_GP_MAX=16 cap.
+        # Every eval's job has the same shape, so one job's signature
+        # set sizes the whole stream (all bench asks are stateless).
+        from nomad_tpu.solver.tensorize import Tensorizer
+        gp_need = len({Tensorizer.ask_signature(a)
+                       for a in asks_for(probe_job)})
+    else:
+        gp_need = len(probe_job.task_groups) * epc
     # exact mode uses serial-fidelity stacking commits (the reference's
     # per-placement best-fit packing — placement QUALITY over wave
     # count), with a budget deep enough to stack a full group
@@ -222,28 +237,31 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     rs.reset_usage(used0=resident_used0(rs.template, n_nodes, resident))
 
     # build the whole eval workload up front (job objects are cheap)
-    jobs = [make_job(config, e, count) for e in range(n_evals)]
+    jobs = [make_job(config, e, count, gen_seed=gen_seed)
+            for e in range(n_evals)]
 
-    # stacked single-fetch helper for drain rounds
+    # single-fetch helpers: concat for the main pipelined stream, stack
+    # for drain rounds
     stack_jit = jax.jit(lambda *xs: jnp.stack(xs))
+    concat_jit = jax.jit(lambda *xs: jnp.concatenate(xs))
 
-    # Ask packing is cheap relative to the transport round trip
-    # (~45ms of pack vs ~90ms RTT per call at config-2 scale), so the
-    # fastest schedule is ONE fused call for the whole workload: pack
-    # everything, dispatch once, fetch once.  (The previous two-call
-    # pipeline paid a second RTT to hide half the pack time — a net
-    # loss; measured 287K -> 390K placements/s on config 2.)
     NB = -(-n_evals // epc)
     # warm the compiles with the real batch shapes, then reset: the
-    # full-stream size and the drain-path variants (B=1 streams, small
-    # per-group counts -> the kernel's floor group_count_hint bucket)
+    # stream shapes (B=1 chained calls in merge mode, one fused B=NB
+    # call in exact mode), the concat/stack fetch arities, and the
+    # drain-path variants (small per-group counts -> the kernel's floor
+    # group_count_hint bucket)
     warm_asks = sum((asks_for(j) for j in jobs[:epc]), [])
     if merge:
         warm_asks, _wk = rs.merge_asks(warm_asks)
     warm = rs.pack_batch(warm_asks)
     warm.job_keys = None        # compile-only: bypass the same-job guard
-    np.asarray(rs.solve_stream_async(
-        [warm] * NB, seeds=None if exact else list(range(NB))))
+    if merge:
+        wouts = [rs.solve_stream_async([warm], seeds=[b + 1])
+                 for b in range(NB)]
+        np.asarray(concat_jit(*wouts))
+    else:
+        np.asarray(rs.solve_stream_async([warm] * NB, seeds=None))
     wout_b1 = rs.solve_stream_async([warm], seeds=None if exact else [1])
     for nd in (1, 2, 3, 4):     # drain fetch stacks (B=1 calls)
         np.asarray(stack_jit(*([wout_b1] * nd)))
@@ -257,31 +275,44 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     startup_s = time.perf_counter() - t0
 
     placed = failed = retried = unresolved = 0
-    n_calls = 0
+    n_fetches = 0
+    n_dispatches = 0
     t_start = time.perf_counter()
-    # single-fused-call main stream: pack all, dispatch once, fetch once
     asks_all = []
     batches = []
 
-    def pack_range(lo, hi):
-        out = []
-        for i in range(lo, hi, epc):
-            asks = sum((asks_for(j) for j in jobs[i:i + epc]), [])
-            keys = None
-            if merge:
-                asks, keys = rs.merge_asks(asks)
-            pb = rs.pack_batch(asks, job_keys=keys)
-            assert pb is not None, "bench asks must fit the universe"
-            asks_all.append(asks)
-            batches.append(pb)
-            out.append(pb)
-        return out
+    def pack_one(i):
+        asks = sum((asks_for(j) for j in jobs[i:i + epc]), [])
+        keys = None
+        if merge:
+            asks, keys = rs.merge_asks(asks)
+        # the whole-batch cache only suits the pipelined one-batch-per-
+        # call schedule; exact mode fuses MANY batches into one call and
+        # a shared pb object would confuse the same-job stream guard
+        pack = rs.pack_batch_cached if merge else rs.pack_batch
+        pb = pack(asks, job_keys=keys)
+        assert pb is not None, "bench asks must fit the universe"
+        asks_all.append(asks)
+        batches.append(pb)
+        return pb
 
-    g1 = pack_range(0, n_evals)
-    out1 = rs.solve_stream_async(
-        g1, seeds=None if exact else list(range(1, NB + 1)))
-    n_calls += 1
-    packed = np.asarray(out1)                          # ONE fetch
+    if merge:
+        # pipelined: pack chunk b+1 while chunk b solves (chained
+        # dispatches, no host sync), then ONE concatenated fetch
+        outs = []
+        for b in range(NB):
+            pb = pack_one(b * epc)
+            outs.append(rs.solve_stream_async([pb], seeds=[b + 1]))
+            n_dispatches += 1
+        packed = np.asarray(concat_jit(*outs))         # ONE fetch
+        n_fetches += 1
+    else:
+        for b in range(NB):
+            pack_one(b * epc)
+        out1 = rs.solve_stream_async(batches, seeds=None)
+        n_dispatches += 1
+        packed = np.asarray(out1)                      # ONE fetch
+        n_fetches += 1
     status = packed[:, :, -1].astype(np.int32)         # [NB, K]
 
     # wave-budget leftovers: resubmit ONLY the undecided counts, all
@@ -351,7 +382,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         for i, pb in enumerate(pbs):
             douts.append(rs.solve_stream_async(
                 [pb], seeds=None if exact else [1009 + 17 * t_retry + i]))
-            n_calls += 1
+            n_dispatches += 1
         # fetch in warmed-arity groups (the warm block compiled stack
         # arities 1-4): a heavy drain round must never compile inside
         # the timed region
@@ -359,6 +390,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         for i in range(0, len(douts), 4):
             grp = douts[i:i + 4]
             drows.append(np.asarray(stack_jit(*grp)))
+            n_fetches += 1
         dpacked = np.concatenate(drows, axis=0)
         dstatus = dpacked[:, 0, :, -1].astype(np.int32)
         nxt = []
@@ -386,7 +418,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         "engine": "nomad-tpu resident stream",
         "evals": total_evals, "placements": placed, "failed": failed,
         "retried": retried, "unresolved": unresolved,
-        "n_device_calls": n_calls,
+        "n_device_calls": n_fetches, "n_dispatches": n_dispatches,
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
@@ -454,7 +486,8 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
     t_start = time.perf_counter()
     for e, job in enumerate(jobs):
         t_call = time.perf_counter()
-        pb = rs.pack_batch(asks_for(job))
+        pack = getattr(rs, "pack_batch_cached", rs.pack_batch)
+        pb = pack(asks_for(job))
         n_calls += 0 if host else 1     # host mode never leaves the CPU
         _, ok, _, status = rs.solve_stream([pb], seeds=[e + 1])
         placed += int(ok[0, :pb.n_place, 0].sum())
@@ -508,47 +541,51 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
                  for e in range(n_evals)] for r in range(n_regions)]
     t0 = time.perf_counter()
     # one shared universe across regions: the federated solver packs
-    # it once (usage tensors stay per-region)
+    # it once (usage tensors stay per-region).  gp sized to the real
+    # distinct-signature count (see run_ours) — config 5's merged
+    # stream needs 1 row, not MERGED_GP_MAX
+    from nomad_tpu.solver.tensorize import Tensorizer
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
     fed = FederatedResidentSolver(
         [region_universe] * n_regions,
-        asks_for(probe_job), gp=MERGED_GP_MAX,
+        asks_for(probe_job), gp=1 << max(0, (gp_need - 1).bit_length()),
         kp=1 << max(0, (count * epc - 1).bit_length()), max_waves=18)
     used0_region = resident_used0(fed.solvers[0].template, n_nodes,
                                   resident)
     used0 = np.stack([used0_region] * n_regions)
 
-    # single fused call covering every region's full stream (see
-    # run_ours: packing is cheap next to the per-call round trip)
+    # pipelined per-step dispatch (see run_ours): pack step b for all
+    # regions, dispatch that one [R]-vmapped step as a chained call,
+    # pack step b+1 while it solves; ONE concatenated fetch at the end
+    import jax
     wasks, _wk = fed.merge_asks(0, sum(
         (asks_for(make_job(5, 9000 + e, count)) for e in range(epc)), []))
     warm = fed.pack_batch(0, wasks)
     warm.job_keys = None
-    np.asarray(fed.solve_stream_async(
-        [[warm] * NB] * n_regions,
-        seeds=[list(range(1, NB + 1))] * n_regions))
+    concat_jit = jax.jit(lambda *xs: jnp.concatenate(xs))
+    wouts = [fed.solve_stream_async([[warm]] * n_regions,
+                                    seeds=[[b + 1]] * n_regions)
+             for b in range(NB)]
+    np.asarray(concat_jit(*wouts))
     fed.reset_usage(used0=used0)
     startup_s = time.perf_counter() - t0
 
     t_start = time.perf_counter()
     batches = [[] for _ in range(n_regions)]
-
-    def pack_steps(lo_b, hi_b):
-        per_region = [[] for _ in range(n_regions)]
-        for b in range(lo_b, hi_b):
-            i = b * epc
-            for r in range(n_regions):
-                masks, mkeys = fed.merge_asks(r, sum(
-                    (asks_for(j) for j in all_jobs[r][i:i + epc]), []))
-                pb = fed.pack_batch(r, masks, job_keys=mkeys)
-                batches[r].append(pb)
-                per_region[r].append(pb)
-        return per_region
-
-    g1 = pack_steps(0, NB)
-    out1 = fed.solve_stream_async(
-        g1, seeds=[[r * NB + b + 1 for b in range(NB)]
-                   for r in range(n_regions)])
-    packed = np.asarray(out1)                         # ONE fetch
+    outs = []
+    for b in range(NB):
+        i = b * epc
+        step = []
+        for r in range(n_regions):
+            masks, mkeys = fed.merge_asks(r, sum(
+                (asks_for(j) for j in all_jobs[r][i:i + epc]), []))
+            pb = fed.pack_batch_cached(r, masks, job_keys=mkeys)
+            batches[r].append(pb)
+            step.append([pb])
+        outs.append(fed.solve_stream_async(
+            step, seeds=[[r * NB + b + 1] for r in range(n_regions)]))
+    packed = np.asarray(concat_jit(*outs))            # ONE fetch
     status = packed[:, :, :, -1].astype(np.int32)     # [NB, R, K]
 
     placed = failed = unresolved = 0
@@ -585,11 +622,11 @@ def ensure_stock_engine():
                         STOCK_SRC], check=True)
 
 
-def run_stock(config, n_nodes, n_evals, count, resident):
+def run_stock(config, n_nodes, n_evals, count, resident, gen_seed=0):
     ensure_stock_engine()
     out = subprocess.run(
         [STOCK_BIN, str(config), str(n_nodes), str(n_evals), str(count),
-         str(resident)],
+         str(resident), str(gen_seed)],
         check=True, capture_output=True, text=True).stdout
     return json.loads(out)
 
@@ -648,23 +685,31 @@ def run_config(config):
             "ratio_evals": round(ratio_e, 3)}
 
 
-def run_quality_duel():
+def run_quality_duel(config=3, n_nodes=512, count=64, load=1.15,
+                     gen_seed=0):
     """Pack-to-capacity: same over-subscribed workload on both engines;
     the engine with better bin-packing places more before exhaustion.
     Stock ranks max(2, log2 N) sampled nodes per placement; the solve
     scores all N. Config 3's mixed ask sizes (400-850 cpu) make
     fragmentation matter."""
-    n_nodes, count = 512, 64
-    # cpu-bound capacity ~= avg(7500)/avg-ask(625) per node
-    cap = int(n_nodes * (7500 / 625))
-    n_evals = int(cap * 1.15) // count
+    # capacity estimate per config shape: cpu-bound for plain/mixed
+    # asks, device-bound for config 4 (1 device/placement, 8 per
+    # device-bearing node, every 2nd node)
+    if config == 4:
+        cap = (n_nodes // 2) * 8
+    else:
+        avg_ask = 625 if config == 3 else 400
+        cap = int(n_nodes * (7500 / avg_ask))
+    n_evals = max(1, int(cap * load) // count)
     # quality mode: one eval per call, exact deterministic scoring (the
     # production single-eval path) - no throughput-mode jitter/offsets
-    ours = run_ours(3, n_nodes=n_nodes, n_evals=n_evals, count=count,
-                    resident=0, evals_per_call=1, exact=True)
-    stock = run_stock(3, n_nodes=n_nodes, n_evals=n_evals, count=count,
-                      resident=0)
+    ours = run_ours(config, n_nodes=n_nodes, n_evals=n_evals,
+                    count=count, resident=0, evals_per_call=1,
+                    exact=True, gen_seed=gen_seed)
+    stock = run_stock(config, n_nodes=n_nodes, n_evals=n_evals,
+                      count=count, resident=0, gen_seed=gen_seed)
     return {
+        "config": config, "load": load, "gen_seed": gen_seed,
         "workload_placements": n_evals * count,
         "capacity_estimate": cap,
         "ours_placed": ours["placements"],
@@ -674,10 +719,41 @@ def run_quality_duel():
     }
 
 
+def run_quality_sweep(seeds=(0, 1, 2, 3, 4)):
+    """Multi-seed, multi-shape, multi-load pack-to-capacity sweep
+    (VERDICT r4 item 3: one seed/one config is a tie, not a win).
+    Returns per-duel records + mean/min placed_ratio."""
+    duels = []
+    for config in (2, 3, 4):
+        for load in (0.95, 1.15):
+            for seed in seeds:
+                duels.append(run_quality_duel(
+                    config=config, load=load, gen_seed=seed))
+                sys.stderr.write(
+                    f"quality duel config={config} load={load} "
+                    f"seed={seed}: {duels[-1]['placed_ratio']}\n")
+    ratios = [d["placed_ratio"] for d in duels]
+    return {
+        "duels": duels,
+        "n": len(duels),
+        "mean_placed_ratio": round(sum(ratios) / len(ratios), 4),
+        "min_placed_ratio": min(ratios),
+        "max_placed_ratio": max(ratios),
+    }
+
+
 def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         # subprocess mode: run one config, print its record as JSON
         print("\x1e" + json.dumps(run_config(int(sys.argv[2]))))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--quality-sweep":
+        out = run_quality_sweep()
+        with open(os.path.join(REPO, "QUALITY_SWEEP.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({k: out[k] for k in
+                          ("n", "mean_placed_ratio", "min_placed_ratio",
+                           "max_placed_ratio")}))
         return
     only = int(sys.argv[1]) if len(sys.argv) > 1 else None
     results = []
@@ -728,7 +804,16 @@ def main():
     detail = {"configs": results,
               "transport_rtt_ms": round(1000 * rtt, 1)}
     if only is None:
-        detail["quality_pack_to_capacity"] = run_quality_duel()
+        # multi-seed / multi-shape / both-load sweep (30 duels): the
+        # quality claim must be systematic, not one lucky seed.  The
+        # classic headline duel is the sweep's (config 3, 1.15, seed 0)
+        # cell — reuse it rather than run a 31st duel
+        sweep = run_quality_sweep()
+        detail["quality_sweep"] = sweep
+        detail["quality_pack_to_capacity"] = next(
+            (d for d in sweep["duels"]
+             if d["config"] == 3 and d["load"] == 1.15
+             and d["gen_seed"] == 0), sweep["duels"][0])
         detail["notes"] = [
             "denominator: bench/stock_engine.cc — reference semantics "
             "(subsampled ranking, class-memoized feasibility, serial "
@@ -755,19 +840,19 @@ def main():
         with open(os.path.join(REPO, "BENCH_DETAIL.json"), "w") as f:
             json.dump(detail, f, indent=1)
     primary = next((r for r in results if r["config"] == 3), results[0])
-    ratios = [r["ratio_placements"] for r in results
-              if r["config"] != 1]
+    # ALL five configs count: 1 is interactive latency (native in-
+    # process solve), 2-5 are throughput streams — r4 verdict item 2
+    ratios = [r["ratio_placements"] for r in results]
     geomean = (math.exp(sum(math.log(max(r, 1e-9)) for r in ratios)
                         / len(ratios)) if ratios else None)
     print(json.dumps({
         "metric": ("placements/sec @10K nodes, 100K resident allocs, "
                    "constraints+affinity+spread (BASELINE config 3); "
                    "vs_baseline = geomean placement-throughput ratio "
-                   "over throughput configs 2-5 against the "
-                   "stock-semantics C++ engine; config 1 is the "
-                   "interactive-latency config, reported separately in "
-                   "BENCH_DETAIL.json (its per-eval p50 is one tunnel "
-                   "round trip)"),
+                   "over ALL FIVE configs (1 = interactive latency via "
+                   "the native in-process solver, 2-5 = streamed "
+                   "throughput) against the stock-semantics C++ "
+                   "engine"),
         "value": primary["ours"]["placements_per_sec"],
         "unit": "placements/sec",
         "vs_baseline": round(geomean, 3) if geomean is not None else None,

@@ -1,0 +1,123 @@
+"""Device-solve probe: wave counts, max_waves sensitivity, and the
+pipelined per-chunk dispatch schedule vs one fused call.
+
+    python bench/probe_solve.py [config...]
+"""
+import json
+import sys
+import time
+
+import os as _os
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import bench as B  # noqa: E402
+
+
+def run(config):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.kernel import MERGED_GP_MAX
+
+    p = B.CONFIGS[config]
+    n_nodes, n_evals, count, resident = (p["n_nodes"], p["n_evals"],
+                                         p["count"], p["resident"])
+    epc = min(128, n_evals)
+    nodes = B.make_nodes(n_nodes, devices=config == 4)
+    probe_job = B.make_job(config, 0, count)
+    kp = 1 << max(0, (count * epc - 1).bit_length())
+    jobs = [B.make_job(config, e, count) for e in range(n_evals)]
+    NB = -(-n_evals // epc)
+    out = {"config": config, "NB": NB}
+
+    def build(max_waves):
+        rs = ResidentSolver(nodes, B.asks_for(probe_job),
+                            gp=MERGED_GP_MAX, kp=kp, max_waves=max_waves)
+        batches = []
+        for i in range(0, n_evals, epc):
+            asks = sum((B.asks_for(j) for j in jobs[i:i + epc]), [])
+            asks, keys = rs.merge_asks(asks)
+            batches.append(rs.pack_batch(asks, job_keys=keys))
+        return rs, batches
+
+    def reset(rs):
+        rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes,
+                                              resident))
+
+    # --- wave-count diagnostics + max_waves sweep (fused call) ---
+    for mw in (10, 14, 18):
+        rs, batches = build(mw)
+        seeds = list(range(1, NB + 1))
+        reset(rs)
+        o = rs.solve_stream_async(batches, seeds=seeds)
+        np.asarray(o)                       # warm compile
+        ts, statuses = [], None
+        for _ in range(3):
+            reset(rs)
+            t0 = time.perf_counter()
+            o = rs.solve_stream_async(batches, seeds=seeds)
+            packed = np.asarray(o)
+            ts.append(time.perf_counter() - t0)
+        st = packed[:, :, -1].astype(np.int32)
+        placed = sum(int((st[b][:pb.n_place] == 1).sum())
+                     for b, pb in enumerate(batches))
+        retry = sum(int((st[b][:pb.n_place] == 2).sum())
+                    for b, pb in enumerate(batches))
+        out[f"fused_mw{mw}_ms"] = round(1000 * min(ts), 1)
+        out[f"fused_mw{mw}_placed"] = placed
+        out[f"fused_mw{mw}_retry"] = retry
+
+    # --- pipelined per-chunk dispatch (chained), one stacked fetch ---
+    rs, batches = build(18)
+    stack_jit = jax.jit(lambda *xs: jnp.concatenate(xs))
+    # warm the B=1 stream compile + the stack arity
+    reset(rs)
+    o1 = [rs.solve_stream_async([pb], seeds=[b + 1])
+          for b, pb in enumerate(batches)]
+    np.asarray(stack_jit(*o1))
+    ts = []
+    for _ in range(3):
+        reset(rs)
+        t0 = time.perf_counter()
+        outs = [rs.solve_stream_async([pb], seeds=[b + 1])
+                for b, pb in enumerate(batches)]
+        packed = np.asarray(stack_jit(*outs))
+        ts.append(time.perf_counter() - t0)
+    out["pipelined_b1_ms"] = round(1000 * min(ts), 1)
+    st = packed[:, :, -1].astype(np.int32)
+    out["pipelined_b1_placed"] = sum(
+        int((st[b][:pb.n_place] == 1).sum())
+        for b, pb in enumerate(batches))
+
+    # --- pipelined with packing INSIDE the timed loop (real schedule) ---
+    rs2, _ = build(18)
+    reset(rs2)
+    warm_asks = sum((B.asks_for(j) for j in jobs[:epc]), [])
+    warm_asks, _k = rs2.merge_asks(warm_asks)
+    wpb = rs2.pack_batch(warm_asks)
+    wpb.job_keys = None
+    np.asarray(stack_jit(*[rs2.solve_stream_async([wpb], seeds=[b + 1])
+                           for b in range(NB)]))
+    ts = []
+    for _ in range(3):
+        reset(rs2)
+        t0 = time.perf_counter()
+        outs = []
+        for b in range(NB):
+            i = b * epc
+            asks = sum((B.asks_for(j) for j in jobs[i:i + epc]), [])
+            asks, keys = rs2.merge_asks(asks)
+            pb = rs2.pack_batch(asks, job_keys=keys)
+            outs.append(rs2.solve_stream_async([pb], seeds=[b + 1]))
+        packed = np.asarray(stack_jit(*outs))
+        ts.append(time.perf_counter() - t0)
+    out["pipelined_pack_inline_ms"] = round(1000 * min(ts), 1)
+    return out
+
+
+if __name__ == "__main__":
+    cfgs = ([int(a) for a in sys.argv[1:]] or [2, 3, 4])
+    for c in cfgs:
+        print(json.dumps(run(c)))

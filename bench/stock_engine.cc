@@ -371,6 +371,9 @@ static bool apply_plan(State* st, const vector<Placement>& plan) {
 }
 
 // ---------------- scenario generator (mirrors bench.py) ----------------
+static int g_gen_seed = 0;   // scenario-generator seed (argv[6]);
+                             // mirrored by bench.py make_nodes/make_job
+
 static State make_cluster(int n_nodes, int resident, bool devices) {
   State st;
   st.nodes.resize(n_nodes);
@@ -381,8 +384,8 @@ static State make_cluster(int n_nodes, int resident, bool devices) {
     n.attrs["kernel.name"] = "linux";
     n.attrs["rack"] = "r" + std::to_string(i % 64);
     n.attrs["zone"] = "z" + std::to_string(i % 16);
-    n.cap.cpu = 4000 + (i % 8) * 1000;
-    n.cap.mem = 8192 + (i % 4) * 4096;
+    n.cap.cpu = 4000 + ((i + g_gen_seed) % 8) * 1000;
+    n.cap.mem = 8192 + ((i + g_gen_seed * 3) % 4) * 4096;
     n.cap.disk = 100000;
     n.cap.net = 1000;
     if (devices && i % 2 == 0) n.device_cap = 8;
@@ -414,7 +417,8 @@ static Job make_job(int config, int eval_ix, int count) {
     for (int g = 0; g < 10; ++g)
       j.groups.push_back(
           {"g" + std::to_string(g), std::max(1, count / 10),
-           {400 + (g % 4) * 150, 256 + (g % 4) * 128, 300, 0}, 0});
+           {400 + ((g + g_gen_seed) % 4) * 150,
+            256 + ((g + g_gen_seed) % 4) * 128, 300, 0}, 0});
     j.constraints.push_back({"${attr.kernel.name}", "linux", "="});
     return j;
   }
@@ -427,7 +431,8 @@ static Job make_job(int config, int eval_ix, int count) {
   int g_res = (config == 3) ? 4 : 1;
   for (int g = 0; g < g_res; ++g)
     j.groups.push_back({"g" + std::to_string(g), count / g_res,
-                        {400 + (g % 4) * 150, 256 + (g % 4) * 128, 300, 0},
+                        {400 + ((g + g_gen_seed) % 4) * 150,
+                         256 + ((g + g_gen_seed) % 4) * 128, 300, 0},
                         (config == 4) ? 1 : 0});
   return j;
 }
@@ -445,6 +450,7 @@ int main(int argc, char** argv) {
   int n_evals = std::atoi(argv[3]);
   int count = std::atoi(argv[4]);
   int resident = std::atoi(argv[5]);
+  if (argc > 6) g_gen_seed = std::atoi(argv[6]);
   int regions = (config == 5) ? 4 : 1;
 
   std::mt19937 rng(42);

@@ -43,12 +43,12 @@ from ..solver.tensorize import PackedBatch, PlacementAsk
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "has_distinct",
-                                    "has_devices"))
+                                    "has_devices", "compact"))
 def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
                              dev_cap, used0, dev_used0, stacked, n_places,
                              seeds, has_spread=True, group_count_hint=0,
                              max_waves=0, has_distinct=True,
-                             has_devices=True):
+                             has_devices=True, compact=True):
     """Node args carry a leading [R] region axis; `stacked` ask tensors
     carry [B, R, ...]; scan over B steps, vmap over R regions."""
 
@@ -71,9 +71,13 @@ def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
         status = jnp.where(res.choice_ok[:, :, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
-        packed = jnp.concatenate(
-            [res.choice.astype(jnp.float32), res.score,
-             status.astype(jnp.float32)[:, :, None]], axis=-1)
+        if compact:
+            from ..solver.resident import pack_out_compact
+            packed = pack_out_compact(res.choice, res.score, status)
+        else:
+            packed = jnp.concatenate(
+                [res.choice.astype(jnp.float32), res.score,
+                 status.astype(jnp.float32)[:, :, None]], axis=-1)
         return (res.used_final, res.dev_used_final), packed
 
     (used_f, dev_used_f), out = jax.lax.scan(
@@ -161,6 +165,13 @@ class FederatedResidentSolver:
                    ) -> Optional[PackedBatch]:
         return self.solvers[region].pack_batch(asks, job_keys=job_keys)
 
+    def pack_batch_cached(self, region: int,
+                          asks: Sequence[PlacementAsk],
+                          job_keys: Optional[set] = None
+                          ) -> Optional[PackedBatch]:
+        return self.solvers[region].pack_batch_cached(asks,
+                                                      job_keys=job_keys)
+
     # ---------------- solving ----------------
     def solve_stream(self, batches: Sequence[Sequence[PackedBatch]],
                      seeds: Optional[Sequence[Sequence[int]]] = None):
@@ -201,18 +212,16 @@ class FederatedResidentSolver:
             group_count_hint=ResidentSolver._group_count_hint(flat),
             max_waves=self.max_waves,
             has_distinct=ResidentSolver._has_distinct(flat),
-            has_devices=ResidentSolver._has_devices(flat))
+            has_devices=ResidentSolver._has_devices(flat),
+            compact=self.solvers[0]._compact)
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
                                           np.ndarray, np.ndarray]:
+        from ..solver.resident import unpack_stream
         out = np.asarray(out)                        # [B, R, K, .]
         out = np.swapaxes(out, 0, 1)                 # [R, B, K, .]
-        choice = out[..., :TOP_K].astype(np.int32)
-        score = out[..., TOP_K:2 * TOP_K]
-        status = out[..., -1].astype(np.int32)
-        ok = score > NEG_INF / 2
-        return choice, ok, score, status
+        return unpack_stream(out)
 
     def _stack_args(self, batches, NB):
         """[B, R, ...] host stack with the device-resident zero-constant

@@ -511,10 +511,10 @@ class Reconciler:
         ignore: AllocSet = {}
         inplace: AllocSet = {}
         destructive: AllocSet = {}
+        # classification is entirely the update fn's call (reference:
+        # computeUpdates defers to allocUpdateFn; the same-version
+        # short-circuit lives in util.go:846 genericAllocUpdateFn)
         for k, a in untainted.items():
-            if a.job is not None and a.job.version == self.job.version:
-                ignore[k] = a
-                continue
             ig, destroy, updated = self.alloc_update_fn(a, self.job, tg)
             if ig:
                 ignore[k] = a

@@ -183,6 +183,12 @@ def generic_alloc_update_fn(snapshot, plan: Plan):
     (reference: util.go:846 genericAllocUpdateFn)."""
     def update_fn(existing: Allocation, new_job: Job, new_tg: TaskGroup
                   ) -> Tuple[bool, bool, Optional[Allocation]]:
+        # same version: nothing to do (reference: util.go:846 "Same
+        # index, so nothing to do" — the check belongs HERE, not in the
+        # reconciler, so tests can drive update decisions directly)
+        if existing.job is not None and \
+                existing.job.version == new_job.version:
+            return True, False, None
         if existing.job is not None and tasks_updated(
                 existing.job, new_job, new_tg.name):
             return False, True, None

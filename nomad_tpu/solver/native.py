@@ -1,0 +1,301 @@
+"""ctypes loader for the native host solve kernel.
+
+Builds `native/host_solve.cc` into a shared object on first use (g++,
+no external deps) and exposes `native_solve_kernel`, a drop-in for
+`host.host_solve_kernel` returning the same SolveResult.  The numpy
+twin stays the reference implementation and the fallback — the native
+path exists because an interactive eval's wave arithmetic costs tens
+of microseconds in C++ vs ~1ms of ufunc overhead in numpy (the
+latency-mode p50 budget is sub-millisecond, BASELINE config 1).
+
+tests/test_native_solver.py differential-tests this against the numpy
+twin (bitwise-identical placements) across every feature: constraints,
+affinities, targeted/even spreads, distinct_hosts, devices, penalties,
+collocation counts, seeds, stack_commit.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .kernel import (MAX_WAVES, MERGED_GP_MAX, TOP_K, _MERGED_W_CAP,
+                     _WIDE_W_CAP, SolveResult)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "native", "host_solve.cc")
+_LIB = os.path.join(_DIR, "native", "_host_solve.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            tmp = _LIB + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True)
+            os.replace(tmp, _LIB)       # atomic vs concurrent builders
+        lib = ctypes.CDLL(_LIB)
+        lib.nomad_host_solve.restype = ctypes.c_int
+        return lib
+    except (OSError, subprocess.CalledProcessError):
+        _build_failed = True
+        return None
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build()
+    return _lib
+
+
+def _c(a, dtype):
+    a = np.ascontiguousarray(a, dtype=dtype)
+    return a, a.ctypes.data_as(ctypes.c_void_p)
+
+
+class PreparedTemplate:
+    """Node-side arrays marshaled once per solver (the template is
+    fixed for the solver's lifetime), plus reusable output and usage
+    buffers.  The per-eval cost of the native path is then one C call
+    + a couple of small copies — no per-call ctypes marshaling."""
+
+    def __init__(self, template):
+        f32, i32, u8 = np.float32, np.int32, np.uint8
+        t = template
+        self.avail = np.ascontiguousarray(t.avail, f32)
+        self.reserved = np.ascontiguousarray(t.reserved, f32)
+        self.valid = np.ascontiguousarray(t.valid, u8)
+        self.node_dc = np.ascontiguousarray(t.node_dc, i32)
+        self.attr_rank = np.ascontiguousarray(t.attr_rank, i32)
+        self.dev_cap = np.ascontiguousarray(t.dev_cap, f32)
+        self.Np, self.R = self.avail.shape
+        self.A = self.attr_rank.shape[1]
+        self.D = self.dev_cap.shape[1]
+        # carried usage: the native stream path mutates these in place
+        self.used = np.ascontiguousarray(t.used0, f32).copy()
+        self.dev_used = np.ascontiguousarray(t.dev_used0, f32).copy()
+
+    def reset_usage(self, used0, dev_used0):
+        np.copyto(self.used, np.asarray(used0, np.float32))
+        np.copyto(self.dev_used, np.asarray(dev_used0, np.float32))
+
+
+class PreparedRun:
+    """One PackedBatch's fully-marshaled native call.  Build once, run
+    many times (seed varies per run); the carried usage lives in the
+    PreparedTemplate's buffers and updates in place."""
+
+    def __init__(self, tp: PreparedTemplate, pb, has_spread: bool,
+                 hint: int, max_waves: int, stack_commit: bool):
+        lib = _get_lib()
+        assert lib is not None
+        f32, i32, u8 = np.float32, np.int32, np.uint8
+        self.tp = tp
+        Gp = pb.ask_res.shape[0]
+        C = pb.c_op.shape[1]
+        CA = pb.a_op.shape[1]
+        S = pb.sp_col.shape[1]
+        V = pb.sp_desired.shape[2]
+        K = pb.p_ask.shape[0]
+        NDC = pb.dc_ok.shape[1]
+        R, D, Np, A = tp.R, tp.D, tp.Np, tp.A
+        assert R <= 8
+        w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
+        self.K, self.TOP_K, self.Gp, self.C, self.Np, self.R = \
+            K, TOP_K, Gp, C, Np, R
+
+        self.sp_used0 = np.ascontiguousarray(pb.sp_used0, f32)
+        self.sp_used = self.sp_used0.copy()
+        self.out_idx = np.zeros((K, TOP_K), i32)
+        self.out_ok = np.zeros((K, TOP_K), u8)
+        self.out_score = np.zeros((K, TOP_K), f32)
+        self.out_nfeas = np.zeros(K, i32)
+        self.out_nexh = np.zeros(K, i32)
+        self.out_dimexh = np.zeros((K, R), i32)
+        self.out_unfin = np.zeros(K, u8)
+        self.out_waves = np.zeros(1, i32)
+
+        def P(a, dtype):
+            a = np.ascontiguousarray(a, dtype)
+            self._keep.append(a)
+            return ctypes.c_void_p(a.ctypes.data)
+
+        self._keep = []
+        args = [
+            P(tp.avail, f32), P(tp.reserved, f32),
+            P(tp.used, f32), P(tp.valid, u8), P(tp.node_dc, i32),
+            P(tp.attr_rank, i32),
+            P(pb.ask_res, f32), P(pb.ask_desired, f32),
+            P(pb.distinct, i32), P(pb.dc_ok, u8), P(pb.host_ok, u8),
+            P(pb.coll0, f32), P(pb.penalty, u8),
+            P(pb.c_op, i32), P(pb.c_col, i32), P(pb.c_rank, i32),
+            P(pb.a_op, i32), P(pb.a_col, i32), P(pb.a_rank, i32),
+            P(pb.a_weight, f32), P(pb.a_host, f32),
+            P(pb.sp_col, i32), P(pb.sp_weight, f32),
+            P(pb.sp_targeted, u8), P(pb.sp_desired, f32),
+            P(pb.sp_implicit, f32), P(self.sp_used, f32),
+            P(tp.dev_cap, f32), P(tp.dev_used, f32),
+            P(pb.dev_ask, f32), P(pb.p_ask, i32),
+            ctypes.c_int(int(pb.n_place)),
+            ctypes.c_int(Np), ctypes.c_int(Gp), ctypes.c_int(A),
+            ctypes.c_int(C), ctypes.c_int(CA), ctypes.c_int(S),
+            ctypes.c_int(V), ctypes.c_int(R), ctypes.c_int(D),
+            ctypes.c_int(K), ctypes.c_int(NDC),
+            ctypes.c_int(0),                      # seed slot
+            ctypes.c_int(1 if has_spread else 0),
+            ctypes.c_int(int(hint)),
+            ctypes.c_int(int(max_waves or MAX_WAVES)),
+            ctypes.c_int(1 if stack_commit else 0),
+            ctypes.c_int(w_cap),
+            P(self.out_idx, i32), P(self.out_ok, u8),
+            P(self.out_score, f32), P(self.out_nfeas, i32),
+            P(self.out_nexh, i32), P(self.out_dimexh, i32),
+            P(self.out_unfin, u8), P(self.out_waves, i32),
+            ctypes.c_void_p(0), ctypes.c_void_p(0),
+            # static-program cache: filled on the first run, read-only
+            # after (ask programs + template are fixed for this batch)
+            ctypes.c_int(0),
+            P(np.zeros((Gp, Np), u8), u8),            # feas
+            P(np.zeros((Gp, Np), f32), f32),          # aff
+            P(np.zeros((Gp, C), i32), i32),           # consf
+            P(np.zeros((S, Gp, Np), i32), i32),       # sp_vnode
+            P(np.zeros((S, Gp, Np), f32), f32),       # sp_des
+        ]
+        self._args = args
+        self._seed_ix = 43
+        self._static_ix = len(args) - 6
+        self._lib = lib
+
+    def run(self, seed: int) -> None:
+        """Execute; results land in the out_* buffers (overwritten per
+        run) and the carried usage updates in place."""
+        np.copyto(self.sp_used, self.sp_used0)
+        self._args[self._seed_ix] = ctypes.c_int(int(seed))
+        rc = self._lib.nomad_host_solve(*self._args)
+        assert rc == 0
+        if not self._args[self._static_ix].value:
+            self._args[self._static_ix] = ctypes.c_int(1)
+
+
+def native_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
+                        ask_res, ask_desired, distinct, dc_ok, host_ok,
+                        coll0, penalty,
+                        c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight,
+                        a_host, sp_col, sp_weight, sp_targeted, sp_desired,
+                        sp_implicit, sp_used0, dev_cap, dev_used0, dev_ask,
+                        p_ask, n_place, seed=0, *, has_spread=True,
+                        group_count_hint=0, max_waves=0,
+                        stack_commit=False,
+                        static_cache=None) -> SolveResult:
+    # static_cache: accepted for drop-in compatibility with the numpy
+    # twin; the native kernel recomputes its static program per call
+    # (tens of microseconds at latency-path sizes)
+    lib = _get_lib()
+    assert lib is not None, "native host solve unavailable"
+    f32, i32, u8 = np.float32, np.int32, np.uint8
+    Np, R = np.asarray(avail).shape
+    Gp = np.asarray(ask_res).shape[0]
+    A = np.asarray(attr_rank).shape[1]
+    C = np.asarray(c_op).shape[1]
+    CA = np.asarray(a_op).shape[1]
+    S = np.asarray(sp_col).shape[1]
+    V = np.asarray(sp_desired).shape[2]
+    D = np.asarray(dev_cap).shape[1]
+    K = np.asarray(p_ask).shape[0]
+    NDC = np.asarray(dc_ok).shape[1]
+    assert R <= 8, "native kernel caps R at 8"
+    w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
+
+    used, p_used = _c(np.array(used0, f32), f32)       # in/out copies
+    dev_used, p_devu = _c(np.array(dev_used0, f32), f32)
+    sp_used, p_spu = _c(np.array(sp_used0, f32), f32)
+    ins = [_c(avail, f32), _c(reserved, f32)]
+    a_avail, p_avail = ins[0]
+    a_res, p_res = ins[1]
+    a_valid, p_valid = _c(valid, u8)
+    a_ndc, p_ndc = _c(node_dc, i32)
+    a_ar, p_ar = _c(attr_rank, i32)
+    a_askres, p_askres = _c(ask_res, f32)
+    a_askdes, p_askdes = _c(ask_desired, f32)
+    a_dist, p_dist = _c(distinct, i32)
+    a_dcok, p_dcok = _c(dc_ok, u8)
+    a_hostok, p_hostok = _c(host_ok, u8)
+    a_coll0, p_coll0 = _c(coll0, f32)
+    a_pen, p_pen = _c(penalty, u8)
+    a_cop, p_cop = _c(c_op, i32)
+    a_ccol, p_ccol = _c(c_col, i32)
+    a_crank, p_crank = _c(c_rank, i32)
+    a_aop, p_aop = _c(a_op, i32)
+    a_acol, p_acol = _c(a_col, i32)
+    a_arank, p_arank = _c(a_rank, i32)
+    a_aw, p_aw = _c(a_weight, f32)
+    a_ah, p_ah = _c(a_host, f32)
+    a_spcol, p_spcol = _c(sp_col, i32)
+    a_spw, p_spw = _c(sp_weight, f32)
+    a_spt, p_spt = _c(sp_targeted, u8)
+    a_spd, p_spd = _c(sp_desired, f32)
+    a_spi, p_spi = _c(sp_implicit, f32)
+    a_devcap, p_devcap = _c(dev_cap, f32)
+    a_devask, p_devask = _c(dev_ask, f32)
+    a_pask, p_pask = _c(p_ask, i32)
+
+    out_idx = np.zeros((K, TOP_K), i32)
+    out_ok = np.zeros((K, TOP_K), u8)
+    out_score = np.zeros((K, TOP_K), f32)
+    out_nfeas = np.zeros(K, i32)
+    out_nexh = np.zeros(K, i32)
+    out_dimexh = np.zeros((K, R), i32)
+    out_unfin = np.zeros(K, u8)
+    out_waves = np.zeros(1, i32)
+    out_feas = np.zeros((Gp, Np), u8)
+    out_consf = np.zeros((Gp, C), i32)
+
+    def vp(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    rc = lib.nomad_host_solve(
+        p_avail, p_res, p_used, p_valid, p_ndc, p_ar,
+        p_askres, p_askdes, p_dist, p_dcok, p_hostok, p_coll0, p_pen,
+        p_cop, p_ccol, p_crank, p_aop, p_acol, p_arank, p_aw, p_ah,
+        p_spcol, p_spw, p_spt, p_spd, p_spi, p_spu,
+        p_devcap, p_devu, p_devask, p_pask,
+        ctypes.c_int(int(n_place)),
+        ctypes.c_int(Np), ctypes.c_int(Gp), ctypes.c_int(A),
+        ctypes.c_int(C), ctypes.c_int(CA), ctypes.c_int(S),
+        ctypes.c_int(V), ctypes.c_int(R), ctypes.c_int(D),
+        ctypes.c_int(K), ctypes.c_int(NDC), ctypes.c_int(int(seed)),
+        ctypes.c_int(1 if has_spread else 0),
+        ctypes.c_int(int(group_count_hint)),
+        ctypes.c_int(int(max_waves or MAX_WAVES)),
+        ctypes.c_int(1 if stack_commit else 0), ctypes.c_int(w_cap),
+        vp(out_idx), vp(out_ok), vp(out_score), vp(out_nfeas),
+        vp(out_nexh), vp(out_dimexh), vp(out_unfin), vp(out_waves),
+        vp(out_feas), vp(out_consf),
+        ctypes.c_int(0), ctypes.c_void_p(0), ctypes.c_void_p(0),
+        ctypes.c_void_p(0), ctypes.c_void_p(0), ctypes.c_void_p(0))
+    assert rc == 0
+    return SolveResult(
+        choice=out_idx, choice_ok=out_ok.astype(bool),
+        score=out_score, n_feasible=out_nfeas, n_exhausted=out_nexh,
+        dim_exhausted=out_dimexh, feas=out_feas.astype(bool),
+        cons_filtered=out_consf, used_final=used,
+        dev_used_final=dev_used, n_waves=out_waves[0],
+        unfinished=out_unfin.astype(bool))

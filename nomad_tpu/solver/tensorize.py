@@ -108,6 +108,17 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+def _pad_nodes(n: int) -> int:
+    """Node-axis padding.  Small clusters pad to a power of two (few
+    distinct compiled shapes across tests/dryruns); large clusters pad
+    to a multiple of 1024 — the TPU only needs lane alignment, and
+    pow2-padding 10K nodes to 16K would do 1.6x the [G, N] wave work
+    for nothing."""
+    if n <= 4096:
+        return _pad_pow2(max(n, 1))
+    return -(-n // 1024) * 1024
+
+
 @dataclass
 class PackedBatch:
     """Everything the kernel needs, as numpy arrays (device put by solver)."""
@@ -172,11 +183,32 @@ class Tensorizer:
 
     def __init__(self) -> None:
         self._class_memo: Dict[Tuple[str, tuple], bool] = {}
+        # shared read-only default [gp, Np] planes (see repack_asks)
+        self._planes: Dict[Tuple[str, int, int, int], np.ndarray] = {}
+
+    def _shared_plane(self, name: str, gp: int, Np: int,
+                      n_real: int) -> np.ndarray:
+        """Read-only default plane: all-zero (coll0/penalty/a_host) or
+        true-for-real-nodes (host_ok).  One allocation per shape for the
+        life of the tensorizer; identity marks it default downstream."""
+        key = (name, gp, Np, n_real)
+        arr = self._planes.get(key)
+        if arr is None:
+            if name == "host_ok":
+                arr = np.zeros((gp, Np), bool)
+                arr[:, :n_real] = True
+            elif name == "penalty":
+                arr = np.zeros((gp, Np), bool)
+            else:
+                arr = np.zeros((gp, Np), np.float32)
+            arr.flags.writeable = False
+            self._planes[key] = arr
+        return arr
 
     def pack(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
              allocs_by_node: Optional[Dict[str, list]] = None) -> PackedBatch:
         N = len(nodes)
-        Np = _pad_pow2(max(N, 1))
+        Np = _pad_nodes(N)
         G = len(asks)
         Gp = _pad_pow2(max(G, 1), floor=1)
 
@@ -518,38 +550,92 @@ class Tensorizer:
         state (existing allocs, penalties, blocked hosts, spread seeds),
         which is pasted onto the cached row per ask, and excludes
         ask.count, which only sizes the placement vector."""
-        job, tg = ask.job, ask.tg
+        return (Tensorizer.job_signature(ask.job),
+                Tensorizer.tg_signature(ask.tg))
 
-        def cons(cs):
-            return tuple((c.ltarget, c.rtarget, c.operand) for c in cs)
+    @staticmethod
+    def ask_signer():
+        """Per-call signature helper that memoizes the job-level half
+        by object identity — a batch's asks usually share few jobs, and
+        the job half is ~half the hashing cost.  Scope the returned
+        closure to ONE pack/merge call (identity memoization is only
+        sound while the caller holds the job objects)."""
+        jmemo: dict = {}
 
-        def affs(afs):
-            return tuple((a.ltarget, a.rtarget, a.operand, a.weight)
-                         for a in afs)
+        def sig(a):
+            js = jmemo.get(id(a.job))
+            if js is None:
+                js = Tensorizer.job_signature(a.job)
+                jmemo[id(a.job)] = js
+            return (js, Tensorizer.tg_signature(a.tg))
+        return sig
 
-        def sprs(sps):
-            return tuple(
-                (sp.attribute, sp.weight,
-                 tuple((t.value, t.percent)
-                       for t in (sp.spread_targets or ())))
-                for sp in sps)
+    @staticmethod
+    def job_signature(job):
+        """Job-level half of ask_signature — callers packing many asks
+        of ONE job compute it once."""
+        sig: list = []
+        add = sig.append
+        add("c")
+        for c in job.constraints:
+            add(c.ltarget); add(c.rtarget); add(c.operand)
+        add("a")
+        for a in job.affinities:
+            add(a.ltarget); add(a.rtarget); add(a.operand); add(a.weight)
+        add("s")
+        for sp in job.spreads:
+            # per-spread marker: targets are variable-arity, and two
+            # adjacent spreads must not flatten ambiguously
+            add("sp"); add(sp.attribute); add(sp.weight)
+            for t in (sp.spread_targets or ()):
+                add(t.value); add(t.percent)
+        add("d"); sig.extend(job.datacenters)
+        return tuple(sig)
 
-        task_sig = tuple(
-            (t.driver, cons(t.constraints), affs(t.affinities),
-             t.resources.cpu, t.resources.memory_mb, t.resources.disk_mb,
-             tuple((d.name, d.count, str(d.constraints))
-                   for d in t.resources.devices),
-             tuple(n.mbits for n in t.resources.networks))
-            for t in tg.tasks)
-        vol_sig = tuple(sorted(
-            (k, v.type, v.source, v.read_only)
-            for k, v in tg.volumes.items()))
-        net_sig = tuple(n.mbits for n in tg.networks)
-        return (cons(job.constraints), affs(job.affinities),
-                sprs(job.spreads), tuple(job.datacenters),
-                cons(tg.constraints), affs(tg.affinities), sprs(tg.spreads),
-                tg.count, tg.ephemeral_disk.size_mb, tg.ephemeral_disk.sticky,
-                vol_sig, net_sig, task_sig)
+    @staticmethod
+    def tg_signature(tg):
+        """Task-group half of ask_signature (flat append-driven build:
+        this runs once per ask on the pack critical path)."""
+        sig: list = []
+        add = sig.append
+        add("c")
+        for c in tg.constraints:
+            add(c.ltarget); add(c.rtarget); add(c.operand)
+        add("a")
+        for a in tg.affinities:
+            add(a.ltarget); add(a.rtarget); add(a.operand); add(a.weight)
+        add("s")
+        for sp in tg.spreads:
+            add("sp"); add(sp.attribute); add(sp.weight)
+            for t in (sp.spread_targets or ()):
+                add(t.value); add(t.percent)
+        add(tg.count); add(tg.ephemeral_disk.size_mb)
+        add(tg.ephemeral_disk.sticky)
+        if tg.volumes:
+            add("v")
+            sig.extend(sorted(
+                (k, v.type, v.source, v.read_only)
+                for k, v in tg.volumes.items()))
+        add("n")
+        for n in tg.networks:
+            add(n.mbits)
+        for t in tg.tasks:
+            add("t"); add(t.driver)
+            r = t.resources
+            add(r.cpu); add(r.memory_mb); add(r.disk_mb)
+            for c in t.constraints:
+                add(c.ltarget); add(c.rtarget); add(c.operand)
+            add("ta")
+            for a in t.affinities:
+                add(a.ltarget); add(a.rtarget); add(a.operand)
+                add(a.weight)
+            add("td")
+            for d in r.devices:
+                add(d.name); add(d.count); add(str(d.constraints))
+            add("tn")
+            for n in r.networks:
+                add(n.mbits)
+        return tuple(sig)
 
     def repack_asks(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
                     template: PackedBatch,
@@ -679,6 +765,7 @@ class Tensorizer:
                     (hostfeas.host_volumes_feasible(n, ask.tg)
                      for n in nodes), bool, N)
             row["host_ok"] = mask
+            row["host_ok_all"] = bool(mask.all())
 
             affs, haffs = [], []
             merged_affs = list(ask.job.affinities) + list(ask.tg.affinities)
@@ -711,6 +798,7 @@ class Tensorizer:
                 match = self._class_masked(nodes, c)
                 row["a_host"] += match * (aff.weight / total if total
                                           else 0.0)
+            row["a_host_zero"] = not haffs or not total
 
             dcs = set(ask.job.datacenters)
             for dc, did in template.dc_ids.items():
@@ -766,8 +854,9 @@ class Tensorizer:
         # pasted over the copy in the assembly loop below, so cached
         # rows are never mutated
         rows = []
+        signer = self.ask_signer()
         for ask in asks:
-            sig = self.ask_signature(ask) if row_cache is not None else None
+            sig = signer(ask) if row_cache is not None else None
             row = row_cache.get(sig) if sig is not None else None
             if row is None:
                 row = build_row(ask)
@@ -784,16 +873,34 @@ class Tensorizer:
         a_col = np.zeros((gp, CA), np.int32)
         a_rank = np.zeros((gp, CA), np.int32)
         a_weight = np.zeros((gp, CA), np.float32)
-        a_host = np.zeros((gp, Np), np.float32)
-        host_ok = np.zeros((gp, Np), bool)
-        host_ok[:, :N] = True       # padding rows keep the universe
+        # The [gp, Np] ask-side planes are DEFAULT for nearly every
+        # fresh-job batch (all-true host masks, no penalties, no
+        # existing allocs, no host affinities): hand out shared
+        # read-only singletons instead of allocating+filling ~MBs per
+        # batch — resident._stack_args recognizes them by identity and
+        # substitutes device-resident constants, so the default case
+        # never touches an O(G*N) byte on the host either.
+        need_a_host = any(not row["a_host_zero"] for row in rows)
+        need_host_ok = (any(not row["host_ok_all"] for row in rows)
+                        or any(a.distinct_hosts_blocked for a in asks))
+        need_coll0 = any(a.existing_by_node for a in asks)
+        need_penalty = any(a.penalty_nodes for a in asks)
+        a_host = (np.zeros((gp, Np), np.float32) if need_a_host
+                  else self._shared_plane("a_host", gp, Np, N))
+        if need_host_ok:
+            host_ok = np.zeros((gp, Np), bool)
+            host_ok[:, :N] = True   # padding rows keep the universe
+        else:
+            host_ok = self._shared_plane("host_ok", gp, Np, N)
         dc_ok = np.zeros((gp, NDC), bool)
         ask_res = np.zeros((gp, NUM_R), np.float32)
         ask_desired = np.ones(gp, np.float32)
         distinct = np.full(gp, -1, np.int32)
         distinct_interner = Interner()
-        coll0 = np.zeros((gp, Np), np.float32)
-        penalty = np.zeros((gp, Np), bool)
+        coll0 = (np.zeros((gp, Np), np.float32) if need_coll0
+                 else self._shared_plane("coll0", gp, Np, N))
+        penalty = (np.zeros((gp, Np), bool) if need_penalty
+                   else self._shared_plane("penalty", gp, Np, N))
         sp_col = np.full((gp, S), -1, np.int32)
         sp_weight = np.zeros((gp, S), np.float32)
         sp_targeted = np.zeros((gp, S), bool)
@@ -808,15 +915,17 @@ class Tensorizer:
             c_op[g], c_col[g], c_rank[g] = \
                 row["c_op"], row["c_col"], row["c_rank"]
             constraint_labels.append(row["labels"])
-            host_ok[g, :N] = row["host_ok"]
-            for nid in ask.distinct_hosts_blocked:
-                i = node_index.get(nid)
-                if i is not None:
-                    host_ok[g, i] = False
+            if need_host_ok:
+                host_ok[g, :N] = row["host_ok"]
+                for nid in ask.distinct_hosts_blocked:
+                    i = node_index.get(nid)
+                    if i is not None:
+                        host_ok[g, i] = False
             a_op[g], a_col[g], a_rank[g] = \
                 row["a_op"], row["a_col"], row["a_rank"]
             a_weight[g] = row["a_weight"]
-            a_host[g, :N] = row["a_host"]
+            if need_a_host:
+                a_host[g, :N] = row["a_host"]
             dc_ok[g] = row["dc_ok"]
             ask_res[g] = row["ask_res"]
             ask_desired[g] = row["ask_desired"]
@@ -825,14 +934,16 @@ class Tensorizer:
             elif row["distinct_kind"] == "tg":
                 distinct[g] = distinct_interner.intern(
                     f"tg:{ask.job.id}:{ask.tg.name}")
-            for nid, cnt in ask.existing_by_node.items():
-                i = node_index.get(nid)
-                if i is not None:
-                    coll0[g, i] = cnt
-            for nid in ask.penalty_nodes:
-                i = node_index.get(nid)
-                if i is not None:
-                    penalty[g, i] = True
+            if need_coll0:
+                for nid, cnt in ask.existing_by_node.items():
+                    i = node_index.get(nid)
+                    if i is not None:
+                        coll0[g, i] = cnt
+            if need_penalty:
+                for nid in ask.penalty_nodes:
+                    i = node_index.get(nid)
+                    if i is not None:
+                        penalty[g, i] = True
             sp_col[g], sp_weight[g] = row["sp_col"], row["sp_weight"]
             sp_targeted[g] = row["sp_targeted"]
             sp_desired[g] = row["sp_desired"]

@@ -375,24 +375,35 @@ class StateStore(StateSnapshot):
     def update_job_stability(self, index: int, namespace: str, job_id: str,
                              version: int, stable: bool) -> None:
         with self._lock:
-            key = (namespace, job_id)
-            for tbl in ("jobs",):
-                j = self._t[tbl].get(key)
-                if j is not None and j.version == version:
-                    import copy as _copy
-                    j2 = _copy.copy(j)
-                    j2.stable = stable
-                    j2.modify_index = index
-                    self._t[tbl][key] = j2
-            versions = list(self._t["job_versions"].get(key, ()))
-            for i, jv in enumerate(versions):
-                if jv.version == version:
-                    import copy as _copy
-                    j2 = _copy.copy(jv)
-                    j2.stable = stable
-                    versions[i] = j2
-            self._t["job_versions"][key] = versions
-            self._bump("jobs", index)
+            self._update_job_stability_locked(index, namespace, job_id,
+                                              version, stable)
+
+    def _update_job_stability_locked(self, index: int, namespace: str,
+                                     job_id: str, version: int,
+                                     stable: bool) -> None:
+        key = (namespace, job_id)
+        for tbl in ("jobs",):
+            j = self._t[tbl].get(key)
+            if j is not None and j.version == version:
+                import copy as _copy
+                j2 = _copy.copy(j)
+                j2.stable = stable
+                j2.modify_index = index
+                self._t[tbl][key] = j2
+        versions = list(self._t["job_versions"].get(key, ()))
+        for i, jv in enumerate(versions):
+            if jv.version == version:
+                import copy as _copy
+                j2 = _copy.copy(jv)
+                j2.stable = stable
+                versions[i] = j2
+        self._t["job_versions"][key] = versions
+        self._bump("jobs", index)
+
+    def _mark_stable_locked(self, index: int, namespace: str,
+                            job_id: str, version: int) -> None:
+        self._update_job_stability_locked(index, namespace, job_id,
+                                          version, True)
 
     def _ensure_summary(self, index: int, job: Job) -> None:
         key = (job.namespace, job.id)
@@ -959,6 +970,17 @@ class StateStore(StateSnapshot):
         d2.status_description = du.status_description
         d2.modify_index = index
         self._t["deployments"][du.deployment_id] = d2
+        # a deployment going SUCCESSFUL marks its job version stable in
+        # the SAME apply, no matter which path flipped it — the watcher
+        # or a reconciler plan (reference: state_store.go
+        # updateDeploymentStatusImpl -> updateJobStabilityImpl; the
+        # watcher racing the plan applier must not lose the stability
+        # bit)
+        from ..structs import DEPLOYMENT_STATUS_SUCCESSFUL
+        if (du.status == DEPLOYMENT_STATUS_SUCCESSFUL
+                and dep.status != DEPLOYMENT_STATUS_SUCCESSFUL):
+            self._mark_stable_locked(index, dep.namespace, dep.job_id,
+                                     dep.job_version)
 
     def upsert_deployment_updates(self, index: int, updates) -> None:
         """Standalone deployment status updates (reference:

@@ -149,7 +149,8 @@ def test_host_stream_matches_device_stream():
     nodes = make_nodes(50)
     probe = make_asks("constrained", count=4)
     rs = ResidentSolver(nodes, probe, gp=8, kp=32)
-    hs = HostResidentSolver(nodes, probe, gp=8, kp=32)
+    hs = HostResidentSolver(nodes, probe, gp=8, kp=32,
+                            device_parity=True)
 
     for seeds in (None, [3, 5, 9]):
         rs.reset_usage()

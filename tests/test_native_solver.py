@@ -1,0 +1,143 @@
+"""Differential tests: the native (C++) host solve must produce
+BITWISE-identical results to the numpy twin (solver/host.py), which is
+itself differential-tested against the device kernel.  The native path
+is the interactive-latency engine (BASELINE config 1); it is only
+sound if it is the same solve.
+"""
+import numpy as np
+import pytest
+
+from nomad_tpu.solver import native
+from nomad_tpu.solver.host import host_solve_kernel
+from nomad_tpu.solver.solve import _kernel_args
+from nomad_tpu.solver.tensorize import Tensorizer
+
+from test_host_solver import make_asks, make_nodes
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="g++ unavailable")
+
+
+def assert_bitwise(res_n, res_h):
+    np.testing.assert_array_equal(res_n.choice_ok, res_h.choice_ok)
+    np.testing.assert_array_equal(
+        np.where(res_n.choice_ok, res_n.choice, -1),
+        np.where(res_h.choice_ok, res_h.choice, -1))
+    # scores may differ by ~1 ulp (numpy's f32 power vs libm powf);
+    # everything discrete — placements, flags, usage — stays bitwise
+    np.testing.assert_allclose(
+        np.where(res_n.choice_ok, res_n.score, 0.0),
+        np.where(res_h.choice_ok, res_h.score, 0.0),
+        rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(res_n.used_final, res_h.used_final)
+    np.testing.assert_array_equal(res_n.dev_used_final,
+                                  res_h.dev_used_final)
+    np.testing.assert_array_equal(res_n.unfinished, res_h.unfinished)
+    np.testing.assert_array_equal(res_n.n_feasible, res_h.n_feasible)
+    np.testing.assert_array_equal(res_n.n_exhausted, res_h.n_exhausted)
+    np.testing.assert_array_equal(res_n.dim_exhausted,
+                                  res_h.dim_exhausted)
+    np.testing.assert_array_equal(res_n.feas, res_h.feas)
+    np.testing.assert_array_equal(res_n.cons_filtered,
+                                  res_h.cons_filtered)
+    assert int(res_n.n_waves) == int(res_h.n_waves)
+
+
+SCENARIOS = [
+    ("binpack", 40, 8, 0, False),
+    ("binpack", 40, 8, 3, False),          # seeded tie-break jitter
+    ("constrained", 60, 6, 0, False),      # constraints+affinity+spread
+    ("constrained", 60, 6, 7, False),
+    ("devices", 30, 4, 0, True),
+    ("distinct", 24, 6, 0, False),
+    ("binpack", 12, 30, 0, False),         # near capacity, many waves
+    ("constrained", 100, 10, 0, False),    # the config-1 shape
+]
+
+
+@pytest.mark.parametrize("style,n_nodes,count,seed,devices", SCENARIOS)
+@pytest.mark.parametrize("stack_commit", [False, True])
+def test_native_matches_numpy(style, n_nodes, count, seed, devices,
+                              stack_commit):
+    nodes = make_nodes(n_nodes, devices=devices)
+    asks = make_asks(style, count=count)
+    pb = Tensorizer().pack(nodes, asks)
+    has_spread = bool((pb.sp_col[:, 0] >= 0).any())
+    args = _kernel_args(pb)
+    res_h = host_solve_kernel(*args, seed, has_spread=has_spread,
+                              stack_commit=stack_commit)
+    res_n = native.native_solve_kernel(*args, seed,
+                                       has_spread=has_spread,
+                                       stack_commit=stack_commit)
+    assert_bitwise(res_n, res_h)
+
+
+def test_native_matches_with_existing_usage():
+    """coll0 + penalty + live usage from allocs_by_node."""
+    from nomad_tpu import mock
+    nodes = make_nodes(30)
+    asks = make_asks("binpack", count=6)
+    allocs = {}
+    for i, n in enumerate(nodes[:10]):
+        a = mock.alloc(node=n)
+        for tr in a.allocated_resources.tasks.values():
+            tr.networks = []
+        allocs[n.id] = [a]
+    pb = Tensorizer().pack(nodes, asks, allocs)
+    args = _kernel_args(pb)
+    res_h = host_solve_kernel(*args, has_spread=False)
+    res_n = native.native_solve_kernel(*args, has_spread=False)
+    assert_bitwise(res_n, res_h)
+
+
+def test_native_stream_matches_numpy_stream():
+    """HostResidentSolver with the native kernel must stream exactly
+    like the numpy-kernel solver (same host hint, carried usage)."""
+    from nomad_tpu.solver.host import HostResidentSolver
+
+    nodes = make_nodes(50)
+    probe = make_asks("constrained", count=4)
+    hn = HostResidentSolver(nodes, probe, gp=8, kp=32, use_native=True)
+    hp = HostResidentSolver(nodes, probe, gp=8, kp=32, use_native=False)
+    assert hn._native, "native path must be active for this test"
+    for seeds in (None, [3, 5, 9]):
+        hn.reset_usage()
+        hp.reset_usage()
+        bn, bp = [], []
+        for b in range(3):
+            asks = make_asks("constrained", count=4)
+            for a in asks:
+                a.job.id = f"job-{b}"
+            bn.append(hn.pack_batch(asks))
+            bp.append(hp.pack_batch(asks))
+        c_n, ok_n, s_n, st_n = hn.solve_stream(bn, seeds=seeds)
+        c_p, ok_p, s_p, st_p = hp.solve_stream(bp, seeds=seeds)
+        np.testing.assert_array_equal(ok_n, ok_p)
+        np.testing.assert_array_equal(np.where(ok_n, c_n, -1),
+                                      np.where(ok_p, c_p, -1))
+        np.testing.assert_array_equal(st_n, st_p)
+        u_n, _ = hn.usage()
+        u_p, _ = hp.usage()
+        np.testing.assert_array_equal(u_n, u_p)
+
+
+def test_native_randomized_fuzz():
+    """Random sizes/seeds across the feature grid — any divergence from
+    the numpy twin is a correctness bug in the native port."""
+    rng = np.random.default_rng(7)
+    for trial in range(12):
+        style = ["binpack", "constrained", "devices",
+                 "distinct"][trial % 4]
+        n_nodes = int(rng.integers(8, 70))
+        count = int(rng.integers(1, 12))
+        seed = int(rng.integers(0, 10))
+        nodes = make_nodes(n_nodes, devices=style == "devices")
+        asks = make_asks(style, count=count,
+                         n_groups=int(rng.integers(1, 5)))
+        pb = Tensorizer().pack(nodes, asks)
+        has_spread = bool((pb.sp_col[:, 0] >= 0).any())
+        args = _kernel_args(pb)
+        res_h = host_solve_kernel(*args, seed, has_spread=has_spread)
+        res_n = native.native_solve_kernel(*args, seed,
+                                           has_spread=has_spread)
+        assert_bitwise(res_n, res_h)
