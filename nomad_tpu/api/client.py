@@ -30,12 +30,26 @@ class APIError(Exception):
 
 class ApiClient:
     def __init__(self, address: Optional[str] = None,
-                 timeout: float = 330.0, token: Optional[str] = None):
+                 timeout: float = 330.0, token: Optional[str] = None,
+                 tls=None):
+        """`tls`: a utils.tlsutil.TLSConfig (or env NOMAD_CACERT /
+        NOMAD_CLIENT_CERT / NOMAD_CLIENT_KEY, like the reference api
+        client) — mutual TLS to an https agent address."""
         self.address = (address or os.environ.get("NOMAD_ADDR")
                         or "http://127.0.0.1:4646").rstrip("/")
         # reference: api.Config.SecretID / NOMAD_TOKEN (api/api.go)
         self.token = token or os.environ.get("NOMAD_TOKEN", "")
         self.timeout = timeout
+        if tls is None and os.environ.get("NOMAD_CACERT"):
+            from ..utils.tlsutil import TLSConfig
+            tls = TLSConfig(
+                ca_file=os.environ.get("NOMAD_CACERT", ""),
+                cert_file=os.environ.get("NOMAD_CLIENT_CERT", ""),
+                key_file=os.environ.get("NOMAD_CLIENT_KEY", ""))
+        self.ssl_context = None
+        if tls is not None and getattr(tls, "enabled", lambda: False)():
+            from ..utils.tlsutil import client_context
+            self.ssl_context = client_context(tls)
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -59,7 +73,8 @@ class ApiClient:
         if self.token:
             req.add_header("X-Nomad-Token", self.token)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self.ssl_context) as resp:
                 payload = json.loads(resp.read() or b"null")
                 index = int(resp.headers.get("X-Nomad-Index") or 0)
                 return payload, index
@@ -72,6 +87,11 @@ class ApiClient:
         except urllib.error.URLError as e:
             raise APIError(0, f"cannot reach agent at {self.address}: "
                               f"{e.reason}")
+        except OSError as e:
+            # e.g. a plaintext dial against a TLS listener resets mid-
+            # response; surface it as the same unreachable-agent error
+            raise APIError(0, f"cannot reach agent at {self.address}: "
+                              f"{e}")
 
     def get(self, path, **params):
         return self.request("GET", path, params=params)

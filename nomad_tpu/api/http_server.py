@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -70,15 +71,34 @@ class HTTPAgentServer:
     `client` (optional) the local node agent for agent-local routes."""
 
     def __init__(self, server, client=None, host: str = "127.0.0.1",
-                 port: int = 0, acl_enabled: bool = False):
+                 port: int = 0, acl_enabled: bool = False, tls=None):
+        """`tls`: utils.tlsutil.TLSConfig — serve /v1 over mutual TLS;
+        a client without a CA-signed cert is rejected at handshake
+        (reference: command/agent/http.go wraps the listener via
+        tlsutil.NewTLSConfiguration when tls.http is set)."""
         self.server = server
         self.client = client
         self.acl_enabled = acl_enabled
+        self.tls = tls
+        # every agent exposes /v1/agent/monitor: capture the package's
+        # logs from the moment the HTTP surface exists
+        from ..utils.monitor import global_monitor
+        global_monitor.install()
         self._routes = _build_routes(self)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                import ssl as _ssl
+                # self.request is the raw accepted socket (setup() has
+                # not assigned self.connection yet)
+                if isinstance(self.request, _ssl.SSLSocket):
+                    self.request.settimeout(10.0)
+                    self.request.do_handshake()
+                    self.request.settimeout(None)
+                super().setup()
 
             def log_message(self, *args):   # quiet
                 pass
@@ -89,6 +109,12 @@ class HTTPAgentServer:
                         and "/exec" in self.path
                         and self.path.startswith("/v1/client/allocation/")):
                     outer.handle_exec_ws(self)
+                    self.close_connection = True
+                    return
+                if (method == "GET"
+                        and self.path.split("?")[0]
+                        == "/v1/agent/monitor"):
+                    outer.handle_monitor(self)
                     self.close_connection = True
                     return
                 if method == "GET" and (self.path == "/ui"
@@ -145,13 +171,25 @@ class HTTPAgentServer:
         self._tl = threading.local()     # per-request token (for proxying)
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
+        if tls is not None and tls.enabled():
+            from ..utils.tlsutil import server_context
+            # do_handshake_on_connect=False: the handshake runs in the
+            # per-connection handler thread (with a deadline, below) —
+            # on-connect it would run inside accept() on the single
+            # serve_forever thread, letting one stalled client hang the
+            # whole API (the RPC server takes the same care)
+            self._httpd.socket = server_context(tls).wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ control
     @property
     def address(self) -> str:
         host, port = self._httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if (self.tls is not None
+                             and self.tls.enabled()) else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -317,6 +355,12 @@ class HTTPAgentServer:
             ok = a.allow_node_write() if write else a.allow_node_read()
             if not ok:
                 raise HTTPError(403, "node permission denied")
+            return
+        if path.startswith("/v1/agent/pprof"):
+            # runtime profiles expose internals: agent WRITE, like the
+            # reference's ACL-gated pprof (pprof.go:58 AgentWrite)
+            if not a.allow_agent_write():
+                raise HTTPError(403, "agent write permission required")
             return
         if path.startswith("/v1/agent") or path == "/v1/metrics":
             ok = a.allow_agent_write() if write else a.allow_agent_read()
@@ -666,6 +710,148 @@ class HTTPAgentServer:
 
     def metrics(self, q, body):
         return 200, global_metrics.dump(), None
+
+    # ----------------------------------------------- agent monitor/pprof
+    def handle_monitor(self, handler) -> None:
+        """/v1/agent/monitor — live log streaming (reference:
+        command/agent/monitor/monitor.go:14 + agent_endpoint.go
+        AgentMonitor): replay the ring of recent lines, then follow new
+        ones until the client disconnects.  ?log_level= filters;
+        ?node_id= routes to that node's agent and relays its stream."""
+        import queue as _q
+        from urllib.parse import parse_qs, urlparse
+        from ..utils.monitor import global_monitor, parse_level
+
+        url = urlparse(handler.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        token = handler.headers.get("X-Nomad-Token", "")
+        try:
+            self._enforce_acl("GET", "/v1/agent/monitor", q, None, token)
+        except HTTPError as e:
+            data = json.dumps({"error": e.msg}).encode()
+            handler.send_response(e.code)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+            return
+
+        node_id = q.get("node_id", "")
+        if node_id and not (self.client is not None
+                            and self.client.node.id.startswith(node_id)):
+            self._relay_monitor(handler, node_id, q, token)
+            return
+
+        min_level = parse_level(q.get("log_level", "debug"))
+        # bounded follow for polling clients/tests; 0 = until disconnect
+        try:
+            deadline_s = float(q.get("duration_s", 0) or 0)
+        except ValueError:
+            deadline_s = 0.0
+        sub = global_monitor.subscribe(min_level=min_level)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type",
+                                "text/plain; charset=utf-8")
+            handler.send_header("X-Accel-Buffering", "no")
+            handler.end_headers()
+            end = (time.monotonic() + deadline_s) if deadline_s else None
+            while True:
+                timeout = 1.0
+                if end is not None:
+                    timeout = min(timeout, end - time.monotonic())
+                    if timeout <= 0:
+                        return
+                try:
+                    levelno, line = sub.get(timeout=max(timeout, 0.01))
+                except _q.Empty:
+                    continue
+                if levelno < min_level:
+                    continue
+                handler.wfile.write(line.encode() + b"\n")
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+        finally:
+            global_monitor.unsubscribe(sub)
+
+    def _peer_conn(self, addr: str, timeout: float):
+        """HTTP(S) connection to a peer agent: when this cluster runs
+        TLS, every agent listener is HTTPS and relays must present this
+        agent's certificate too."""
+        import http.client as hc
+        if self.tls is not None and self.tls.enabled():
+            from ..utils.tlsutil import client_context
+            if getattr(self, "_relay_ctx", None) is None:
+                self._relay_ctx = client_context(self.tls)
+            return hc.HTTPSConnection(addr, timeout=timeout,
+                                      context=self._relay_ctx)
+        return hc.HTTPConnection(addr, timeout=timeout)
+
+    def _relay_monitor(self, handler, node_id: str, q, token) -> None:
+        """Stream another agent's monitor through this one (the
+        server-side hop of the reference's remote monitor)."""
+        import http.client as hc
+        from urllib.parse import urlencode
+        matches = [n for n in self.server.store.nodes()
+                   if n.id.startswith(node_id)]
+        if len(matches) != 1:
+            code = 400 if matches else 404
+            data = json.dumps({"error": f"node {node_id!r} "
+                               + ("ambiguous" if matches
+                                  else "not found")}).encode()
+            handler.send_response(code)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+            return
+        addr = matches[0].attributes.get("unique.advertise.http", "")
+        if not addr:
+            handler.send_response(502)
+            handler.end_headers()
+            return
+        qs = urlencode(dict(q, _routed="1"))
+        conn = self._peer_conn(addr, timeout=330.0)
+        try:
+            conn.request("GET", f"/v1/agent/monitor?{qs}",
+                         headers={"X-Nomad-Token": token or ""})
+            resp = conn.getresponse()
+            handler.send_response(resp.status)
+            handler.send_header("Content-Type",
+                                "text/plain; charset=utf-8")
+            handler.end_headers()
+            while True:
+                chunk = resp.read(4096)
+                if not chunk:
+                    return
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def agent_pprof(self, q, body, profile):
+        """/v1/agent/pprof/* (reference: command/agent/pprof/pprof.go:58
+        — ACL-gated runtime profiles).  Profiles: `profile` (sampled
+        CPU stacks, ?seconds=), `goroutine` (all-thread dump),
+        `cmdline`."""
+        from ..utils import monitor as monmod
+        if profile == "profile":
+            try:
+                seconds = min(float(q.get("seconds", 1.0)), 30.0)
+            except ValueError:
+                raise HTTPError(400, "seconds must be a number")
+            hz = 100
+            text = monmod.sample_profile(seconds=seconds, hz=hz)
+            return 200, {"profile": text, "seconds": seconds,
+                         "hz": hz}, None
+        if profile == "goroutine":
+            return 200, {"stacks": monmod.thread_dump(),
+                         "threads": threading.active_count()}, None
+        if profile == "cmdline":
+            return 200, {"cmdline": " ".join(sys.argv)}, None
+        raise HTTPError(404, f"unknown profile {profile!r} "
+                             "(have: profile, goroutine, cmdline)")
 
     def system_gc(self, q, body):
         self.server.force_gc()
@@ -1058,7 +1244,6 @@ class HTTPAgentServer:
                            q: Dict[str, str], body):
         """Forward one client-endpoint request to the owning agent and
         relay its JSON reply."""
-        import http.client as hc
         from urllib.parse import urlencode
         qs = urlencode(dict(q, _routed="1"))
         # the forwarded request may itself run a command with a
@@ -1067,7 +1252,7 @@ class HTTPAgentServer:
             budget = float((body or {}).get("timeout_s", 0)) + 30.0
         except (TypeError, ValueError):
             budget = 30.0
-        conn = hc.HTTPConnection(remote, timeout=max(60.0, budget))
+        conn = self._peer_conn(remote, timeout=max(60.0, budget))
         try:
             conn.request(
                 method, f"{path}?{qs}",
@@ -1457,6 +1642,7 @@ def _build_routes(s: HTTPAgentServer):
          {"GET": s.deployment_allocations}),
         (R(r"^/v1/deployment/([^/]+)$"), {"GET": s.deployment_get}),
         (R(r"^/v1/agent/self$"), {"GET": s.agent_self}),
+        (R(r"^/v1/agent/pprof/([^/]+)$"), {"GET": s.agent_pprof}),
         (R(r"^/v1/agent/members$"), {"GET": s.agent_members}),
         (R(r"^/v1/status/leader$"), {"GET": s.status_leader}),
         (R(r"^/v1/status/peers$"), {"GET": s.status_peers}),

@@ -39,6 +39,23 @@ class AgentConfig:
     datacenter: str = "dc1"
     meta: Dict[str, str] = field(default_factory=dict)
     acl_enabled: bool = False
+    log_level: str = "info"   # reference: config.Config.LogLevel
+    # tls stanza (reference: config.TLSConfig — http/rpc toggles over
+    # one CA + cert pair)
+    tls_http: bool = False
+    tls_rpc: bool = False
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+
+    def tls_config(self):
+        from ..utils.tlsutil import TLSConfig
+        if not (self.tls_ca_file and self.tls_cert_file
+                and self.tls_key_file):
+            return None
+        return TLSConfig(ca_file=self.tls_ca_file,
+                         cert_file=self.tls_cert_file,
+                         key_file=self.tls_key_file)
 
 
 class AgentConfigError(ValueError):
@@ -64,7 +81,7 @@ def _hcl_to_dict(body) -> dict:
     """Lower a parsed HCL Body (attrs + one level of named blocks, with
     the client.meta sub-block folded in) to the JSON config shape."""
     d = dict(body.attrs)
-    for name in ("ports", "server", "client", "acl"):
+    for name in ("ports", "server", "client", "acl", "tls"):
         for _labels, blk in body.blocks_named(name):
             sub = d.setdefault(name, {})
             sub.update(blk.attrs)
@@ -89,6 +106,13 @@ def _from_dict(d: dict) -> AgentConfig:
     cfg.meta.update({k: str(v) for k, v in (cl.get("meta") or {}).items()})
     cfg.acl_enabled = bool((d.get("acl") or {}).get("enabled",
                                                     cfg.acl_enabled))
+    cfg.log_level = str(d.get("log_level", cfg.log_level))
+    tls = d.get("tls") or {}
+    cfg.tls_http = bool(tls.get("http", cfg.tls_http))
+    cfg.tls_rpc = bool(tls.get("rpc", cfg.tls_rpc))
+    cfg.tls_ca_file = tls.get("ca_file", cfg.tls_ca_file)
+    cfg.tls_cert_file = tls.get("cert_file", cfg.tls_cert_file)
+    cfg.tls_key_file = tls.get("key_file", cfg.tls_key_file)
     return cfg
 
 

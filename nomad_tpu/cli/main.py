@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import sys
 import time
 from typing import List, Optional
@@ -64,6 +65,15 @@ def cmd_agent(args) -> int:
     workers = (args.workers if args.workers is not None
                else cfg.num_schedulers)
     acl_enabled = args.acl_enabled or cfg.acl_enabled
+    # the agent's own logging level (the monitor endpoint streams what
+    # this emits); operators embedding the library configure logging
+    # themselves
+    from ..utils.monitor import parse_level
+    logging.getLogger("nomad_tpu").setLevel(parse_level(cfg.log_level))
+    if cfg.tls_rpc:
+        print("WARNING: tls { rpc = true } has no effect in -dev mode "
+              "(single process, no RPC sockets); serve_cluster wires "
+              "RPC TLS for multi-server deployments", file=sys.stderr)
     server = Server(num_workers=workers)
     server.start()
     client = None
@@ -73,7 +83,9 @@ def cmd_agent(args) -> int:
                         meta=cfg.meta or None)
         client.start()
     http = HTTPAgentServer(server, client, host=bind, port=port,
-                           acl_enabled=acl_enabled)
+                           acl_enabled=acl_enabled,
+                           tls=(cfg.tls_config() if cfg.tls_http
+                                else None))
     http.start()
     print(f"==> nomad-tpu agent started (dev mode)")
     print(f"    HTTP: {http.address}")
@@ -88,6 +100,85 @@ def cmd_agent(args) -> int:
         if client is not None:
             client.shutdown(halt_tasks=True)
         server.stop()
+    return 0
+
+
+# -------------------------------------------------------------- monitor
+def cmd_monitor(args) -> int:
+    """`monitor` — stream agent logs (reference: command/monitor.go)."""
+    import urllib.request
+    api = _client(args)
+    params = [f"log_level={args.log_level}"]
+    if args.node_id:
+        params.append(f"node_id={args.node_id}")
+    if args.duration:
+        params.append(f"duration_s={args.duration}")
+    url = f"{api.address}/v1/agent/monitor?" + "&".join(params)
+    req = urllib.request.Request(url)
+    if api.token:
+        req.add_header("X-Nomad-Token", api.token)
+    try:
+        with urllib.request.urlopen(req, timeout=330.0,
+                                    context=api.ssl_context) as resp:
+            for raw in resp:
+                sys.stdout.write(raw.decode(errors="replace"))
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+    except urllib.error.HTTPError as e:
+        # clean CLI error, matching every other command's ACL/4xx path
+        try:
+            msg = json.loads(e.read()).get("error", str(e))
+        except Exception:
+            msg = str(e)
+        raise APIError(e.code, msg)
+    except (urllib.error.URLError, OSError) as e:
+        raise APIError(0, f"cannot reach agent at {api.address}: {e}")
+    return 0
+
+
+# ------------------------------------------------------------------ tls
+def cmd_tls_ca(args) -> int:
+    """`tls ca create` (reference: command/tls_ca_create.go)."""
+    import os
+    from ..utils import tlsutil
+    ca_pem, key_pem = tlsutil.generate_ca()
+    ca = os.path.join(args.dir, "nomad-agent-ca.pem")
+    key = os.path.join(args.dir, "nomad-agent-ca-key.pem")
+    with open(ca, "wb") as f:
+        f.write(ca_pem)
+    tlsutil.write_private(key, key_pem)
+    print(f"==> CA certificate saved to {ca}")
+    print(f"==> CA key saved to {key} (keep this private)")
+    return 0
+
+
+def cmd_tls_cert(args) -> int:
+    """`tls cert create` (reference: command/tls_cert_create.go)."""
+    import os
+    from ..utils import tlsutil
+    ca = os.path.join(args.dir, "nomad-agent-ca.pem")
+    key = os.path.join(args.dir, "nomad-agent-ca-key.pem")
+    try:
+        with open(ca, "rb") as f:
+            ca_pem = f.read()
+        with open(key, "rb") as f:
+            ca_key = f.read()
+    except OSError as e:
+        print(f"cannot read CA material in {args.dir}: {e} "
+              "(run `tls ca create` first)", file=sys.stderr)
+        return 1
+    sans = ["localhost"] + list(args.additional_dns)
+    ips = ["127.0.0.1"] + list(args.additional_ip)
+    cert_pem, key_pem = tlsutil.generate_cert(
+        ca_pem, ca_key, args.role, sans=sans, ips=ips)
+    cpath = os.path.join(args.dir, f"{args.role}.pem")
+    kpath = os.path.join(args.dir, f"{args.role}-key.pem")
+    with open(cpath, "wb") as f:
+        f.write(cert_pem)
+    tlsutil.write_private(kpath, key_pem)
+    print(f"==> certificate saved to {cpath}")
+    print(f"==> key saved to {kpath}")
     return 0
 
 
@@ -715,6 +806,29 @@ def build_parser() -> argparse.ArgumentParser:
 
     mt = sub.add_parser("metrics", help="dump agent metrics")
     mt.set_defaults(fn=cmd_metrics)
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", dest="log_level", default="info")
+    mon.add_argument("-node-id", dest="node_id", default="")
+    mon.add_argument("-duration", dest="duration", default="",
+                     help="stop after N seconds (default: follow)")
+    mon.set_defaults(fn=cmd_monitor)
+
+    tls = sub.add_parser("tls", help="mint cluster TLS material"
+                         ).add_subparsers(dest="tls_cmd", required=True)
+    tca = tls.add_parser("ca", help="create a cluster CA")
+    tca.add_argument("create", choices=["create"])
+    tca.add_argument("-d", dest="dir", default=".")
+    tca.set_defaults(fn=cmd_tls_ca)
+    tcr = tls.add_parser("cert", help="create a CA-signed role cert")
+    tcr.add_argument("create", choices=["create"])
+    tcr.add_argument("-role", default="server.global.nomad",
+                     help="server.<region>.nomad / client.<region>."
+                          "nomad / cli.<region>.nomad")
+    tcr.add_argument("-d", dest="dir", default=".")
+    tcr.add_argument("-additional-dns", action="append", default=[])
+    tcr.add_argument("-additional-ip", action="append", default=[])
+    tcr.set_defaults(fn=cmd_tls_cert)
     return p
 
 

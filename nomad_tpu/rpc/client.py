@@ -27,18 +27,28 @@ class RpcError(Exception):
 
 
 class RpcClient:
-    def __init__(self, addr: Tuple[str, int], pool_size: int = 4):
+    def __init__(self, addr: Tuple[str, int], pool_size: int = 4,
+                 tls=None):
+        """`tls`: an ssl.SSLContext from tlsutil.client_context —
+        presents this node's cert and verifies the server against the
+        cluster CA on every pooled dial."""
         self.addr = (addr[0], int(addr[1]))
         self._pool: List[socket.socket] = []
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._pool_size = pool_size
+        self._tls = tls
 
     def call(self, method: str, params: List[Any],
              timeout: float = CALL_TIMEOUT_S) -> Any:
         """One request/response. Raises RpcError for typed application
         errors and ConnectionError for transport failures."""
-        sock = self._checkout()
+        try:
+            sock = self._checkout()
+        except OSError as e:
+            # dial/handshake failures (incl. TLS verification) present
+            # uniformly as transport errors
+            raise ConnectionError(f"rpc dial {self.addr}: {e}") from e
         try:
             sock.settimeout(timeout)
             send_frame(sock, {"id": next(self._ids), "method": method,
@@ -75,6 +85,9 @@ class RpcClient:
         sock = socket.create_connection(self.addr,
                                         timeout=DIAL_TIMEOUT_S)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._tls is not None:
+            sock = self._tls.wrap_socket(
+                sock, server_hostname=self.addr[0])
         return sock
 
     def _checkin(self, sock: socket.socket) -> None:
@@ -92,9 +105,10 @@ class ClientPool:
     """Keyed RpcClient pool shared by the raft transport and the server
     endpoints; replacing a key's address closes the old client."""
 
-    def __init__(self):
+    def __init__(self, tls=None):
         self._clients: Dict[str, RpcClient] = {}
         self._lock = threading.Lock()
+        self._tls = tls
 
     def get(self, key: str, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], int(addr[1]))
@@ -103,7 +117,7 @@ class ClientPool:
             if c is None or c.addr != addr:
                 if c is not None:
                     c.close()
-                c = RpcClient(addr)
+                c = RpcClient(addr, tls=self._tls)
                 self._clients[key] = c
             return c
 

@@ -28,11 +28,12 @@ class ServerRpc:
     """
 
     def __init__(self, server, rpc_server: RpcServer,
-                 peer_addrs: Optional[Dict[str, Tuple[str, int]]] = None):
+                 peer_addrs: Optional[Dict[str, Tuple[str, int]]] = None,
+                 tls=None):
         self.server = server
         self.rpc = rpc_server
         self.peer_addrs = dict(peer_addrs or {})
-        self._pool = ClientPool()
+        self._pool = ClientPool(tls=tls)
         # leader_only verbs forward to the leader up front (heartbeats
         # must reset the LEADER's failure detector, not a follower's
         # disabled one — nomad/rpc.go forward() runs before the handler);
@@ -123,10 +124,10 @@ class RpcServerEndpoints(ServerEndpoints):
     failover (reference: client/servers/ rebalancing — on a transport
     error the next server in the list is tried)."""
 
-    def __init__(self, addrs: Sequence[Tuple[str, int]]):
+    def __init__(self, addrs: Sequence[Tuple[str, int]], tls=None):
         assert addrs, "need at least one server address"
         self.addrs = [(h, int(p)) for h, p in addrs]
-        self._clients = [RpcClient(a) for a in self.addrs]
+        self._clients = [RpcClient(a, tls=tls) for a in self.addrs]
         self._current = 0
         self._lock = threading.Lock()
 
@@ -176,7 +177,8 @@ class RpcServerEndpoints(ServerEndpoints):
 
 
 def serve_cluster(n: int = 3, host: str = "127.0.0.1", num_workers: int = 1,
-                  server_kwargs: Optional[dict] = None):
+                  server_kwargs: Optional[dict] = None,
+                  tls_server=None, tls_client=None):
     """Boot an n-server cluster wired over TCP: one RpcServer per member
     carrying both the raft verbs and the server endpoints. Returns
     (servers, server_rpcs, addrs). The reference's in-process test
@@ -186,16 +188,16 @@ def serve_cluster(n: int = 3, host: str = "127.0.0.1", num_workers: int = 1,
     from .transport import TcpRaftTransport
 
     ids = [f"s{i + 1}" for i in range(n)]
-    rpcs = [RpcServer(host, 0) for _ in ids]
+    rpcs = [RpcServer(host, 0, tls=tls_server) for _ in ids]
     addrs = {pid: rpc.addr for pid, rpc in zip(ids, rpcs)}
     servers, server_rpcs = [], []
     for pid, rpc in zip(ids, rpcs):
-        transport = TcpRaftTransport(rpc, addrs)
+        transport = TcpRaftTransport(rpc, addrs, tls=tls_client)
         srv = Server(num_workers=num_workers,
                      raft_config=RaftConfig(node_id=pid, peers=list(ids)),
                      raft_transport=transport,
                      **(server_kwargs or {}))
-        server_rpcs.append(ServerRpc(srv, rpc, addrs))
+        server_rpcs.append(ServerRpc(srv, rpc, addrs, tls=tls_client))
         servers.append(srv)
         rpc.start()
     for srv in servers:

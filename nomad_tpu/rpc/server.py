@@ -16,8 +16,14 @@ _log = logging.getLogger(__name__)
 
 
 class RpcServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
+        """`tls`: an ssl.SSLContext from tlsutil.server_context —
+        mutual TLS; a client with no CA-signed cert fails the
+        handshake before a single frame is read (reference:
+        nomad/rpc.go:99-115 wraps every conn in tls.Server)."""
         self._handlers: Dict[str, Callable[[List[Any]], Any]] = {}
+        self._tls = tls
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -56,6 +62,20 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls is not None:
+            try:
+                # a short handshake deadline so a plaintext client
+                # can't pin the thread; cleared for the frame loop
+                conn.settimeout(5.0)
+                conn = self._tls.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except (OSError, ValueError) as e:
+                _log.debug("rpc tls handshake rejected: %s", e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         try:
             while not self._shutdown.is_set():
                 try:

@@ -35,12 +35,13 @@ VOTE_PROBE_WINDOW_S = 2 * VOTE_PROBE_TIMEOUT_S
 
 class TcpRaftTransport:
     def __init__(self, rpc_server: RpcServer,
-                 peer_addrs: Dict[str, Tuple[str, int]]):
+                 peer_addrs: Dict[str, Tuple[str, int]], tls=None):
         """peer_addrs: raft node id -> (host, port) of that peer's
-        RpcServer (including this node's own)."""
+        RpcServer (including this node's own).  `tls`: client-side
+        ssl context for peer dials (mutual TLS)."""
         self.rpc_server = rpc_server
         self.peer_addrs = dict(peer_addrs)
-        self._pool = ClientPool()
+        self._pool = ClientPool(tls=tls)
         self._lock = threading.Lock()
         self._local: Dict[str, Any] = {}
         self._backoff: Dict[str, Tuple[float, int]] = {}  # until, fails

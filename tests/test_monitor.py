@@ -1,0 +1,160 @@
+"""Agent monitor + pprof endpoints (VERDICT r4 missing item 3).
+
+Reference: command/agent/monitor/monitor.go:14 (live log streaming),
+command/agent/pprof/pprof.go:58 (ACL-gated runtime profiles),
+command/monitor.go (the CLI).
+"""
+import io
+import logging
+import threading
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import ApiClient
+from nomad_tpu.api.http_server import HTTPAgentServer
+from nomad_tpu.client.agent import Client
+from nomad_tpu.client.sim import wait_until
+from nomad_tpu.server.server import Server
+from nomad_tpu.utils.monitor import (LogMonitor, global_monitor,
+                                     sample_profile, thread_dump)
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    # the monitor observes whatever the logging config emits; the dev
+    # agent sets this from its log_level stanza — tests do it here
+    logging.getLogger("nomad_tpu").setLevel(logging.DEBUG)
+    server = Server(num_workers=1)
+    server.start()
+    client = Client(server,
+                    data_dir=str(tmp_path_factory.mktemp("mon")))
+    client.start()
+    http = HTTPAgentServer(server, client, port=0)
+    http.start()
+    yield server, client, http
+    http.stop()
+    client.shutdown(halt_tasks=True)
+    server.stop()
+
+
+def _fetch(url, timeout=15.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode(errors="replace")
+
+
+def test_monitor_streams_backlog_and_live_lines(agent):
+    server, client, http, = agent
+    log = logging.getLogger("nomad_tpu.test_monitor")
+    log.info("backlog-marker-1")
+
+    live = threading.Timer(0.4, lambda: log.warning("live-marker-2"))
+    live.start()
+    try:
+        body = _fetch(f"{http.address}/v1/agent/monitor?duration_s=1.5")
+    finally:
+        live.cancel()
+    assert "backlog-marker-1" in body
+    assert "live-marker-2" in body
+
+
+def test_monitor_log_level_filters(agent):
+    server, client, http = agent
+    log = logging.getLogger("nomad_tpu.test_monitor")
+    log.debug("noisy-debug-line")
+    log.error("important-error-line")
+    body = _fetch(
+        f"{http.address}/v1/agent/monitor?log_level=error&duration_s=0.3")
+    assert "important-error-line" in body
+    assert "noisy-debug-line" not in body
+
+
+def test_monitor_routes_to_owning_node(agent):
+    """?node_id= relays the target agent's stream through this one."""
+    server, client, http = agent
+    log = logging.getLogger("nomad_tpu.test_monitor")
+    log.info("routed-marker-3")
+    nid = client.node.id[:8]
+    body = _fetch(f"{http.address}/v1/agent/monitor"
+                  f"?node_id={nid}&duration_s=0.3")
+    assert "routed-marker-3" in body
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _fetch(f"{http.address}/v1/agent/monitor"
+               f"?node_id=doesnotexist&duration_s=0.2")
+    assert ei.value.code == 404
+
+
+def test_pprof_profile_and_goroutine(agent):
+    server, client, http = agent
+    api = ApiClient(address=http.address)
+    burn = threading.Thread(
+        target=lambda: sum(i * i for i in range(3_000_000)), daemon=True,
+        name="burner")
+    burn.start()
+    prof, _ = api.get("/v1/agent/pprof/profile", seconds=0.3)
+    assert prof["seconds"] == 0.3
+    assert "samples:" in prof["profile"]
+    g, _ = api.get("/v1/agent/pprof/goroutine")
+    assert "thread " in g["stacks"]
+    assert g["threads"] >= 2
+    cl, _ = api.get("/v1/agent/pprof/cmdline")
+    assert cl["cmdline"]
+    from nomad_tpu.api.client import APIError
+    with pytest.raises(APIError) as ei:
+        api.get("/v1/agent/pprof/bogus")
+    assert ei.value.code == 404
+
+
+def test_pprof_requires_agent_write_acl(tmp_path):
+    server = Server(num_workers=1)
+    server.start()
+    http = HTTPAgentServer(server, None, port=0, acl_enabled=True)
+    http.start()
+    try:
+        from nomad_tpu.api.client import APIError
+        boot, _ = ApiClient(address=http.address).post("/v1/acl/bootstrap")
+        mgmt = boot["secret_id"]
+        api = ApiClient(address=http.address, token=mgmt)
+        # management token can profile
+        g, _ = api.get("/v1/agent/pprof/goroutine")
+        assert "thread " in g["stacks"]
+        # a read-only policy token cannot
+        api.post("/v1/acl/policy/readonly", {
+            "rules": 'namespace "default" { policy = "read" } '
+                     'agent { policy = "read" }'})
+        tok, _ = api.post("/v1/acl/tokens",
+                          {"name": "t", "type": "client",
+                           "policies": ["readonly"]})
+        ro = ApiClient(address=http.address, token=tok["secret_id"])
+        with pytest.raises(APIError) as ei:
+            ro.get("/v1/agent/pprof/goroutine")
+        assert ei.value.code == 403
+    finally:
+        http.stop()
+        server.stop()
+
+
+def test_monitor_cli_streams(agent, capsys):
+    from nomad_tpu.cli.main import main as cli_main
+    server, client, http = agent
+    logging.getLogger("nomad_tpu.test_monitor").info("cli-marker-4")
+    rc = cli_main(["-address", http.address, "monitor",
+                   "-log-level", "info", "-duration", "0.3"])
+    assert rc == 0
+    assert "cli-marker-4" in capsys.readouterr().out
+
+
+def test_log_monitor_primitives():
+    mon = LogMonitor(capacity=4)
+    rec = logging.LogRecord("nomad_tpu.x", logging.INFO, "f", 1,
+                            "hello %s", ("world",), None)
+    mon.emit(rec)
+    q = mon.subscribe()
+    level, line = q.get_nowait()
+    assert "hello world" in line
+    mon.unsubscribe(q)
+    assert thread_dump()
+    out = sample_profile(seconds=0.05, hz=50)
+    assert out.startswith("samples:")
