@@ -808,6 +808,15 @@ def main():
         # quality claim must be systematic, not one lucky seed.  The
         # classic headline duel is the sweep's (config 3, 1.15, seed 0)
         # cell — reuse it rather than run a 31st duel
+        # applier saturation: the plan pipeline must not serialize on
+        # the consensus round trip (VERDICT r4 item 5)
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "applier_bench", os.path.join(REPO, "bench",
+                                          "applier_bench.py"))
+        _ab = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_ab)
+        detail["applier_pipeline"] = _ab.run_applier_bench(3.0)
         sweep = run_quality_sweep()
         detail["quality_sweep"] = sweep
         detail["quality_pack_to_capacity"] = next(

@@ -1,0 +1,127 @@
+"""Applier-saturation microbench (VERDICT r4 item 5 done-bar).
+
+Drives the REAL PlanApplier loop with a simulated raft consensus
+latency and measures plans/s serial (legacy sync apply) vs pipelined
+(async apply + overlay evaluation).  At solve throughputs of 10^5+
+placements/s the applier must not serialize on the consensus round
+trip; this shows the pipeline's overlap directly.
+
+    python bench/applier_bench.py [latency_ms]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _cluster(n_nodes=64):
+    from nomad_tpu import mock
+    from nomad_tpu.state.store import StateStore
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.node_resources.cpu = 32_000
+        n.node_resources.memory_mb = 64_000
+        store.upsert_node(i + 1, n)
+        nodes.append(n)
+    return store, nodes
+
+
+def _plan(job, nodes, start, count=32):
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Plan
+    plan = Plan(job=job)
+    for k in range(count):
+        node = nodes[(start + k) % len(nodes)]
+        a = mock.alloc(job=job, node_id=node.id)
+        for tr in a.allocated_resources.tasks.values():
+            tr.networks = []
+        plan.node_allocation.setdefault(node.id, []).append(a)
+    return plan
+
+
+def run_applier_bench(latency_ms: float = 3.0, n_plans: int = 60,
+                      allocs_per_plan: int = 32) -> dict:
+    """Returns {serial_plans_per_s, pipelined_plans_per_s, speedup}."""
+    from nomad_tpu import mock
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+
+    latency_s = latency_ms / 1000.0
+
+    def one_mode(pipelined: bool) -> float:
+        store, nodes = _cluster()
+        job = mock.job()
+        index = [1000]
+        lock = threading.Lock()
+
+        def commit(plan, result):
+            with lock:
+                index[0] += 1
+                ix = index[0]
+            store.upsert_plan_results(ix, result, job=plan.job)
+            return ix
+
+        def apply_sync(plan, result):
+            time.sleep(latency_s)        # consensus round trip
+            return commit(plan, result)
+
+        def apply_async(plan, result):
+            done = threading.Event()
+            box = {}
+
+            def consensus():
+                time.sleep(latency_s)
+                box["ix"] = commit(plan, result)
+                done.set()
+            threading.Thread(target=consensus, daemon=True).start()
+
+            def finish(timeout=10.0):
+                done.wait(timeout)
+                return box["ix"]
+            return box.get("ix", 0), finish
+
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        applier = PlanApplier(
+            queue, store, apply_sync, None,
+            apply_async_fn=apply_async if pipelined else None)
+        applier.start()
+        plans = [_plan(job, nodes, i * allocs_per_plan,
+                       allocs_per_plan) for i in range(n_plans)]
+        t0 = time.perf_counter()
+        pendings = [queue.enqueue(p) for p in plans]
+        for p in pendings:
+            result, err = p.future.wait(30.0)
+            assert err is None and result is not None, err
+            assert sum(len(v) for v in result.node_allocation.values()) \
+                == allocs_per_plan, "plan bounced unexpectedly"
+        elapsed = time.perf_counter() - t0
+        applier.stop()
+        queue.set_enabled(False)
+        return n_plans / elapsed
+
+    serial = one_mode(False)
+    pipelined = one_mode(True)
+    return {
+        "consensus_latency_ms": latency_ms,
+        "plans": n_plans,
+        "allocs_per_plan": allocs_per_plan,
+        "serial_plans_per_s": round(serial, 1),
+        "pipelined_plans_per_s": round(pipelined, 1),
+        "speedup": round(pipelined / serial, 2),
+        "pipelined_placements_per_s": round(pipelined * allocs_per_plan,
+                                            1),
+    }
+
+
+if __name__ == "__main__":
+    ms = float(sys.argv[1]) if len(sys.argv) > 1 else 3.0
+    print(json.dumps(run_applier_bench(ms), indent=1))

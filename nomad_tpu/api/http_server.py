@@ -247,6 +247,23 @@ class HTTPAgentServer:
             return fn(q, body, *(unquote(g) for g in m.groups()))
         raise HTTPError(404, f"no handler for {url.path}")
 
+    def _valid_migrate_token(self, alloc_prefix: str, token: str) -> bool:
+        """Does `token` authorize disk-migration reads of this alloc?
+        HMAC under the OWNING node's secret (structs.funcs
+        generate_migrate_token)."""
+        if not token:
+            return False
+        from ..structs.funcs import compare_migrate_token
+        alloc = self.server.store.alloc_by_id(alloc_prefix)
+        if alloc is None:
+            return False
+        node = self.server.store.node_by_id(alloc.node_id)
+        if node is None or not node.secret_id:
+            # never verify under a missing/empty secret — an empty HMAC
+            # key would make the token forgeable from the alloc id
+            return False
+        return compare_migrate_token(alloc.id, node.secret_id, token)
+
     def _alloc_namespace(self, prefix: str) -> str:
         """Namespace of the alloc a client endpoint will act on; an
         AMBIGUOUS prefix is rejected here so the capability check can
@@ -271,6 +288,13 @@ class HTTPAgentServer:
             return
         if segs is None:
             segs = path.split("/")
+        # a migrate token is not an ACL token: it authorizes exactly
+        # one alloc's fs reads for disk migration (reference:
+        # fs_endpoint.go CompareMigrateToken) and is checked before
+        # token resolution
+        if (path.startswith("/v1/client/fs/")
+                and self._valid_migrate_token(segs[-1], token)):
+            return
         from ..acl import acl as aclmod
         a = self.server.resolve_token(token) if token else None
         if a is None:
@@ -313,7 +337,10 @@ class HTTPAgentServer:
             return
         if path.startswith("/v1/client/fs/"):
             # ls/stat/cat/readat/stream over the alloc dir: read-fs in
-            # the alloc's namespace (reference: fs_endpoint.go ACL)
+            # the alloc's namespace (reference: fs_endpoint.go ACL), OR
+            # a migrate token scoped to exactly this alloc — the
+            # replacement alloc's disk-migration read authority
+            # (reference: fs_endpoint.go checks CompareMigrateToken)
             target_ns = self._alloc_namespace(segs[-1])
             if not a.allow_namespace_op(target_ns, aclmod.CAP_READ_FS):
                 raise HTTPError(403, "missing capability read-fs")

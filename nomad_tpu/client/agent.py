@@ -62,6 +62,14 @@ class ServerEndpoints:
         client/pluginmanager/csimanager/volume.go)."""
         raise NotImplementedError
 
+    def get_alloc_migrate_source(self, alloc_id: str):
+        """For a replacement alloc's previous_allocation: the previous
+        alloc's terminal-ness, owning node, advertised agent address,
+        and a migrate token scoped to reading ITS alloc dir (reference:
+        Node.GetClientAllocs returns MigrateTokens, client.go:925).
+        None when the alloc is unknown (already GC'd)."""
+        raise NotImplementedError
+
 
 class InProcServer(ServerEndpoints):
     """Direct adapter over nomad_tpu.server.server.Server."""
@@ -87,6 +95,9 @@ class InProcServer(ServerEndpoints):
     def get_csi_volume(self, namespace: str, vol_id: str):
         return self.server.store.csi_volume_by_id(namespace, vol_id)
 
+    def get_alloc_migrate_source(self, alloc_id: str):
+        return self.server.alloc_migrate_source(alloc_id)
+
 
 class Client:
     def __init__(self, servers: ServerEndpoints, data_dir: str,
@@ -94,7 +105,7 @@ class Client:
                  datacenter: str = "dc1",
                  meta: Optional[Dict[str, str]] = None,
                  state_db=None, dev_mode: bool = False,
-                 device_registry=None):
+                 device_registry=None, tls=None):
         self.servers = (InProcServer(servers)
                         if not isinstance(servers, ServerEndpoints)
                         else servers)
@@ -119,6 +130,9 @@ class Client:
         self._updates_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
+        #: utils.tlsutil.TLSConfig for dials to OTHER agents (disk
+        #: migration streams); must match the cluster's HTTP plane
+        self.tls = tls
 
     def _fingerprint_with_identity(self, datacenter, meta) -> Node:
         """Fingerprint the host, keeping a stable node identity across
@@ -272,7 +286,99 @@ class Client:
                            device_registry=self.device_registry,
                            secrets_fetcher=self.servers.get_secret,
                            csi_manager=self.csi_manager,
-                           csi_resolver=self.servers.get_csi_volume)
+                           csi_resolver=self.servers.get_csi_volume,
+                           prev_migrator=self.migrate_prev_alloc_dir)
+
+    # ------------------------------------------- ephemeral-disk migration
+    def migrate_prev_alloc_dir(self, alloc: Allocation,
+                               dest_alloc_dir,
+                               timeout_s: float = 60.0) -> None:
+        """Bring a migrate=true previous alloc's shared data to this
+        node before the replacement's tasks start (reference:
+        client/allocwatcher/ — wait for the previous alloc to stop,
+        then move its dir locally or stream it from the owning agent
+        with a migrate token, client.go:925)."""
+        import shutil
+        import time as _t
+        prev_id = alloc.previous_allocation
+        deadline = _t.monotonic() + timeout_s
+        src = None
+        while True:
+            try:
+                src = self.servers.get_alloc_migrate_source(prev_id)
+            except NotImplementedError:
+                return                    # endpoint unsupported: skip
+            if src is None:
+                return                    # previous alloc already GC'd
+            if src.get("terminal"):
+                break
+            if _t.monotonic() > deadline:
+                raise RuntimeError(
+                    f"timed out waiting for previous alloc "
+                    f"{prev_id[:8]} to stop before disk migration")
+            _t.sleep(0.2)
+        dest_data = os.path.join(dest_alloc_dir.shared, "data")
+        os.makedirs(dest_data, exist_ok=True)
+        prev_runner = self.get_alloc_runner(prev_id)
+        if prev_runner is not None:
+            # local move (same node): reference allocwatcher's
+            # local migration path
+            src_data = os.path.join(prev_runner.alloc_dir.shared, "data")
+            if os.path.isdir(src_data):
+                shutil.copytree(src_data, dest_data, dirs_exist_ok=True)
+            return
+        addr = src.get("addr", "")
+        if not addr:
+            raise RuntimeError(
+                "previous alloc's node has no advertised agent address "
+                "to stream the ephemeral disk from")
+        self._fetch_remote_alloc_data(addr, prev_id,
+                                      src.get("migrate_token", ""),
+                                      dest_data)
+
+    def _fetch_remote_alloc_data(self, addr: str, prev_id: str,
+                                 token: str, dest_data: str) -> None:
+        """Recursively copy the previous alloc's alloc/data subtree
+        through the owning agent's fs API."""
+        from ..api.client import ApiClient
+        scheme = ("https" if self.tls is not None
+                  and self.tls.enabled() else "http")
+        api = ApiClient(address=f"{scheme}://{addr}", token=token,
+                        timeout=60.0, tls=self.tls)
+
+        def walk(rel: str, dest: str) -> None:
+            listing, _ = api.request(
+                "GET", f"/v1/client/fs/ls/{prev_id}",
+                params={"path": rel})
+            for ent in listing.get("files", []):
+                name = ent["name"]
+                sub_rel = f"{rel}/{name}"
+                sub_dest = os.path.join(dest, name)
+                if ent["is_dir"]:
+                    os.makedirs(sub_dest, exist_ok=True)
+                    walk(sub_rel, sub_dest)
+                    continue
+                with open(sub_dest, "wb") as f:
+                    off = 0
+                    while True:
+                        chunk, _ = api.request(
+                            "GET", f"/v1/client/fs/readat/{prev_id}",
+                            params={"path": sub_rel, "offset": off,
+                                    "limit": 1 << 20})
+                        data = __import__("base64").b64decode(
+                            chunk.get("data", ""))
+                        if not data:
+                            break
+                        f.write(data)
+                        off += len(data)
+                        if len(data) < (1 << 20):
+                            break
+
+        try:
+            walk("alloc/data", dest_data)
+        except Exception as e:
+            raise RuntimeError(
+                f"ephemeral disk migration from {addr} failed: {e}")
 
     def _fail_alloc(self, alloc: Allocation, reason: str) -> None:
         import copy

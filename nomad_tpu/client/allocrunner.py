@@ -51,13 +51,17 @@ class AllocRunner:
                  on_alloc_update: Callable[[Allocation], None],
                  state_db=None, device_registry=None,
                  secrets_fetcher=None, csi_manager=None,
-                 csi_resolver=None):
+                 csi_resolver=None, prev_migrator=None):
         self.alloc = alloc
         self.registry = registry
         self.device_registry = device_registry
         self.secrets_fetcher = secrets_fetcher
         self.csi_manager = csi_manager
         self.csi_resolver = csi_resolver
+        #: callable(alloc, alloc_dir) bringing a migrate=true previous
+        #: alloc's ephemeral disk here before tasks start (reference:
+        #: client/allocwatcher prerun gate)
+        self.prev_migrator = prev_migrator
         self._csi_mounts: List[tuple] = []   # (plugin, vol_id)
         self._vol_binds: List[str] = []      # task-dir bind mounts
         self.node = node
@@ -173,6 +177,14 @@ class AllocRunner:
     def run(self) -> None:
         self.alloc_dir.build()
         try:
+            self._migrate_prev_disk()
+        except Exception as e:
+            for tr in self.task_runners:
+                tr.mark_failed(f"ephemeral disk migration failed: {e}")
+            self._done.set()
+            self._report()
+            return
+        try:
             self._mount_csi_volumes()
         except Exception as e:
             # release anything already staged/published before the
@@ -195,6 +207,20 @@ class AllocRunner:
             self._health.start()
         # initial sync so the server sees pending promptly
         self._report()
+
+    def _migrate_prev_disk(self) -> None:
+        """Prerun gate: a replacement for a migrate=true group waits
+        for its previous alloc to stop and pulls that alloc's shared
+        data dir — locally or streamed from the owning agent
+        (reference: client/allocwatcher/, migrate token client.go:925).
+        Tasks must not start until the data is in place."""
+        if self.prev_migrator is None:
+            return
+        if not self.alloc.previous_allocation:
+            return
+        if not self.alloc.migrate_disk():
+            return
+        self.prev_migrator(self.alloc, self.alloc_dir)
 
     def restore(self) -> None:
         """reference: alloc_runner.go:380 — restore every task runner

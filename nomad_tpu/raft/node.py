@@ -347,6 +347,31 @@ class RaftNode:
             term = self.term
         return self._wait_applied(index, term, timeout)
 
+    def propose_async(self, etype: str, payload: Any):
+        """Append + kick replication WITHOUT waiting; returns
+        (index, wait_fn) where wait_fn(timeout) blocks until the entry
+        is applied locally.  The pipelined plan applier overlaps the
+        consensus round trip of plan N with evaluating plan N+1
+        (reference: plan_apply.go:71-178 applyPlan's async raft future
+        + asyncPlanWait)."""
+        with self._lock:
+            if self._closed:
+                raise NotLeaderError(None)
+            if self.role != ROLE_LEADER:
+                raise NotLeaderError(self.leader_id)
+            index = self._append_locked(etype, payload)
+            term = self.term
+        single = len([p for p in self.cfg.peers or [self.id]]) <= 1
+        if single:
+            with self._lock:
+                self._advance_commit_locked()
+                self._apply_committed_locked()
+            return index, (lambda timeout=10.0: index)
+        kick = threading.Thread(target=self._replicate_all, daemon=True)
+        kick.start()
+        return index, (lambda timeout=10.0:
+                       self._await_applied(index, term, timeout))
+
     def _wait_applied(self, index: int, term: int,
                       timeout: float) -> int:
         single = len([p for p in self.cfg.peers or [self.id]]) <= 1
@@ -356,6 +381,10 @@ class RaftNode:
                 self._apply_committed_locked()
                 return index
         self._replicate_all()
+        return self._await_applied(index, term, timeout)
+
+    def _await_applied(self, index: int, term: int,
+                       timeout: float) -> int:
         deadline = time.monotonic() + timeout
         with self._lock:
             while self.last_applied < index:

@@ -45,6 +45,7 @@ class ServerRpc:
             ("Node.GetClientAllocs", self._get_client_allocs, False),
             ("Node.UpdateAlloc", self._update_alloc, True),
             ("Secret.Get", self._secret_get, False),
+            ("Alloc.MigrateSource", self._alloc_migrate_source, False),
             ("Job.Register", self._job_register, True),
             ("Job.Deregister", self._job_deregister, True),
             ("Status.Leader", self._status_leader, False),
@@ -74,6 +75,9 @@ class ServerRpc:
     def _secret_get(self, params):
         namespace, path = params
         return self.server.store.secret_by_path(namespace, path)
+
+    def _alloc_migrate_source(self, params):
+        return self.server.alloc_migrate_source(params[0])
 
     def _job_register(self, params):
         job = from_wire(Job, params[0])
@@ -170,6 +174,9 @@ class RpcServerEndpoints(ServerEndpoints):
 
     def get_secret(self, namespace: str, path: str):
         return self._call("Secret.Get", [namespace, path])
+
+    def get_alloc_migrate_source(self, alloc_id: str):
+        return self._call("Alloc.MigrateSource", [alloc_id])
 
     # convenience for tests / CLI parity over the wire
     def register_job(self, job: Job):

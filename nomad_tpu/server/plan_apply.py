@@ -7,15 +7,25 @@ index so they retry against fresh data.
 
 Reference: nomad/plan_apply.go — planApply loop :71-178, evaluatePlan
 :399, evaluatePlanPlacements :436 (per-node fit re-check with partial
-commit + RefreshIndex :568-584), evaluateNodePlan :628, applyPlan :204.
-The reference fans per-node checks over an EvaluatePool of NumCPU/2
-goroutines; here a single pass suffices because the fit check itself is
-vector math (structs.funcs.allocs_fit), and the TPU batch already did
-the heavy scoring.
+commit + RefreshIndex :568-584), evaluateNodePlan :628, applyPlan :204,
+plan_apply_pool.go (per-node verify fan-out over NumCPU/2 workers).
+
+PIPELINING: plan N's raft consensus round trip overlaps plan N+1's
+evaluation — the applier evaluates N+1 against plan N's KNOWN result
+overlaid on the snapshot (`_OverlaySnapshot`), dispatches N+1's raft
+apply, and only then waits/responds for N (the reference overlaps the
+same region via applyPlan's async raft future + asyncPlanWait; it
+re-snapshots at min-index instead of overlaying, trading the extra
+wait for a narrower optimism window — both designs accept the same
+hazard class, writes landing between evaluate and apply).  A plan is
+only held outstanding while another is ALREADY queued, so a singleton
+plan keeps today's latency.
 """
 from __future__ import annotations
 
+import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..structs import (ALLOC_DESIRED_STOP, EVAL_STATUS_BLOCKED,
@@ -61,6 +71,57 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str
     return True, ""
 
 
+class _OverlaySnapshot:
+    """A snapshot with an in-flight plan's result applied on top: the
+    applier KNOWS what plan N will commit, so plan N+1 validates
+    against base+N without waiting for the raft apply (reference
+    analog: plan_apply.go's "snapshot at min-index" — ours trades that
+    wait for an optimistic overlay)."""
+
+    def __init__(self, base, result: PlanResult):
+        self._base = base
+        self._extra: Dict[str, List[Allocation]] = {
+            nid: list(allocs)
+            for nid, allocs in result.node_allocation.items()}
+        removed = set()
+        for allocs in result.node_update.values():
+            removed.update(a.id for a in allocs)
+        for allocs in result.node_preemptions.values():
+            removed.update(a.id for a in allocs)
+        self._removed = removed
+
+    def allocs_by_node(self, node_id: str):
+        # idempotent whether or not the overlaid plan has ALREADY been
+        # applied to the base (the base is a fresh snapshot racing the
+        # consensus thread): stops/preemptions filter by id, placements
+        # replace any same-id alloc the base may carry
+        extra = self._extra.get(node_id, ())
+        extra_ids = {a.id for a in extra}
+        base = [a for a in self._base.allocs_by_node(node_id)
+                if a.id not in self._removed and a.id not in extra_ids]
+        return base + list(extra)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
+#: per-node verify fan-out (reference: plan_apply_pool.go NumCPU/2
+#: workers); small plans stay on the applier thread
+_POOL_MIN_NODES = 16
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def _verify_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(
+                max_workers=max(2, (os.cpu_count() or 4) // 2),
+                thread_name_prefix="plan-verify")
+        return _pool
+
+
 def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     """Re-check the whole plan against `snapshot`, keeping only nodes that
     still fit; partial results carry a refresh index."""
@@ -87,8 +148,15 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
         return result
 
     partial = False
-    for node_id in plan.node_allocation:
-        ok, _why = evaluate_node_plan(snapshot, plan, node_id)
+    node_ids = list(plan.node_allocation)
+    if len(node_ids) >= _POOL_MIN_NODES:
+        oks = list(_verify_pool().map(
+            lambda nid: evaluate_node_plan(snapshot, plan, nid)[0],
+            node_ids))
+    else:
+        oks = [evaluate_node_plan(snapshot, plan, nid)[0]
+               for nid in node_ids]
+    for node_id, ok in zip(node_ids, oks):
         if ok:
             result.node_allocation[node_id] = plan.node_allocation[node_id]
             if node_id in plan.node_preemptions:
@@ -106,15 +174,29 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     return result
 
 
+class _Outstanding:
+    """A dispatched-but-unacknowledged plan apply."""
+    __slots__ = ("pending", "plan", "result", "finish")
+
+    def __init__(self, pending, plan, result, finish):
+        self.pending = pending
+        self.plan = plan
+        self.result = result
+        self.finish = finish          # blocks until raft-applied
+
+
 class PlanApplier:
-    """Owns the applier loop: dequeue pending plan -> evaluate -> apply."""
+    """Owns the applier loop: dequeue pending plan -> evaluate ->
+    apply, pipelined when plans are queued back to back (see module
+    docstring)."""
 
     def __init__(self, queue: PlanQueue, store, apply_fn: ApplyFn,
                  create_evals: Optional[Callable[[List[Evaluation]], None]]
-                 = None):
+                 = None, apply_async_fn=None):
         self.queue = queue
         self.store = store
         self.apply_fn = apply_fn
+        self.apply_async_fn = apply_async_fn
         self.create_evals = create_evals
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -130,34 +212,76 @@ class PlanApplier:
             self._thread.join(timeout=2.0)
 
     def _run(self) -> None:
+        out: Optional[_Outstanding] = None
         while not self._stop.is_set():
-            pending = self.queue.dequeue(0.2)
+            # only hold a plan outstanding while another is already
+            # queued: a singleton plan is finalized immediately and
+            # keeps the unpipelined latency
+            pending = self.queue.dequeue(0.0 if out is not None else 0.2)
             if pending is None:
+                if out is not None:
+                    out = self._finalize(out)
                 continue
             try:
-                self.apply_one(pending)
+                out = self.apply_one(pending, out)
             except Exception as e:   # keep the applier alive
                 pending.future.respond(None, f"plan apply error: {e}")
+        if out is not None:
+            self._finalize(out)
 
-    def apply_one(self, pending: PendingPlan) -> None:
+    def apply_one(self, pending: PendingPlan,
+                  out: Optional[_Outstanding] = None
+                  ) -> Optional[_Outstanding]:
         from ..utils.metrics import global_metrics as _m
         plan = pending.plan
         _m.set_gauge("plan.queue_depth", self.queue.depth()
                      if hasattr(self.queue, "depth") else 0)
         snapshot = self.store.snapshot()
+        if out is not None:
+            # evaluate against base + the in-flight plan's known result
+            # (the overlay is idempotent if the apply already landed)
+            snapshot = _OverlaySnapshot(snapshot, out.result)
         with _m.timed("plan.evaluate"):
             result = evaluate_plan(snapshot, plan)
         if result.is_no_op() and not result.refresh_index:
             pending.future.respond(result, None)
-            return
+            return out
+        if self.apply_async_fn is not None:
+            index, finish = self.apply_async_fn(plan, result)
+            new_out = _Outstanding(pending, plan, result, finish)
+            if out is not None:
+                # plan N+1's consensus is in flight: N's wait+respond
+                # rides under it
+                self._finalize(out)
+            return new_out
+        # legacy synchronous path (no async apply wired)
+        if out is not None:
+            self._finalize(out)
         with _m.timed("plan.apply"):
             index = self.apply_fn(plan, result)
         result.alloc_index = index
+        self._account_and_respond(pending, plan, result)
+        return None
+
+    def _finalize(self, out: _Outstanding) -> None:
+        from ..utils.metrics import global_metrics as _m
+        try:
+            with _m.timed("plan.apply"):
+                index = out.finish(10.0)
+        except Exception as e:
+            out.pending.future.respond(None, f"plan apply error: {e}")
+            return None
+        out.result.alloc_index = index
+        self._account_and_respond(out.pending, out.plan, out.result)
+        return None
+
+    def _account_and_respond(self, pending, plan: Plan,
+                             result: PlanResult) -> None:
+        from ..utils.metrics import global_metrics as _m
         if result.refresh_index:
             _m.incr_counter("plan.partial_commit")
         _m.incr_counter("plan.node_allocations",
                         sum(len(v) for v in result.node_allocation.values()))
-
         # preempted allocs need follow-up evals for their jobs
         if self.create_evals and plan.node_preemptions:
             preempted_jobs = {}

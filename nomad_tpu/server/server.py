@@ -77,7 +77,8 @@ class Server:
         self.plan_queue = PlanQueue()
         self.batch_size = batch_size
         self.planner = PlanApplier(self.plan_queue, self.store,
-                                   self._apply_plan, self._create_evals)
+                                   self._apply_plan, self._create_evals,
+                                   apply_async_fn=self._apply_plan_async)
         self.enabled_schedulers = enabled_schedulers or [
             s for s in SCHEDULERS if s != JOB_TYPE_CORE]
         # every worker must also drain the core queue or GC evals pile up
@@ -942,12 +943,54 @@ class Server:
             "namespace": namespace, "job_id": job_id, "launch": launch})
 
     # ------------------------------------------------------- plan applier
+    def alloc_migrate_source(self, alloc_id: str):
+        """Ephemeral-disk migration source info for a previous alloc
+        (reference: Node.GetClientAllocs attaches MigrateTokens —
+        structs.GenerateMigrateToken under the OWNING node's secret, so
+        that agent verifies reads without a server round trip)."""
+        from ..structs.funcs import generate_migrate_token
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None
+        node = self.store.node_by_id(alloc.node_id)
+        if node is None:
+            # the owning node is gone: nothing to stream from, and a
+            # token minted under an empty secret would be forgeable
+            return None
+        return {
+            "alloc_id": alloc_id,
+            "namespace": alloc.namespace,
+            # CLIENT-terminal: the old tasks must have actually stopped
+            # writing before the data is copied (reference: allocwatcher
+            # waits for client-terminal, not desired-stop)
+            "terminal": alloc.client_terminal_status(),
+            "node_id": alloc.node_id,
+            "addr": node.attributes.get("unique.advertise.http", ""),
+            "migrate_token": generate_migrate_token(alloc_id,
+                                                    node.secret_id),
+        }
+
     def _apply_plan(self, plan: Plan, result: PlanResult) -> int:
         index = self._propose("plan_result", {
             "result": to_wire(result),
             "job": to_wire(plan.job) if plan.job is not None else None})
         self._claim_csi_for_placements(plan, result)
         return index
+
+    def _apply_plan_async(self, plan: Plan, result: PlanResult):
+        """Dispatch the plan's raft apply without waiting; returns
+        (index, finish_fn) — finish_fn blocks until the entry is
+        applied and then claims CSI volumes.  The applier pipelines
+        plan N+1's evaluation under plan N's consensus round trip."""
+        index, wait = self.raft.propose_async("plan_result", {
+            "result": to_wire(result),
+            "job": to_wire(plan.job) if plan.job is not None else None})
+
+        def finish(timeout: float = 10.0) -> int:
+            ix = wait(timeout)
+            self._claim_csi_for_placements(plan, result)
+            return ix
+        return index, finish
 
     def _claim_csi_for_placements(self, plan: Plan,
                                   result: PlanResult) -> None:

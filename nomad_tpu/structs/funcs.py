@@ -97,3 +97,28 @@ def filter_terminal_allocs(allocs: List[Allocation]
         else:
             live.append(a)
     return live, terminal_by_name
+
+
+def generate_migrate_token(alloc_id: str, node_secret_id: str) -> str:
+    """Token authorizing a REPLACEMENT alloc to read its previous
+    alloc's ephemeral disk through the owning agent's fs API
+    (reference: structs.GenerateMigrateToken — HMAC of the alloc id
+    under the owning NODE's secret, so the serving agent can verify it
+    without a server round trip)."""
+    import base64
+    import hashlib
+    import hmac
+    mac = hmac.new((node_secret_id or "").encode(),
+                   alloc_id.encode(), hashlib.sha256).digest()
+    return base64.urlsafe_b64encode(mac).decode().rstrip("=")
+
+
+def compare_migrate_token(alloc_id: str, node_secret_id: str,
+                          token: str) -> bool:
+    """Constant-time migrate-token check (reference:
+    structs.CompareMigrateToken)."""
+    import hmac
+    if not token:
+        return False
+    return hmac.compare_digest(
+        generate_migrate_token(alloc_id, node_secret_id), token)

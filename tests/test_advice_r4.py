@@ -219,6 +219,7 @@ def test_alloc_runner_failed_csi_setup_releases_mounts(tmp_path):
             self._csi_mounts = [("p", "v1")]
             self._vol_binds = []
             self.csi_manager = None
+            self.prev_migrator = None
             self.alloc_dir = type("D", (), {"build": lambda s: None})()
 
         def _mount_csi_volumes(self):
