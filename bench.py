@@ -277,6 +277,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     placed = failed = retried = unresolved = 0
     n_fetches = 0
     n_dispatches = 0
+    pack_s = dispatch_s = 0.0
     t_start = time.perf_counter()
     asks_all = []
     batches = []
@@ -301,17 +302,30 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         # dispatches, no host sync), then ONE concatenated fetch
         outs = []
         for b in range(NB):
+            t_p = time.perf_counter()
             pb = pack_one(b * epc)
+            t_d = time.perf_counter()
             outs.append(rs.solve_stream_async([pb], seeds=[b + 1]))
             n_dispatches += 1
+            t_e = time.perf_counter()
+            pack_s += t_d - t_p
+            dispatch_s += t_e - t_d
+        t_f = time.perf_counter()
         packed = np.asarray(concat_jit(*outs))         # ONE fetch
+        fetch_wait_s = time.perf_counter() - t_f
         n_fetches += 1
     else:
+        t_p = time.perf_counter()
         for b in range(NB):
             pack_one(b * epc)
+        t_d = time.perf_counter()
         out1 = rs.solve_stream_async(batches, seeds=None)
         n_dispatches += 1
+        t_f = time.perf_counter()
         packed = np.asarray(out1)                      # ONE fetch
+        fetch_wait_s = time.perf_counter() - t_f
+        pack_s = t_d - t_p
+        dispatch_s = t_f - t_d
         n_fetches += 1
     status = packed[:, :, -1].astype(np.int32)         # [NB, K]
 
@@ -419,12 +433,105 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         "evals": total_evals, "placements": placed, "failed": failed,
         "retried": retried, "unresolved": unresolved,
         "n_device_calls": n_fetches, "n_dispatches": n_dispatches,
+        "breakdown_ms": {
+            "pack": round(1000 * pack_s, 1),
+            "dispatch": round(1000 * dispatch_s, 1),
+            "solve_and_fetch_wait": round(1000 * fetch_wait_s, 1),
+        },
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
         "placements_per_sec": round(placed / elapsed, 1),
         "p50_ms": round(pct(0.5), 3), "p99_ms": round(pct(0.99), 3),
         "nodes_scored_per_placement": n_nodes,
+    }
+
+
+def measure_device_ceiling(config=3):
+    """Device-only solve ceiling for one config (VERDICT r4 item 1):
+    every argument resident on device, chained re-runs, the transport
+    round trip subtracted — placements/s with transport at zero.  Plus
+    a memory-roofline estimate of ONE wave so the distance from the
+    chip is explicit: the wave's dominant traffic is the [G, N] score/
+    feasibility passes (f32) + the [N, R] usage updates, far below
+    MXU-relevant arithmetic intensity — the kernel is HBM-bound by
+    design, so the roofline is bytes/bandwidth, not FLOPs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nomad_tpu.solver.resident import ResidentSolver, _stream_kernel
+    from nomad_tpu.solver.tensorize import Tensorizer
+
+    p = CONFIGS[config]
+    n_nodes, n_evals, count, resident = (p["n_nodes"], p["n_evals"],
+                                         p["count"], p["resident"])
+    epc = min(128, n_evals)
+    NB = -(-n_evals // epc)
+    nodes = make_nodes(n_nodes, devices=config == 4)
+    probe_job = make_job(config, 0, count)
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    rs = ResidentSolver(nodes, asks_for(probe_job),
+                        gp=1 << max(0, (gp_need - 1).bit_length()),
+                        kp=1 << max(0, (count * epc - 1).bit_length()),
+                        max_waves=18)
+    used0 = resident_used0(rs.template, n_nodes, resident)
+    rs.reset_usage(used0=used0)
+    jobs = [make_job(config, e, count) for e in range(n_evals)]
+    batches = []
+    for i in range(0, n_evals, epc):
+        asks, keys = rs.merge_asks(
+            sum((asks_for(j) for j in jobs[i:i + epc]), []))
+        batches.append(rs.pack_batch(asks, job_keys=keys))
+    stacked = rs._stack_args(batches)
+    dev = {k: (jax.device_put(v) if isinstance(v, np.ndarray) else v)
+           for k, v in stacked.items()}
+    n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+    seeds = np.asarray(range(1, NB + 1), np.int32)
+    kw = dict(has_spread=rs._has_spread(batches),
+              group_count_hint=rs._group_count_hint(batches),
+              max_waves=rs.max_waves, wave_mode=rs.wave_mode,
+              has_distinct=rs._has_distinct(batches),
+              has_devices=rs._has_devices(batches),
+              stack_commit=False, compact=rs._compact)
+    args = (rs._dev_node["avail"], rs._dev_node["reserved"],
+            rs._dev_node["valid"], rs._dev_node["node_dc"],
+            rs._dev_node["attr_rank"], rs._dev_node["dev_cap"])
+    rtt = measure_transport_rtt()
+    ts = []
+    for trial in range(4):
+        rs.reset_usage(used0=used0)
+        t0 = time.perf_counter()
+        _u, _d, o = _stream_kernel(*args, rs._used, rs._dev_used, dev,
+                                   n_places, seeds, **kw)
+        np.asarray(o)
+        ts.append(time.perf_counter() - t0)
+    solve_s = max(min(ts[1:]) - rtt, 1e-6)   # trial 0 warms the compile
+    placements = int(n_places.sum())
+
+    # one-wave memory roofline (f32 bytes), config shape:
+    Np = rs.template.avail.shape[0]
+    G = gp_need
+    R = rs.template.avail.shape[1]
+    K = rs.kp
+    wave_bytes = (G * Np * 4 * 6        # after/fit/score/top-k passes
+                  + Np * R * 4 * 2      # usage read+write
+                  + K * 4 * 6)          # per-placement vectors
+    HBM_GBPS = 819.0                    # v5e-class HBM bandwidth
+    wave_floor_us = wave_bytes / (HBM_GBPS * 1e3)
+    return {
+        "config": config,
+        "device_only_solve_s": round(solve_s, 4),
+        "device_only_placements_per_sec": round(placements / solve_s, 1),
+        "transport_rtt_ms": round(1000 * rtt, 1),
+        "roofline": {
+            "wave_bytes_est": wave_bytes,
+            "hbm_gbps_assumed": HBM_GBPS,
+            "wave_floor_us_est": round(wave_floor_us, 1),
+            "note": ("the wave kernel is HBM-bound ([G,N] elementwise "
+                     "passes, no MXU-shaped contractions); the floor "
+                     "is bytes/bandwidth x waves x batches"),
+        },
     }
 
 
@@ -817,6 +924,11 @@ def main():
         _ab = _ilu.module_from_spec(_spec)
         _spec.loader.exec_module(_ab)
         detail["applier_pipeline"] = _ab.run_applier_bench(3.0)
+        # device-only ceiling + roofline for the primary config
+        try:
+            detail["device_ceiling"] = measure_device_ceiling(3)
+        except Exception as e:      # never lose the run over analysis
+            detail["device_ceiling"] = {"error": str(e)}
         sweep = run_quality_sweep()
         detail["quality_sweep"] = sweep
         detail["quality_pack_to_capacity"] = next(

@@ -729,6 +729,19 @@ class HTTPAgentServer:
             "name": self.server.raft.id, "status": "alive",
             "leader": self.server.is_leader()}]}, None
 
+    def regions_list(self, q, body):
+        """Known federation regions, sorted (reference:
+        nomad/regions_endpoint.go Regions.List from the WAN serf pool;
+        a standalone server reports its own region)."""
+        gossip = getattr(self.server, "gossip", None)
+        if gossip is not None:
+            try:
+                return 200, sorted(set(gossip.regions())), None
+            except Exception:
+                pass
+        region = getattr(self.server, "region", "") or "global"
+        return 200, [region], None
+
     def status_leader(self, q, body):
         return 200, "127.0.0.1:4647", None
 
@@ -1668,6 +1681,7 @@ def _build_routes(s: HTTPAgentServer):
         (R(r"^/v1/deployment/allocations/([^/]+)$"),
          {"GET": s.deployment_allocations}),
         (R(r"^/v1/deployment/([^/]+)$"), {"GET": s.deployment_get}),
+        (R(r"^/v1/regions$"), {"GET": s.regions_list}),
         (R(r"^/v1/agent/self$"), {"GET": s.agent_self}),
         (R(r"^/v1/agent/pprof/([^/]+)$"), {"GET": s.agent_pprof}),
         (R(r"^/v1/agent/members$"), {"GET": s.agent_members}),

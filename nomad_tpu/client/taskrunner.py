@@ -418,7 +418,11 @@ class TaskRunner:
             memory_mb=res.memory_mb if res else 0,
             task_dir=task_dir, alloc_dir=self.alloc_dir.shared,
             stdout_path=self.alloc_dir.stdout_path(self.task.name),
-            stderr_path=self.alloc_dir.stderr_path(self.task.name))
+            stderr_path=self.alloc_dir.stderr_path(self.task.name),
+            log_max_files=(self.task.log_config.max_files
+                           if self.task.log_config else 10),
+            log_max_file_size_mb=(self.task.log_config.max_file_size_mb
+                                  if self.task.log_config else 10))
 
     def _start_driver(self) -> None:
         handle = self.driver.start_task(self._task_config())

@@ -60,12 +60,79 @@ def _atomic_write_json(path: str, obj) -> None:
     os.rename(tmp, path)
 
 
+class _RotatingWriter:
+    """Size-rotated log sink (reference: client/logmon/logging — the
+    out-of-proc rotating writer; this executor IS the out-of-proc
+    supervisor, so logs both survive agent restarts and stay bounded).
+    Current file keeps the task path; older generations shift to
+    .1 .. .N and the oldest is dropped."""
+
+    def __init__(self, path: str, max_bytes: int, max_files: int):
+        self.path = path
+        self.max_bytes = max(max_bytes, 1)
+        self.max_files = max(max_files, 1)
+        self._fh = open(path, "ab", buffering=0)
+        self._size = self._fh.tell()
+
+    def write(self, data: bytes) -> None:
+        if self._size + len(data) > self.max_bytes and self._size > 0:
+            self._rotate()
+        self._fh.write(data)
+        self._size += len(data)
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        for i in range(self.max_files - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            try:
+                os.replace(src, f"{self.path}.{i}")
+            except FileNotFoundError:
+                pass
+        if self.max_files == 1:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+        self._fh = open(self.path, "ab", buffering=0)
+        self._size = 0
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+def _pump(src, writer: _RotatingWriter) -> None:
+    try:
+        while True:
+            chunk = src.read(65536)
+            if not chunk:
+                return
+            writer.write(chunk)
+    except (OSError, ValueError):
+        return
+    finally:
+        writer.close()
+
+
 def main(spec_path: str) -> int:
+    import threading
     with open(spec_path) as f:
         spec = json.load(f)
 
-    stdout = open(spec["stdout_path"], "ab", buffering=0)
-    stderr = open(spec["stderr_path"], "ab", buffering=0)
+    # log rotation: when the spec carries limits, task output flows
+    # through this supervisor into rotating files; otherwise the child
+    # inherits the raw file descriptors (legacy specs)
+    log_max_bytes = int(spec.get("log_max_bytes") or 0)
+    log_max_files = int(spec.get("log_max_files") or 0)
+    rotate = log_max_bytes > 0 and log_max_files > 0
+    if rotate:
+        stdout = subprocess.PIPE
+        stderr = subprocess.PIPE
+    else:
+        stdout = open(spec["stdout_path"], "ab", buffering=0)
+        stderr = open(spec["stderr_path"], "ab", buffering=0)
     iso = spec.get("isolation")
     cg_dirs = []
     preexec = None
@@ -103,6 +170,18 @@ def main(spec_path: str) -> int:
             "finished_at": time.time()})
         return 1
 
+    pumps = []
+    if rotate:
+        for src, path in ((child.stdout, spec["stdout_path"]),
+                          (child.stderr, spec["stderr_path"])):
+            t = threading.Thread(
+                target=_pump,
+                args=(src, _RotatingWriter(path, log_max_bytes,
+                                           log_max_files)),
+                daemon=True)
+            t.start()
+            pumps.append(t)
+
     if cg_dirs:
         from . import isolation
         isolation.cgroup_add_pid(cg_dirs, child.pid)
@@ -121,6 +200,8 @@ def main(spec_path: str) -> int:
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
 
     code = child.wait()
+    for t in pumps:                # drain the tail of the output
+        t.join(timeout=5.0)
     result = {"exit_code": code if code >= 0 else 0,
               "signal": -code if code < 0 else 0,
               "err": "",

@@ -119,6 +119,8 @@ class RawExecDriver(DriverPlugin):
             "cwd": cfg.task_dir,
             "stdout_path": cfg.stdout_path,
             "stderr_path": cfg.stderr_path,
+            "log_max_bytes": cfg.log_max_file_size_mb * 1024 * 1024,
+            "log_max_files": cfg.log_max_files,
             "state_file": paths["state"],
             "exit_file": paths["exit"],
         }

@@ -62,6 +62,10 @@ class TaskConfig:
     alloc_dir: str = ""
     stdout_path: str = ""
     stderr_path: str = ""
+    # size-rotated logging (reference: LogConfig -> logmon rotation);
+    # 0 disables rotation
+    log_max_files: int = 10
+    log_max_file_size_mb: int = 10
 
 
 @dataclass
