@@ -97,16 +97,16 @@ def breakdown(config):
             rs._dev_node["valid"], rs._dev_node["node_dc"],
             rs._dev_node["attr_rank"], rs._dev_node["dev_cap"])
     rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes, resident))
-    _, _, o = _stream_kernel(*args, rs._used, rs._dev_used, dev_stacked,
-                             n_places, seeds, **kw)
+    _, _, o, _w = _stream_kernel(*args, rs._used, rs._dev_used,
+                                 dev_stacked, n_places, seeds, **kw)
     np.asarray(o)
     ts = []
     for _ in range(3):
         rs.reset_usage(used0=B.resident_used0(rs.template, n_nodes,
                                               resident))
         t0 = time.perf_counter()
-        _, _, o = _stream_kernel(*args, rs._used, rs._dev_used,
-                                 dev_stacked, n_places, seeds, **kw)
+        _, _, o, _w = _stream_kernel(*args, rs._used, rs._dev_used,
+                                     dev_stacked, n_places, seeds, **kw)
         np.asarray(o)
         ts.append(time.perf_counter() - t0)
     t_solve_resident = min(ts)

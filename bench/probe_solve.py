@@ -68,6 +68,15 @@ def run(config):
         out[f"fused_mw{mw}_ms"] = round(1000 * min(ts), 1)
         out[f"fused_mw{mw}_placed"] = placed
         out[f"fused_mw{mw}_retry"] = retry
+        # instrumentation: measured wave counts + per-wave byte model
+        # (the achieved-HBM-GB/s inputs BENCH_DETAIL's roofline records)
+        out[f"fused_mw{mw}_waves"] = int(np.asarray(rs.last_waves).sum())
+        if mw == 18:
+            tr = rs.wave_traffic(batches)
+            out["pallas_mode"] = tr["mode"]
+            out["tile_size"] = tr["tile"]
+            out["bytes_per_wave"] = tr["bytes_per_wave"]
+            out["fused_pass_count"] = tr["fused_pass_count"]
 
     # --- pipelined per-chunk dispatch (chained), one stacked fetch ---
     rs, batches = build(18)
@@ -114,6 +123,26 @@ def run(config):
         packed = np.asarray(stack_jit(*outs))
         ts.append(time.perf_counter() - t0)
     out["pipelined_pack_inline_ms"] = round(1000 * min(ts), 1)
+
+    # --- the shipped schedule: ResidentSolver.solve_stream_pipelined
+    # (same overlap, owned by the solver; phase stats for free) ---
+    def pack_chunk(i):
+        asks = sum((B.asks_for(j) for j in jobs[i:i + epc]), [])
+        asks, keys = rs2.merge_asks(asks)
+        return rs2.pack_batch(asks, job_keys=keys)
+
+    ts = []
+    for _ in range(3):
+        reset(rs2)
+        t0 = time.perf_counter()
+        rs2.solve_stream_pipelined([b * epc for b in range(NB)],
+                                   seeds=[b + 1 for b in range(NB)],
+                                   pack=pack_chunk)
+        ts.append(time.perf_counter() - t0)
+    out["pipelined_api_ms"] = round(1000 * min(ts), 1)
+    out["pipelined_api_stats"] = {
+        k: round(v, 4) if isinstance(v, float) else v
+        for k, v in rs2.last_pipeline_stats.items()}
     return out
 
 

@@ -151,6 +151,10 @@ def profiled_run(config):
     print(f"    fetch result     {t_fetch:8.3f}s  (device compute+rtt)")
     print(f"    harvest status   {t_harvest:8.3f}s")
     print(f"    drain rounds     {t_drain:8.3f}s  calls={n_drain_calls}")
+    tr = rs.wave_traffic(batches)
+    print(f"    wave model: pallas_mode={tr['mode']} "
+          f"tile={tr['tile']} bytes/wave={tr['bytes_per_wave']:,} "
+          f"fused_passes={tr['fused_pass_count']}")
 
 
 if __name__ == "__main__":

@@ -352,6 +352,15 @@ class Client:
                 params={"path": rel})
             for ent in listing.get("files", []):
                 name = ent["name"]
+                # the listing comes from a REMOTE agent: a compromised
+                # or confused peer must not be able to steer the join
+                # below outside dest ("../x", "a/b", "/etc/passwd")
+                if (not name or name in (".", "..")
+                        or "/" in name or "\\" in name
+                        or os.path.isabs(name)):
+                    raise RuntimeError(
+                        f"remote fs listing returned unsafe entry name "
+                        f"{name!r}")
                 sub_rel = f"{rel}/{name}"
                 sub_dest = os.path.join(dest, name)
                 if ent["is_dir"]:
@@ -371,8 +380,10 @@ class Client:
                             break
                         f.write(data)
                         off += len(data)
-                        if len(data) < (1 << 20):
-                            break
+                        # NOTE: a short (< limit) read is NOT EOF — the
+                        # remote may return partial chunks under load;
+                        # only an empty read ends the file, so a short
+                        # read can never silently truncate a migration
 
         try:
             walk("alloc/data", dest_data)

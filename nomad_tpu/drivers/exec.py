@@ -139,11 +139,13 @@ class ExecDriver(RawExecDriver):
 
         def enter():
             import signal as _sig
+            from .isolation import (CLONE_NEWNS, CLONE_NEWPID,
+                                    CLONE_NEWUSER, setns)
 
             if user_fd is not None:
-                os.setns(user_fd, os.CLONE_NEWUSER)
-            os.setns(mnt_fd, os.CLONE_NEWNS)
-            os.setns(pid_fd, os.CLONE_NEWPID)
+                setns(user_fd, CLONE_NEWUSER)
+            setns(mnt_fd, CLONE_NEWNS)
+            setns(pid_fd, CLONE_NEWPID)
             os.chroot(rootfs)
             os.chdir("/local")
             # setns(CLONE_NEWPID) applies only to CHILDREN: fork once

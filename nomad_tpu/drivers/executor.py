@@ -103,21 +103,67 @@ class _RotatingWriter:
             pass
 
 
-def _pump(src, writer: _RotatingWriter) -> None:
-    try:
-        while True:
-            chunk = src.read(65536)
-            if not chunk:
-                return
-            writer.write(chunk)
-    except (OSError, ValueError):
-        return
-    finally:
-        writer.close()
+def _pump_until_eof(writers: dict, poll=None, grace: float = 5.0,
+                    timeout: float = 1.0) -> None:
+    """Thread-free select/os.read pump: drain every readable fd into its
+    rotating writer until ALL pipes hit EOF (the task tree closed them).
+    A detached grandchild can inherit the pipe and never close it, so
+    once `poll()` reports the direct child gone the pump lingers at most
+    `grace` seconds — the old daemon-thread join bound.
+
+    Runs on the executor's main thread — no `threading.Thread`, which the
+    exec jail may forbid (thread creation inside a fresh user+pid
+    namespace is blocked on some kernels) and whose failure used to kill
+    the task outright.  `os.read` on a select-ready pipe fd returns
+    whatever is buffered immediately, so output reaches the log file
+    while the task is still running (a BufferedReader `.read(n)` blocks
+    for the full n bytes and stalled live log streaming until exit).
+
+    A writer error (disk full, rotation race) must never stall the
+    child: the failing sink is downgraded to drain-and-discard so the
+    pipe keeps flowing.
+    """
+    import select
+    fds = dict(writers)            # fd -> writer (or None: discard)
+    exit_deadline = None
+    while fds:
+        if poll is not None and exit_deadline is None \
+                and poll() is not None:
+            exit_deadline = time.monotonic() + grace
+        if exit_deadline is not None and time.monotonic() > exit_deadline:
+            break
+        try:
+            ready, _, _ = select.select(list(fds), [], [], timeout)
+        except OSError:
+            ready = list(fds)      # EBADF etc: probe each fd directly
+        for fd in ready:
+            try:
+                chunk = os.read(fd, 65536)
+            except BlockingIOError:
+                continue
+            except OSError:
+                chunk = b""
+            if not chunk:          # EOF (or dead fd): retire it
+                w = fds.pop(fd, None)
+                if w is not None:
+                    w.close()
+                continue
+            w = fds.get(fd)
+            if w is not None:
+                try:
+                    w.write(chunk)
+                except OSError:
+                    try:
+                        w.close()
+                    except OSError:
+                        pass
+                    fds[fd] = None      # keep draining, drop the bytes
+    for w in fds.values():              # grace-break: flush what's left
+        if w is not None:
+            w.close()
 
 
 def main(spec_path: str) -> int:
-    import threading
     with open(spec_path) as f:
         spec = json.load(f)
 
@@ -170,17 +216,17 @@ def main(spec_path: str) -> int:
             "finished_at": time.time()})
         return 1
 
-    pumps = []
+    writers = {}
     if rotate:
         for src, path in ((child.stdout, spec["stdout_path"]),
                           (child.stderr, spec["stderr_path"])):
-            t = threading.Thread(
-                target=_pump,
-                args=(src, _RotatingWriter(path, log_max_bytes,
-                                           log_max_files)),
-                daemon=True)
-            t.start()
-            pumps.append(t)
+            try:
+                writers[src.fileno()] = _RotatingWriter(
+                    path, log_max_bytes, log_max_files)
+            except OSError:
+                # sink unavailable: drain-and-discard keeps the child
+                # unblocked; the task itself must survive
+                writers[src.fileno()] = None
 
     if cg_dirs:
         from . import isolation
@@ -199,9 +245,9 @@ def main(spec_path: str) -> int:
     signal.signal(signal.SIGINT, signal.SIG_IGN)
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
 
+    if writers:
+        _pump_until_eof(writers, poll=child.poll)
     code = child.wait()
-    for t in pumps:                # drain the tail of the output
-        t.join(timeout=5.0)
     result = {"exit_code": code if code >= 0 else 0,
               "signal": -code if code < 0 else 0,
               "err": "",

@@ -58,6 +58,32 @@ class IsolationError(OSError):
     pass
 
 
+# os.unshare/os.CLONE_* only exist on python >= 3.12; the jail speaks
+# to libc directly everywhere else (same syscall, same semantics)
+CLONE_NEWNS = getattr(os, "CLONE_NEWNS", 0x00020000)
+CLONE_NEWUSER = getattr(os, "CLONE_NEWUSER", 0x10000000)
+CLONE_NEWPID = getattr(os, "CLONE_NEWPID", 0x20000000)
+
+
+def _unshare(flags: int) -> None:
+    if hasattr(os, "unshare"):
+        os.unshare(flags)
+        return
+    if _libc.unshare(flags) != 0:
+        e = ctypes.get_errno()
+        raise OSError(e, f"unshare({flags:#x}): {os.strerror(e)}")
+
+
+def setns(fd: int, nstype: int = 0) -> None:
+    """os.setns (3.12+) or the raw syscall on older pythons."""
+    if hasattr(os, "setns"):
+        os.setns(fd, nstype)
+        return
+    if _libc.setns(fd, nstype) != 0:
+        e = ctypes.get_errno()
+        raise OSError(e, f"setns({fd}, {nstype:#x}): {os.strerror(e)}")
+
+
 def _mount(src: Optional[str], target: str, fstype: Optional[str],
            flags: int, data: Optional[str] = None) -> None:
     rc = _libc.mount(os.fsencode(src) if src else None,
@@ -72,14 +98,22 @@ def _mount(src: Optional[str], target: str, fstype: Optional[str],
 
 
 _PROBE_SCRIPT = """
-import os, sys
+import ctypes, ctypes.util, os, sys
+NEWNS, NEWUSER, NEWPID = 0x00020000, 0x10000000, 0x20000000
+libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                   use_errno=True)
+def unshare(flags):
+    if hasattr(os, "unshare"):
+        os.unshare(flags)
+    elif libc.unshare(flags) != 0:
+        raise OSError(ctypes.get_errno(), "unshare")
 code = 0
 try:
-    os.unshare(os.CLONE_NEWNS | os.CLONE_NEWPID)
+    unshare(NEWNS | NEWPID)
     code |= 1
 except OSError:
     try:
-        os.unshare(os.CLONE_NEWUSER | os.CLONE_NEWNS | os.CLONE_NEWPID)
+        unshare(NEWUSER | NEWNS | NEWPID)
         code |= 1 | 2
     except OSError:
         pass
@@ -117,7 +151,7 @@ def enter_namespaces() -> None:
     namespaces (the next fork lands as pid 1), root-mapped user ns
     first when not privileged."""
     if os.getuid() != 0:
-        os.unshare(os.CLONE_NEWUSER)
+        _unshare(CLONE_NEWUSER)
         # self-mapping is allowed for a single entry + setgroups deny
         with open("/proc/self/setgroups", "w") as f:
             f.write("deny")
@@ -125,7 +159,7 @@ def enter_namespaces() -> None:
             f.write(f"0 {os.getuid()} 1")
         with open("/proc/self/gid_map", "w") as f:
             f.write(f"0 {os.getgid()} 1")
-    os.unshare(os.CLONE_NEWNS | os.CLONE_NEWPID)
+    _unshare(CLONE_NEWNS | CLONE_NEWPID)
     # stop mount events from leaking back to the host namespace
     _mount(None, "/", None, MS_REC | MS_PRIVATE)
 

@@ -222,8 +222,15 @@ class PlanApplier:
                 if out is not None:
                     out = self._finalize(out)
                 continue
+            # clear the outstanding slot BEFORE the raising path:
+            # apply_one owns `prev` from here (it finalizes it on every
+            # branch, and _finalize never raises), so an exception out
+            # of apply_one can no longer leave a consumed _Outstanding
+            # in the loop slot to be finalized — and its future
+            # responded — a second time
+            prev, out = out, None
             try:
-                out = self.apply_one(pending, out)
+                out = self.apply_one(pending, prev)
             except Exception as e:   # keep the applier alive
                 pending.future.respond(None, f"plan apply error: {e}")
         if out is not None:
@@ -232,6 +239,19 @@ class PlanApplier:
     def apply_one(self, pending: PendingPlan,
                   out: Optional[_Outstanding] = None
                   ) -> Optional[_Outstanding]:
+        try:
+            return self._apply_one(pending, out)
+        except Exception:
+            # the handed-over outstanding plan must reach its finalize
+            # exactly once even when THIS plan's evaluate/dispatch blows
+            # up — _finalize error-responds internally and never raises
+            if out is not None:
+                self._finalize(out)
+            raise
+
+    def _apply_one(self, pending: PendingPlan,
+                   out: Optional[_Outstanding]
+                   ) -> Optional[_Outstanding]:
         from ..utils.metrics import global_metrics as _m
         plan = pending.plan
         _m.set_gauge("plan.queue_depth", self.queue.depth()
@@ -264,6 +284,11 @@ class PlanApplier:
         return None
 
     def _finalize(self, out: _Outstanding) -> None:
+        """Wait out a dispatched apply and respond its future — exactly
+        once, never raising: every failure path error-responds instead
+        (PlanFuture.respond is first-wins, so a partial
+        _account_and_respond that already delivered the result cannot
+        be overwritten by the trailing error)."""
         from ..utils.metrics import global_metrics as _m
         try:
             with _m.timed("plan.apply"):
@@ -272,7 +297,10 @@ class PlanApplier:
             out.pending.future.respond(None, f"plan apply error: {e}")
             return None
         out.result.alloc_index = index
-        self._account_and_respond(out.pending, out.plan, out.result)
+        try:
+            self._account_and_respond(out.pending, out.plan, out.result)
+        except Exception as e:
+            out.pending.future.respond(None, f"plan apply error: {e}")
         return None
 
     def _account_and_respond(self, pending, plan: Plan,

@@ -21,6 +21,11 @@ class PlanFuture:
 
     def respond(self, result: Optional[PlanResult],
                 err: Optional[str]) -> None:
+        # first respond wins: the applier's error paths may race a
+        # result already delivered (pipelined finalize), and a late
+        # error must never overwrite what the worker already read
+        if self._event.is_set():
+            return
         self._result = result
         self._err = err
         self._event.set()

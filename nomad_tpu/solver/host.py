@@ -248,6 +248,13 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                               axis=1)[:, None].astype(f32)
                 maxc = np.max(np.where(present, used_vec, -np.inf),
                               axis=1)[:, None].astype(f32)
+                # rows with NO present value carry minc=inf/maxc=-inf;
+                # their `even` term is masked to 0 by any_present below,
+                # but inf/inf through the divides raises RuntimeWarnings
+                # across the whole suite — pin the masked rows to finite
+                # values first (identical results, clean exact twin)
+                minc = np.where(any_present, minc, f32(0.0))
+                maxc = np.where(any_present, maxc, f32(0.0))
                 delta_boost = (minc - cur) / np.maximum(minc, f32(1e-9))
                 even = np.where(cur != minc, delta_boost,
                                 np.where(minc == maxc, f32(-1.0),

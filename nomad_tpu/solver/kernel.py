@@ -115,7 +115,7 @@ class SolveResult(NamedTuple):
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
-                                    "stack_commit"))
+                                    "stack_commit", "pallas_mode"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -125,7 +125,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  seed=0, *, has_spread=True,
                  group_count_hint=0, max_waves=0,
                  wave_mode="scan", has_distinct=True,
-                 has_devices=True, stack_commit=False) -> SolveResult:
+                 has_devices=True, stack_commit=False,
+                 pallas_mode="off") -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -250,6 +251,27 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                        (h & jnp.uint32(1023)).astype(jnp.float32)
                        * (SCORE_BIN / 1023.0))             # [Gp, Np]
 
+    # ---------- pallas fused-wave path (static, trace-time pick) ----
+    # "auto" resolves against the problem shape: "topk" fuses scoring
+    # AND per-tile top-K extraction (the [G, N] wave never reaches
+    # HBM), "score" fuses the scoring chain into one pass and leaves
+    # wide-window extraction to approx_max_k/top_k, "off" keeps the
+    # unfused jnp path (the host twin's reference shape).
+    if pallas_mode == "auto":
+        from . import pallas_kernel as _pk
+        pallas_mode = _pk.resolve_mode(Np, Gp, TK, V, has_spread)
+    use_pk = pallas_mode != "off"
+    if use_pk:
+        from . import pallas_kernel as _pk
+        pk_feas = feas.astype(jnp.int8)
+        pk_pen = penalty.astype(jnp.int8)
+        pk_sp_has = ((sp_col >= 0).astype(jnp.int8) if has_spread
+                     else None)
+        # int16 value ranks: bounded by the padded vocab (< 2^15
+        # always), halving the static plane each wave re-reads; cast
+        # ONCE per solve, outside the wave loop
+        pk_vnode = (sp_vnode.astype(jnp.int16) if has_spread else None)
+
     def group_scores(used, dev_used, coll, sp_used, blocked):
         """Batched scoring of every (group, node) pair against current
         usage — one instance of the reference's rank pipeline, [Gp, Np]."""
@@ -369,15 +391,58 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         else:
             blocked = jnp.zeros((Gp, Np), bool)
 
-        score, placeable, feas_b, fit, fit_dims, dev_fit = group_scores(
-            used, dev_used, coll, sp_used, blocked)
+        Vs_i = sp_desired.shape[2]
+        want_tables = has_spread and Vs_i <= 8 and not stack_commit
+        pk = None
+        if use_pk:
+            # fused pallas pass: scoring chain + counters (+ top-K and
+            # per-value tables in "topk" mode) in ONE walk of each node
+            # tile; no [Gp, Np, R] intermediate ever reaches HBM
+            if has_spread:
+                pres = sp_used > 0                     # [Gp, S, V]
+                anyp = pres.any(axis=2)
+                minc_w = jnp.min(jnp.where(pres, sp_used, jnp.inf),
+                                 axis=2)
+                maxc_w = jnp.max(jnp.where(pres, sp_used, -jnp.inf),
+                                 axis=2)
+                # masked rows (nothing present) are pinned finite: the
+                # kernel's contribution for them is masked to 0 either
+                # way, and finite inputs keep the VPU out of inf/nan
+                spread_pack = (
+                    pk_vnode, sp_des, sp_used,
+                    sp_weight, sp_targeted, pk_sp_has,
+                    jnp.where(anyp, minc_w, 0.0).astype(jnp.float32),
+                    jnp.where(anyp, maxc_w, 0.0).astype(jnp.float32),
+                    anyp.astype(jnp.int8))
+            else:
+                spread_pack = None
+            pk = _pk.fused_wave(
+                mode=pallas_mode, feas=pk_feas,
+                blocked=(blocked.astype(jnp.int8) if has_distinct
+                         else None),
+                aff=aff_score, pen=pk_pen, jitter=jitter, coll=coll,
+                used=used, avail=avail, reserved=reserved,
+                ask_res=ask_res, ask_desired=ask_desired,
+                dev=((dev_used, dev_cap, dev_ask) if has_devices
+                     else None),
+                spread=spread_pack, seed=jnp.int32(seed), TK=TK,
+                tables_v=(Vs_i if (want_tables
+                                   and pallas_mode == "topk") else 0))
+            n_feas_g, n_exh_g = pk["n_feas"], pk["n_exh"]
+            dim_exh_g, grp_any = pk["dim_exh"], pk["grp_any"]
+            score = pk.get("score")          # None in "topk" mode
+        else:
+            score, placeable, feas_b, fit, fit_dims, dev_fit = \
+                group_scores(used, dev_used, coll, sp_used, blocked)
         # full sort-based top_k dominates wave cost at scale; TPU's
         # approx_max_k (recall ~0.95 over near-tied scores) is the
         # hardware-native candidate search — the solve still scores every
         # node, only the top-W *extraction* is approximate, a far smaller
         # perturbation than the reference's 14-node subsample. Small
         # problems (tests, dryruns) keep the exact path.
-        if Np >= _APPROX_MIN_NP:
+        if use_pk and pallas_mode == "topk":
+            top_score, top_idx = pk["top_score"], pk["top_idx"]
+        elif Np >= _APPROX_MIN_NP:
             top_score, top_idx = lax.approx_max_k(score, TK)
         else:
             top_score, top_idx = lax.top_k(score, TK)      # [Gp, TK]
@@ -396,27 +461,33 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # at slot 0, and the reference picks the max TOTAL score — the
         # spread term is already inside the score; forcing slot 0 to
         # the spread-preferred value would override the argmax)
-        Vs = sp_desired.shape[2]
-        if has_spread and Vs <= 8 and not stack_commit:
+        Vs = Vs_i
+        if want_tables:
             has0 = sp_col[:, 0] >= 0                       # [Gp]
-            vnode = sp_vnode[0]                            # [Gp, Np]
             # one class per value PLUS a class for nodes MISSING the
             # spread attribute — the reference still places on those
             # with a -1 score penalty (spread.go), so they must stay
             # candidates or feasible nodes would livelock unplaced
             TKv = -(-TK // (Vs + 1))
-            tabs_i, tabs_s = [], []
-            for v in range(Vs + 1):
-                vmask = (vnode == v) if v < Vs else (vnode < 0)
-                sv = jnp.where(vmask, score, NEG_INF)
-                if Np >= _APPROX_MIN_NP:
-                    ts, ti = lax.approx_max_k(sv, TKv)
-                else:
-                    ts, ti = lax.top_k(sv, TKv)
-                tabs_i.append(ti)
-                tabs_s.append(ts)
-            tab_i = jnp.stack(tabs_i, axis=1)              # [Gp, V+1, TKv]
-            tab_s = jnp.stack(tabs_s, axis=1)
+            if use_pk and pallas_mode == "topk":
+                # per-value tables came out of the fused pass; the
+                # tile-partial merge is exact-equal to the full-row
+                # top_k below (tournament + node-order tie-break)
+                tab_s, tab_i = pk["tab_s"], pk["tab_i"]
+            else:
+                vnode = sp_vnode[0]                        # [Gp, Np]
+                tabs_i, tabs_s = [], []
+                for v in range(Vs + 1):
+                    vmask = (vnode == v) if v < Vs else (vnode < 0)
+                    sv = jnp.where(vmask, score, NEG_INF)
+                    if Np >= _APPROX_MIN_NP:
+                        ts, ti = lax.approx_max_k(sv, TKv)
+                    else:
+                        ts, ti = lax.top_k(sv, TKv)
+                    tabs_i.append(ti)
+                    tabs_s.append(ts)
+                tab_i = jnp.stack(tabs_i, axis=1)          # [Gp, V+1, TKv]
+                tab_s = jnp.stack(tabs_s, axis=1)
             # visit values in each group's preference order (best head
             # candidate first), so the first interleaved slot — where a
             # lone remaining placement always lands — is the value the
@@ -433,13 +504,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             top_idx = jnp.where(has0[:, None], inter_i, top_idx)
             top_score = jnp.where(has0[:, None], inter_s, top_score)
 
-        grp_any = placeable.any(axis=1)                    # [Gp]
+        if not use_pk:
+            grp_any = placeable.any(axis=1)                # [Gp]
 
-        # metrics snapshot for placements finishing this wave
-        n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
-        n_exh_g = (feas_b & valid[None, :] & ~(fit & dev_fit)).sum(axis=1)
-        dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
-                     & ~fit_dims).sum(axis=1)              # [Gp, R]
+            # metrics snapshot for placements finishing this wave
+            n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
+            n_exh_g = (feas_b & valid[None, :]
+                       & ~(fit & dev_fit)).sum(axis=1)
+            dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
+                         & ~fit_dims).sum(axis=1)          # [Gp, R]
 
         # rank each active placement within its group, then assign the
         # r-th remaining placement the group's (r mod M)-th best node,

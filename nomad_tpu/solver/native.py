@@ -28,26 +28,47 @@ from .kernel import (MAX_WAVES, MERGED_GP_MAX, TOP_K, _MERGED_W_CAP,
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "native", "host_solve.cc")
-_LIB = os.path.join(_DIR, "native", "_host_solve.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
+def _lib_path() -> str:
+    """Artifact path keyed on a CONTENT hash of the source: a fresh
+    checkout (mtimes all equal — git does not preserve them) or a
+    committed/foreign .so can never shadow the current source the way
+    an mtime comparison could; editing host_solve.cc changes the hash
+    and the stale artifact is simply never looked at again."""
+    import hashlib
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, "native", f"_host_solve.{digest}.so")
+
+
 def _build() -> Optional[ctypes.CDLL]:
     global _build_failed
     try:
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            tmp = _LIB + f".tmp{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                 "-o", tmp, _SRC],
-                check=True, capture_output=True)
-            os.replace(tmp, _LIB)       # atomic vs concurrent builders
-        lib = ctypes.CDLL(_LIB)
-        lib.nomad_host_solve.restype = ctypes.c_int
-        return lib
+        lib_path = _lib_path()
+        for attempt in range(2):
+            if not os.path.exists(lib_path):
+                tmp = lib_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, lib_path)  # atomic vs concurrent builders
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError:
+                if attempt == 0:
+                    # right hash, unloadable object (foreign arch,
+                    # truncated write): rebuild once in place
+                    os.unlink(lib_path)
+                    continue
+                raise
+            lib.nomad_host_solve.restype = ctypes.c_int
+            return lib
+        return None
     except (OSError, subprocess.CalledProcessError):
         _build_failed = True
         return None

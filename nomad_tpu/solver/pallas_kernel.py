@@ -1,0 +1,535 @@
+"""Pallas-tiled fused wave scoring for the placement solve.
+
+The wave kernel's per-iteration cost is pure HBM traffic: the jnp
+implementation (`kernel.group_scores`) walks the [Gp, Np] plane half a
+dozen times per wave (fit/after/binpack/anti/spread/normalize/select)
+and materializes [Gp, Np, R] broadcast intermediates between passes —
+`BENCH_DETAIL.json` device_ceiling puts the measured solve far above
+its own bytes/bandwidth floor.  This module fuses the whole scoring
+chain into ONE pass per node tile resident in VMEM:
+
+  for each tile of T nodes (grid axis):
+      load the tile's static planes (feasibility, affinity+penalty
+      score, scorer counts, jitter) and dynamic planes (usage,
+      collocation, distinct-blocking) into VMEM once;
+      compute feasibility ∧ fit ∧ device-fit, bin-pack, anti-affinity,
+      spread (targeted + even), append-then-average normalization,
+      seeded binning+jitter — all on VMEM-resident values;
+      reduce the per-group explainability counters for the tile;
+      EITHER write the tile's score row back (mode "score": one
+      [Gp, Np] store total, the only HBM write of the wave)
+      OR extract the tile's top-K partial in-kernel (mode "topk":
+      nothing but [Gp, tiles*TKt] partials ever reaches HBM — the
+      [G, N] wave never materializes at all).
+
+Per-tile top-K partials merge with one small `lax.top_k` over
+[Gp, tiles*TKt] outside the kernel; the tournament is EXACT: a row's
+global top-K is a subset of the per-tile top-Ks, per-tile extraction
+breaks ties low-index-first (same as `lax.top_k`), and tiles
+concatenate in node order, so equal scores resolve in global node
+order — bitwise the same selection the unfused kernel makes.  The
+same-wave conflict commit then runs on the compacted [K] candidate
+set exactly as before (kernel.py), so placements are identical by
+construction; tests/test_pallas_kernel.py property-tests the full
+solve against the `host.py` exact twin in interpreter mode on CPU.
+
+Mode selection is static (trace-time): "topk" when the candidate
+window is small enough for iterative in-VMEM extraction, "score"
+otherwise (merged throughput batches with 1024-wide windows keep
+`approx_max_k` on the fused score), "off" when shapes/features fall
+outside the fused universe.  On CPU the kernel runs in pallas
+interpreter mode — same semantics, no Mosaic — which is what tier-1
+exercises; on TPU `available()` compile-probes a representative kernel
+once and disables the path rather than let a Mosaic regression take
+the scheduler down.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:                               # TPU memory spaces (absent on some
+    from jax.experimental.pallas import tpu as pltpu  # cpu-only builds)
+except ImportError:                # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+#: sentinel strictly below NEG_INF: masks already-extracted slots so
+#: the next iterative extraction never re-picks them, while untouched
+#: NEG_INF (infeasible) entries still extract in node order like
+#: lax.top_k would return them
+_EXTRACTED = -2e30
+SCORE_BIN = 0.05
+#: largest candidate window the in-kernel iterative extraction serves;
+#: wider windows (merged throughput batches) use mode "score"
+TOPK_MAX = 256
+#: per-tile VMEM working-set budget, in [Gp, T] f32-plane elements
+_TILE_ELEMS = 1 << 18
+#: spread value-vocabulary cap for the unrolled select-sum
+_V_MAX = 16
+
+_R_CPU, _R_MEM = 0, 1
+
+
+def _env_mode() -> str:
+    """NOMAD_TPU_PALLAS: '1'/'interpret' force-enables (interpreted on
+    CPU), '0' disables, unset = auto (on only for TPU backends)."""
+    return os.environ.get("NOMAD_TPU_PALLAS", "").strip().lower()
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.lru_cache(maxsize=1)
+def enabled() -> bool:
+    env = _env_mode()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true", "interpret"):
+        return True
+    return jax.default_backend() == "tpu" and available()
+
+
+@functools.lru_cache(maxsize=1)
+def available() -> bool:
+    """Compile-probe a representative fused kernel once: a Mosaic
+    lowering failure downgrades the solver to the unfused path instead
+    of crashing the scheduler."""
+    try:
+        import numpy as np
+        Gp, Np, R, S, V, D = 2, 256, 4, 1, 4, 2
+        out = fused_wave(
+            mode="topk",
+            feas=jnp.ones((Gp, Np), jnp.int8),
+            blocked=jnp.zeros((Gp, Np), jnp.int8),
+            aff=jnp.zeros((Gp, Np), jnp.float32),
+            pen=jnp.zeros((Gp, Np), jnp.int8),
+            jitter=jnp.zeros((Gp, Np), jnp.float32),
+            coll=jnp.zeros((Gp, Np), jnp.float32),
+            used=jnp.zeros((Np, R), jnp.float32),
+            avail=jnp.ones((Np, R), jnp.float32) * 100,
+            reserved=jnp.zeros((Np, R), jnp.float32),
+            ask_res=jnp.ones((Gp, R), jnp.float32),
+            ask_desired=jnp.ones((Gp,), jnp.float32),
+            dev=(jnp.zeros((Np, D), jnp.float32),
+                 jnp.ones((Np, D), jnp.float32),
+                 jnp.zeros((Gp, D), jnp.float32)),
+            spread=(jnp.zeros((S, Gp, Np), jnp.int32),
+                    jnp.ones((S, Gp, Np), jnp.float32),
+                    jnp.zeros((Gp, S, V), jnp.float32),
+                    jnp.ones((Gp, S), jnp.float32),
+                    jnp.zeros((Gp, S), jnp.bool_),
+                    jnp.zeros((Gp, S), jnp.int8),
+                    jnp.zeros((Gp, S), jnp.float32),
+                    jnp.zeros((Gp, S), jnp.float32),
+                    jnp.zeros((Gp, S), jnp.int8)),
+            seed=jnp.int32(1), TK=8, tables_v=V)
+        np.asarray(out["top_score"])
+        return True
+    except Exception:               # pragma: no cover - backend specific
+        return False
+
+
+def pick_tile(Np: int, Gp: int) -> int:
+    """Node-tile width: largest lane-aligned divisor of Np whose
+    [Gp, T] working set fits the VMEM budget.  Padded node counts are
+    a power of two (<= 4096) or a multiple of 1024 (tensorize
+    _pad_nodes), so a divisor always exists."""
+    budget = max(_TILE_ELEMS // max(Gp, 1), 128)
+    for t in (2048, 1024, 512, 256, 128):
+        if Np % t == 0 and t <= budget:
+            return t
+    return Np                       # tiny pow2 problems: one tile
+
+
+def resolve_mode(Np: int, Gp: int, TK: int, V: int,
+                 has_spread: bool, enabled_hint: Optional[bool] = None
+                 ) -> str:
+    """Trace-time mode pick for solve_kernel (all args static)."""
+    on = enabled() if enabled_hint is None else enabled_hint
+    if not on:
+        return "off"
+    if has_spread and V > _V_MAX:
+        return "off"                # select-sum unroll would explode
+    T = pick_tile(Np, Gp)
+    if Np % T != 0:
+        return "off"
+    if TK <= TOPK_MAX:
+        return "topk"
+    return "score"
+
+
+def _specs(shape, tile_map, memory_space=None):
+    kw = {}
+    if pltpu is not None and not _interpret():
+        kw["memory_space"] = memory_space or pltpu.VMEM
+    return pl.BlockSpec(shape, tile_map, **kw)
+
+
+def fused_wave(*, mode, feas, blocked, aff, pen, jitter,
+               coll, used, avail, reserved, ask_res, ask_desired,
+               dev=None, spread=None, seed=0, TK=4, tables_v=0):
+    """One fused pass over node tiles producing the wave's scoring
+    outputs.  Returns a dict:
+
+      mode "score": score [Gp, Np] f32, counters (see below)
+      mode "topk":  top_score/top_idx [Gp, TK] (exact, merged from
+                    per-tile partials), counters, and when tables_v>0
+                    tab_s/tab_i [Gp, tables_v+1, TKv] — the per-value
+                    candidate tables for spread-aware interleaving.
+
+    counters: n_feas [Gp] i32, n_exh [Gp] i32, grp_any [Gp] bool,
+    dim_exh [Gp, R] i32 — the per-wave explainability reductions.
+
+    All tensors use the caller's (kernel.py) layouts; `spread` packs
+    (sp_vnode [S,Gp,Np], sp_des [S,Gp,Np], sp_used [Gp,S,V],
+    sp_weight [Gp,S], sp_targeted [Gp,S], sp_has [Gp,S] i8,
+    minc [Gp,S], maxc [Gp,S], anyp [Gp,S] i8); `dev` packs
+    (dev_used [Np,D], dev_cap [Np,D], dev_ask [Gp,D]).
+    """
+    Gp, Np = feas.shape
+    R = used.shape[1]
+    has_devices = dev is not None
+    has_spread = spread is not None
+    has_blocked = blocked is not None
+    T = pick_tile(Np, Gp)
+    n_tiles = Np // T
+    TKt = min(TK, T)
+    want_tables = mode == "topk" and tables_v > 0
+    Vs = tables_v
+    TKv = -(-TK // (Vs + 1)) if want_tables else 0
+    TKvt = min(TKv, T) if want_tables else 0
+    CNT = 3 + R
+
+    if has_spread:
+        (sp_vnode, sp_des, sp_used, sp_weight, sp_targeted, sp_has,
+         minc, maxc, anyp) = spread
+        S = sp_vnode.shape[0]
+        V = sp_used.shape[2]
+    else:
+        S = V = 0
+    if has_devices:
+        dev_used, dev_cap, dev_ask = dev
+        D = dev_cap.shape[1]
+    else:
+        D = 0
+
+    # ---- assemble inputs + block specs (order matters: the kernel
+    # unpacks positionally) ----
+    gp_t = lambda i: (0, i)              # [Gp, Np] planes  # noqa: E731
+    np_r = lambda i: (i, 0)              # [Np, X] planes   # noqa: E731
+    full = lambda i: (0, 0)              # whole small arrays # noqa: E731
+    inputs = [feas, aff, pen, jitter, coll]
+    in_specs = [_specs((Gp, T), gp_t)] * 5
+    if has_blocked:
+        inputs.append(blocked)
+        in_specs.append(_specs((Gp, T), gp_t))
+    inputs += [used, avail, reserved, ask_res,
+               ask_desired.reshape(Gp, 1),
+               jnp.asarray(seed, jnp.int32).reshape(1, 1)]
+    in_specs += [_specs((T, R), np_r), _specs((T, R), np_r),
+                 _specs((T, R), np_r), _specs((Gp, R), full),
+                 _specs((Gp, 1), full),
+                 _specs((1, 1), full,
+                        memory_space=(pltpu.SMEM if pltpu is not None
+                                      else None))]
+    if has_devices:
+        inputs += [dev_used, dev_cap, dev_ask]
+        in_specs += [_specs((T, D), np_r), _specs((T, D), np_r),
+                     _specs((Gp, D), full)]
+    if has_spread:
+        s_gp_t = lambda i: (0, 0, i)     # noqa: E731
+        inputs += [sp_vnode, sp_des, sp_used, sp_weight,
+                   sp_targeted.astype(jnp.int8), sp_has, minc, maxc,
+                   anyp]
+        in_specs += [_specs((S, Gp, T), s_gp_t),
+                     _specs((S, Gp, T), s_gp_t),
+                     _specs((Gp, S, V), lambda i: (0, 0, 0)),
+                     _specs((Gp, S), full), _specs((Gp, S), full),
+                     _specs((Gp, S), full), _specs((Gp, S), full),
+                     _specs((Gp, S), full), _specs((Gp, S), full)]
+
+    # ---- outputs ----
+    out_shapes = []
+    out_specs = []
+    if mode == "score":
+        out_shapes.append(jax.ShapeDtypeStruct((Gp, Np), jnp.float32))
+        out_specs.append(_specs((Gp, T), gp_t))
+    else:
+        out_shapes += [
+            jax.ShapeDtypeStruct((Gp, n_tiles * TKt), jnp.float32),
+            jax.ShapeDtypeStruct((Gp, n_tiles * TKt), jnp.int32)]
+        out_specs += [_specs((Gp, TKt), gp_t),
+                      _specs((Gp, TKt), gp_t)]
+        if want_tables:
+            out_shapes += [
+                jax.ShapeDtypeStruct((Vs + 1, Gp, n_tiles * TKvt),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((Vs + 1, Gp, n_tiles * TKvt),
+                                     jnp.int32)]
+            vmap3 = lambda i: (0, 0, i)  # noqa: E731
+            out_specs += [_specs((Vs + 1, Gp, TKvt), vmap3),
+                          _specs((Vs + 1, Gp, TKvt), vmap3)]
+    out_shapes.append(jax.ShapeDtypeStruct((n_tiles, Gp, CNT),
+                                           jnp.float32))
+    out_specs.append(_specs((1, Gp, CNT), lambda i: (i, 0, 0)))
+
+    kernel = functools.partial(
+        _wave_tile_kernel, mode=mode, Gp=Gp, T=T, R=R, D=D, S=S, V=V,
+        TKt=TKt, Vs=Vs, TKvt=TKvt, has_devices=has_devices,
+        has_spread=has_spread, has_blocked=has_blocked,
+        want_tables=want_tables)
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_shape=tuple(out_shapes),
+        out_specs=tuple(out_specs),
+        interpret=_interpret(),
+    )(*inputs)
+
+    # ---- merge per-tile partials (the "small reduction") ----
+    res = {}
+    oi = 0
+    if mode == "score":
+        res["score"] = outs[oi]
+        oi += 1
+    else:
+        ts_all, ti_all = outs[oi], outs[oi + 1]
+        oi += 2
+        mTK = min(TK, n_tiles * TKt)
+        ms, pos = lax.top_k(ts_all, mTK)
+        mi = jnp.take_along_axis(ti_all, pos, axis=1)
+        if mTK < TK:                 # tiny problems: pad like top_k of
+            pad = TK - mTK           # a row narrower than k never is —
+            ms = jnp.concatenate(    # callers clamp TK <= Np upstream
+                [ms, jnp.full((Gp, pad), NEG_INF, jnp.float32)], axis=1)
+            mi = jnp.concatenate(
+                [mi, jnp.zeros((Gp, pad), jnp.int32)], axis=1)
+        res["top_score"], res["top_idx"] = ms, mi
+        if want_tables:
+            vts, vti = outs[oi], outs[oi + 1]
+            oi += 2
+            mv = min(TKv, n_tiles * TKvt)
+            tab_s, vpos = lax.top_k(
+                vts.transpose(1, 0, 2), mv)          # [Gp, Vs+1, mv]
+            tab_i = jnp.take_along_axis(vti.transpose(1, 0, 2), vpos,
+                                        axis=2)
+            if mv < TKv:
+                padv = TKv - mv
+                tab_s = jnp.concatenate(
+                    [tab_s, jnp.full((Gp, Vs + 1, padv), NEG_INF,
+                                     jnp.float32)], axis=2)
+                tab_i = jnp.concatenate(
+                    [tab_i, jnp.zeros((Gp, Vs + 1, padv), jnp.int32)],
+                    axis=2)
+            res["tab_s"], res["tab_i"] = tab_s, tab_i
+    cnt = outs[oi].sum(axis=0)                        # [Gp, CNT]
+    res["n_feas"] = cnt[:, 0].astype(jnp.int32)
+    res["n_exh"] = cnt[:, 1].astype(jnp.int32)
+    res["grp_any"] = cnt[:, 2] > 0
+    res["dim_exh"] = cnt[:, 3:3 + R].astype(jnp.int32)
+    return res
+
+
+def _extract_topk(sc, col_ids, n_out, write):
+    """Iteratively pop the row-wise max `n_out` times, ties broken by
+    LOWER column (lax.top_k's order).  `write(j, vals, cols)` stores
+    slot j.  Runs entirely on VMEM-resident values."""
+
+    def body(j, sc):
+        m = jnp.max(sc, axis=1, keepdims=True)             # [Gp, 1]
+        am = jnp.min(jnp.where(sc == m, col_ids, jnp.int32(1 << 30)),
+                     axis=1, keepdims=True)                # [Gp, 1]
+        write(j, m, am)
+        return jnp.where(col_ids == am, jnp.float32(_EXTRACTED), sc)
+
+    lax.fori_loop(0, n_out, body, sc)
+
+
+def _wave_tile_kernel(*refs, mode, Gp, T, R, D, S, V, TKt, Vs, TKvt,
+                      has_devices, has_spread, has_blocked,
+                      want_tables):
+    """The fused per-tile pass.  Positional refs mirror fused_wave's
+    input/output assembly exactly."""
+    it = iter(refs)
+    feas_ref = next(it)
+    aff_ref = next(it)
+    pen_ref = next(it)
+    jitter_ref = next(it)
+    coll_ref = next(it)
+    blocked_ref = next(it) if has_blocked else None
+    used_ref = next(it)
+    avail_ref = next(it)
+    reserved_ref = next(it)
+    ask_res_ref = next(it)
+    ask_desired_ref = next(it)
+    seed_ref = next(it)
+    if has_devices:
+        dev_used_ref, dev_cap_ref, dev_ask_ref = (next(it), next(it),
+                                                  next(it))
+    if has_spread:
+        (sp_vnode_ref, sp_des_ref, sp_used_ref, sp_w_ref, sp_t_ref,
+         sp_has_ref, minc_ref, maxc_ref, anyp_ref) = (
+            next(it), next(it), next(it), next(it), next(it), next(it),
+            next(it), next(it), next(it))
+    if mode == "score":
+        score_ref = next(it)
+    else:
+        ts_ref = next(it)
+        ti_ref = next(it)
+        if want_tables:
+            vts_ref = next(it)
+            vti_ref = next(it)
+    cnt_ref = next(it)
+
+    i = pl.program_id(0)
+    f32 = jnp.float32
+
+    feas_b = feas_ref[...] != 0                        # [Gp, T]
+    if has_blocked:
+        feas_b &= blocked_ref[...] == 0
+
+    # ---- resource fit + bin-pack, one static unroll over R ----
+    ask_res = ask_res_ref[...]                         # [Gp, R]
+    fit = jnp.ones((Gp, T), bool)
+    dim_fail = []
+    util_cpu = util_mem = None
+    denom_cpu = denom_mem = None
+    for r in range(R):
+        after_r = (used_ref[:, r][None, :]
+                   + ask_res[:, r][:, None])           # [Gp, T]
+        fit_r = after_r <= avail_ref[:, r][None, :]
+        fit &= fit_r
+        dim_fail.append(jnp.sum((feas_b & ~fit_r).astype(f32), axis=1))
+        if r == _R_CPU:
+            util_cpu = after_r + reserved_ref[:, r][None, :]
+            denom_cpu = avail_ref[:, r][None, :]
+        elif r == _R_MEM:
+            util_mem = after_r + reserved_ref[:, r][None, :]
+            denom_mem = avail_ref[:, r][None, :]
+
+    if has_devices:
+        dev_fit = jnp.ones((Gp, T), bool)
+        dev_ask = dev_ask_ref[...]
+        for d in range(D):
+            dev_fit &= ((dev_used_ref[:, d][None, :]
+                         + dev_ask[:, d][:, None])
+                        <= dev_cap_ref[:, d][None, :])
+    else:
+        dev_fit = jnp.ones((Gp, T), bool)
+
+    placeable = feas_b & fit & dev_fit
+
+    ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+    free_cpu = f32(1.0) - util_cpu / jnp.maximum(denom_cpu, f32(1.0))
+    free_mem = f32(1.0) - util_mem / jnp.maximum(denom_mem, f32(1.0))
+    raw = f32(20.0) - (f32(10.0) ** free_cpu + f32(10.0) ** free_mem)
+    binpack = jnp.where(ok_denoms,
+                        jnp.clip(raw, f32(0.0), f32(18.0)) / f32(18.0),
+                        f32(0.0))
+
+    # ---- anti-affinity (collocation) ----
+    coll = coll_ref[...]
+    anti = jnp.where(coll > 0,
+                     -(coll + f32(1.0)) / ask_desired_ref[...],
+                     f32(0.0))
+    anti_counts = (coll > 0).astype(f32)
+
+    # ---- spread (targeted + even), select-sum over the value vocab ----
+    if has_spread:
+        spread_total = jnp.zeros((Gp, T), f32)
+        sp_used = sp_used_ref[...]                     # [Gp, S, V]
+        for s in range(S):
+            has = sp_has_ref[:, s][:, None] != 0       # [Gp, 1]
+            v = sp_vnode_ref[s]                        # [Gp, T]
+            has_v = v >= 0
+            cur = jnp.zeros((Gp, T), f32)
+            for val in range(V):
+                cur = cur + jnp.where(v == val,
+                                      sp_used[:, s, val][:, None],
+                                      f32(0.0))
+            desired = sp_des_ref[s]                    # [Gp, T]
+            boost = ((desired - (cur + f32(1.0)))
+                     / jnp.maximum(desired, f32(1e-9))
+                     ) * sp_w_ref[:, s][:, None]
+            targeted = jnp.where(~has_v, f32(-1.0),
+                                 jnp.where(desired <= 0, f32(-1.0),
+                                           boost))
+            minc = minc_ref[:, s][:, None]
+            maxc = maxc_ref[:, s][:, None]
+            anyp = anyp_ref[:, s][:, None] != 0
+            delta_boost = (minc - cur) / jnp.maximum(minc, f32(1e-9))
+            even = jnp.where(cur != minc, delta_boost,
+                             jnp.where(minc == maxc, f32(-1.0),
+                                       (maxc - minc)
+                                       / jnp.maximum(minc, f32(1e-9))))
+            even = jnp.where(~has_v, f32(-1.0), even)
+            even = jnp.where(anyp, even, f32(0.0))
+            contrib = jnp.where(sp_t_ref[:, s][:, None] != 0, targeted,
+                                even)
+            spread_total = spread_total + jnp.where(has, contrib,
+                                                    f32(0.0))
+        spread_counts = (spread_total != 0.0).astype(f32)
+    else:
+        spread_total = f32(0.0)
+        spread_counts = f32(0.0)
+
+    # ---- normalize + seeded binning + jitter + mask ----
+    # EXACT float summation order of kernel.group_scores: f32 addition
+    # is not associative, and the pallas path must be bitwise the
+    # kernel/host twin's score for placement-identity to hold
+    pen_counts = pen_ref[...] != 0
+    pen_score = jnp.where(pen_counts, f32(-1.0), f32(0.0))
+    aff_sc = aff_ref[...]
+    aff_counts = aff_sc != 0.0
+    n_scorers = (1.0 + anti_counts + pen_counts.astype(f32)
+                 + aff_counts.astype(f32) + spread_counts)
+    total = (binpack + anti + pen_score + aff_sc
+             + spread_total) / n_scorers
+    seed = seed_ref[0, 0]
+    total = jnp.where(seed == 0, total,
+                      jnp.floor(total / f32(SCORE_BIN)) * f32(SCORE_BIN))
+    total = total + jitter_ref[...]
+    score = jnp.where(placeable, total, f32(NEG_INF))
+
+    # ---- explainability counters for this tile (one 2-D store) ----
+    n_feas_t = jnp.sum(feas_b.astype(f32), axis=1)
+    n_exh_t = jnp.sum((feas_b & ~(fit & dev_fit)).astype(f32), axis=1)
+    any_t = jnp.max(placeable.astype(f32), axis=1)
+    cnt_ref[0] = jnp.stack([n_feas_t, n_exh_t, any_t] + dim_fail,
+                           axis=1)                     # [Gp, 3 + R]
+
+    if mode == "score":
+        score_ref[...] = score
+        return
+
+    # ---- in-kernel per-tile top-K extraction ----
+    local_cols = lax.broadcasted_iota(jnp.int32, (Gp, T), 1)
+    base = i * T
+
+    def write_main(j, vals, cols):
+        ts_ref[:, pl.ds(j, 1)] = vals
+        ti_ref[:, pl.ds(j, 1)] = cols + base
+
+    _extract_topk(score, local_cols, TKt, write_main)
+
+    if want_tables:
+        vnode0 = sp_vnode_ref[0]                       # [Gp, T]
+        for vv in range(Vs + 1):
+            vmask = (vnode0 == vv) if vv < Vs else (vnode0 < 0)
+            sv = jnp.where(vmask, score, f32(NEG_INF))
+
+            def write_v(j, vals, cols, vv=vv):
+                vts_ref[vv, :, pl.ds(j, 1)] = vals
+                vti_ref[vv, :, pl.ds(j, 1)] = cols + base
+
+            _extract_topk(sv, local_cols, TKvt, write_v)

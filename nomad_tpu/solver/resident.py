@@ -137,7 +137,8 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
                group_count_hint=0, max_waves=0, wave_mode="scan",
-               has_distinct=True, has_devices=True, stack_commit=False):
+               has_distinct=True, has_devices=True, stack_commit=False,
+               pallas_mode="off"):
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -151,7 +152,7 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         seed, has_spread=has_spread, group_count_hint=group_count_hint,
         max_waves=max_waves, wave_mode=wave_mode,
         has_distinct=has_distinct, has_devices=has_devices,
-        stack_commit=stack_commit)
+        stack_commit=stack_commit, pallas_mode=pallas_mode)
 
 
 @functools.partial(jax.jit,
@@ -232,14 +233,18 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
-                                    "stack_commit", "compact"))
+                                    "stack_commit", "compact",
+                                    "pallas_mode"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
                    has_spread=True, group_count_hint=0, max_waves=0,
                    wave_mode="scan", has_distinct=True,
-                   has_devices=True, stack_commit=False, compact=True):
+                   has_devices=True, stack_commit=False, compact=True,
+                   pallas_mode="off"):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
-    threading resource usage from batch to batch on device."""
+    threading resource usage from batch to batch on device.  Also
+    returns the per-batch wave count [B] — the instrumentation hook the
+    HBM-GB/s report multiplies against the per-wave byte model."""
 
     def step(carry, xs):
         used, dev_used = carry
@@ -248,7 +253,7 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                          dev_cap, used, dev_used, batch, n_place, seed,
                          has_spread, group_count_hint, max_waves,
                          wave_mode, has_distinct, has_devices,
-                         stack_commit)
+                         stack_commit, pallas_mode)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -258,11 +263,12 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
             packed = jnp.concatenate(
                 [res.choice.astype(jnp.float32), res.score,
                  status.astype(jnp.float32)[:, None]], axis=-1)
-        return (res.used_final, res.dev_used_final), packed
+        return ((res.used_final, res.dev_used_final),
+                (packed, res.n_waves))
 
-    (used_f, dev_used_f), out = jax.lax.scan(
+    (used_f, dev_used_f), (out, waves) = jax.lax.scan(
         step, (used0, dev_used0), (stacked, n_places, seeds))
-    return used_f, dev_used_f, out
+    return used_f, dev_used_f, out, waves
 
 
 class ResidentSolver:
@@ -281,11 +287,18 @@ class ResidentSolver:
                  allocs_by_node: Optional[Dict[str, list]] = None,
                  gp: Optional[int] = None, kp: Optional[int] = None,
                  max_waves: int = 0, wave_mode: str = "scan",
-                 stack_commit: bool = False):
+                 stack_commit: bool = False, pallas: str = "auto"):
         self.nodes = list(nodes)
         self.max_waves = max_waves        # 0 = kernel default
         self.wave_mode = wave_mode        # see kernel.py loop-shape note
         self.stack_commit = stack_commit  # serial-fidelity commits
+        #: "auto" resolves per trace against shape + backend (pallas
+        #: fused wave kernel on TPU / forced via NOMAD_TPU_PALLAS);
+        #: "off"/"score"/"topk" pin it (tests, benchmarks)
+        self.pallas = pallas
+        #: per-batch wave counts of the LAST dispatched stream (device
+        #: array; fetch syncs — instrumentation consumers only)
+        self.last_waves = None
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
         self.gp = gp or self.template.ask_res.shape[0]
@@ -416,7 +429,7 @@ class ResidentSolver:
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
                     else np.asarray(list(seeds), np.int32))
-        self._used, self._dev_used, out = _stream_kernel(
+        self._used, self._dev_used, out, self.last_waves = _stream_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
@@ -426,12 +439,110 @@ class ResidentSolver:
             max_waves=self.max_waves, wave_mode=self.wave_mode,
             has_distinct=self._has_distinct(batches),
             has_devices=self._has_devices(batches),
-            stack_commit=self.stack_commit, compact=self._compact)
+            stack_commit=self.stack_commit, compact=self._compact,
+            pallas_mode=self.pallas)
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
                                           np.ndarray, np.ndarray]:
         return self._unpack(out)
+
+    def solve_stream_pipelined(self, chunks, seeds=None, pack=None
+                               ) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+        """Double-buffered pack→dispatch overlap: pack chunk b+1 on the
+        host WHILE chunk b's device call is in flight.  JAX dispatch is
+        async and the carried usage chains the calls on device, so each
+        chunk's host-side packing rides entirely under the previous
+        chunks' solve; ONE concatenated fetch then pays the transport
+        round trip once for the whole stream (the fused-call schedule
+        pays the same single round trip but serializes ALL packing
+        before the first wave can start).
+
+        `chunks`: sequence of PackedBatch, or of ask-lists packed via
+        `pack` (default pack_batch_cached).  Returns the solve_stream
+        tuple (choice [B,K,TOP_K], ok, score, status); per-phase timings
+        land in self.last_pipeline_stats and per-call wave counts in
+        self.last_waves (list of device scalars).
+        """
+        import time
+        outs, waves = [], []
+        pack_s = dispatch_s = 0.0
+        for b, chunk in enumerate(chunks):
+            t0 = time.perf_counter()
+            if isinstance(chunk, PackedBatch):
+                pb = chunk
+            else:
+                pb = (pack or self.pack_batch_cached)(chunk)
+            if pb is None:
+                raise ValueError(
+                    "pipelined chunk fell outside the resident universe")
+            t1 = time.perf_counter()
+            outs.append(self.solve_stream_async(
+                [pb], seeds=None if seeds is None else [seeds[b]]))
+            waves.append(self.last_waves)
+            t2 = time.perf_counter()
+            pack_s += t1 - t0
+            dispatch_s += t2 - t1
+        t3 = time.perf_counter()
+        if not outs:
+            raise ValueError("solve_stream_pipelined needs >= 1 chunk")
+        packed = np.asarray(outs[0] if len(outs) == 1
+                            else self._concat_jit(*outs))
+        fetch_s = time.perf_counter() - t3
+        self.last_waves = waves
+        self.last_pipeline_stats = {
+            "pack_s": pack_s, "dispatch_s": dispatch_s,
+            "fetch_s": fetch_s, "n_dispatches": len(outs)}
+        return self._unpack(packed)
+
+    @functools.cached_property
+    def _concat_jit(self):
+        return jax.jit(lambda *xs: jnp.concatenate(xs))
+
+    def wave_traffic(self, batches: Sequence[PackedBatch]) -> Dict:
+        """Per-wave HBM byte model for the CURRENT solve configuration —
+        multiplied by the measured wave counts (last_waves) this yields
+        the achieved-GB/s numerator the roofline report compares against
+        the assumed HBM bandwidth.  Returns {mode, tile, bytes_per_wave,
+        fused_pass_count}."""
+        from . import pallas_kernel as _pk
+        from .kernel import (TOP_K as _TOP_K, WAVE_K, _MERGED_W_CAP,
+                             _WIDE_W_CAP)
+        t = self.template
+        Np, R = t.avail.shape
+        Gp = max(pb.ask_res.shape[0] for pb in batches)
+        K = max(pb.p_ask.shape[0] for pb in batches)
+        S = t.sp_desired.shape[1]
+        has_spread = self._has_spread(batches)
+        hint = self._group_count_hint(batches)
+        w_cap = (_MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP)
+        TK = min(max(WAVE_K, min(2 * hint, w_cap)) + _TOP_K, Np)
+        mode = self.pallas
+        if mode == "auto":
+            V = t.sp_desired.shape[2]
+            mode = _pk.resolve_mode(Np, Gp, TK, V, has_spread)
+        plane = Gp * Np
+        spread_planes = (2 * S * plane * 4) if has_spread else 0
+        if mode == "off":
+            # the unfused chain: ~6 elementwise [Gp, Np] f32 passes plus
+            # the [Gp, Np, R] broadcast intermediates and the top-k read
+            bytes_per_wave = (plane * 4 * 6 + plane * R * 4 * 2
+                              + spread_planes + Np * R * 4 * 2
+                              + K * 4 * 6)
+            passes = 6
+        else:
+            # fused single pass: every plane read ONCE (feas i8, aff
+            # f32, pen i8, jitter f32, coll f32 + spread statics), node
+            # columns once, plus score write+read in "score" mode only
+            reads = plane * (1 + 4 + 1 + 4 + 4) + spread_planes \
+                + Np * R * 4 * 3
+            extra = (plane * 4 * 2 if mode == "score" else 0)
+            bytes_per_wave = reads + extra + K * 4 * 6
+            passes = 1
+        return {"mode": mode, "tile": _pk.pick_tile(Np, Gp),
+                "bytes_per_wave": int(bytes_per_wave),
+                "fused_pass_count": passes}
 
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:

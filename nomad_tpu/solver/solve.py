@@ -271,7 +271,8 @@ class Solver:
         return None
 
 
-def _run_kernel(pb: PackedBatch, host_mode: str = "auto"):
+def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
+                pallas: str = "auto"):
     import numpy as _np
     has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
     if host_mode != "never":
@@ -280,7 +281,11 @@ def _run_kernel(pb: PackedBatch, host_mode: str = "auto"):
                 pb.avail.shape[0], pb.n_asks, pb.n_place):
             return host_solve_kernel(*_kernel_args(pb),
                                      has_spread=has_spread)
-    return solve_kernel(*_kernel_args(pb), has_spread=has_spread)
+    # "auto" resolves to the pallas fused wave on TPU backends (or when
+    # NOMAD_TPU_PALLAS forces it) and to the unfused kernel otherwise —
+    # placement-identical either way (tests/test_pallas_kernel.py)
+    return solve_kernel(*_kernel_args(pb), has_spread=has_spread,
+                        pallas_mode=pallas)
 
 
 def _kernel_args(pb: PackedBatch):

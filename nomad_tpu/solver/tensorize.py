@@ -113,10 +113,31 @@ def _pad_nodes(n: int) -> int:
     distinct compiled shapes across tests/dryruns); large clusters pad
     to a multiple of 1024 — the TPU only needs lane alignment, and
     pow2-padding 10K nodes to 16K would do 1.6x the [G, N] wave work
-    for nothing."""
+    for nothing.
+
+    Both regimes are TILE-ALIGNED for the pallas fused wave kernel
+    (pallas_kernel.pick_tile): a power of two <= 4096 is divisible by
+    every smaller power-of-two tile, and 1024-multiples split into
+    lane-aligned 1024/2048 tiles — so the fused path never needs a
+    ragged last tile."""
     if n <= 4096:
         return _pad_pow2(max(n, 1))
     return -(-n // 1024) * 1024
+
+
+def _index_dtype(rank_columns, n_targets: int):
+    """int16 when every interned rank (and column index) fits —
+    halving the [Np, A] attribute matrix and the constraint/affinity
+    program rows that the kernel streams per solve; int32 when a value
+    universe is pathologically wide.  Resource tensors deliberately
+    STAY float32: cpu-MHz/memory-MB values are integral and < 2^24 so
+    f32 compares exactly, while fp16 would round them (a 11000-MHz
+    node is not fp16-representable) and int tensors would re-convert
+    on every fused multiply-add."""
+    if n_targets < 32000 and all(rc.n_values < 32000
+                                 for rc in rank_columns):
+        return np.int16
+    return np.int32
 
 
 @dataclass
@@ -321,7 +342,8 @@ class Tensorizer:
                     universes[attr_target_ix[sp.attribute]].add(st.value)
 
         rank_columns = [RankColumn(u) for u in universes]
-        attr_rank = np.full((Np, A), -1, np.int32)
+        idt = _index_dtype(rank_columns, A)
+        attr_rank = np.full((Np, A), -1, idt)
         for col in range(A):
             rc = rank_columns[col]
             for i in range(N):
@@ -332,9 +354,9 @@ class Tensorizer:
         # ---- constraint program arrays ----
         C = _pad_pow2(max((len(v) for v in per_ask_vec_constraints),
                           default=1), floor=4)
-        c_op = np.zeros((Gp, C), np.int32)
-        c_col = np.zeros((Gp, C), np.int32)
-        c_rank = np.zeros((Gp, C), np.int32)
+        c_op = np.zeros((Gp, C), idt)
+        c_col = np.zeros((Gp, C), idt)
+        c_rank = np.zeros((Gp, C), idt)
         for g, vecs in enumerate(per_ask_vec_constraints):
             for k, (op, col, operand) in enumerate(vecs):
                 c_op[g, k] = op
@@ -343,9 +365,9 @@ class Tensorizer:
 
         CA = _pad_pow2(max((len(v) for v in per_ask_affinities), default=1),
                        floor=2)
-        a_op = np.zeros((Gp, CA), np.int32)
-        a_col = np.zeros((Gp, CA), np.int32)
-        a_rank = np.zeros((Gp, CA), np.int32)
+        a_op = np.zeros((Gp, CA), idt)
+        a_col = np.zeros((Gp, CA), idt)
+        a_rank = np.zeros((Gp, CA), idt)
         a_weight = np.zeros((Gp, CA), np.float32)
         a_weight_sum = np.zeros(Gp, np.float32)
         for g, affs in enumerate(per_ask_affinities):
@@ -441,7 +463,7 @@ class Tensorizer:
         V = _pad_pow2(max((rank_columns[attr_target_ix[sp.attribute]].n_values
                            for sps in all_spreads for sp in sps),
                           default=1), floor=2)
-        sp_col = np.full((Gp, S), -1, np.int32)
+        sp_col = np.full((Gp, S), -1, idt)
         sp_weight = np.zeros((Gp, S), np.float32)
         sp_targeted = np.zeros((Gp, S), bool)
         sp_desired = np.full((Gp, S, V), -1.0, np.float32)
@@ -866,12 +888,15 @@ class Tensorizer:
                     row_cache[sig] = row
             rows.append(row)
 
-        c_op = np.zeros((gp, C), np.int32)
-        c_col = np.zeros((gp, C), np.int32)
-        c_rank = np.zeros((gp, C), np.int32)
-        a_op = np.zeros((gp, CA), np.int32)
-        a_col = np.zeros((gp, CA), np.int32)
-        a_rank = np.zeros((gp, CA), np.int32)
+        # program rows reuse the TEMPLATE's (possibly int16-minimized)
+        # dtypes so repacked batches hit the same compiled kernel
+        idt = template.attr_rank.dtype
+        c_op = np.zeros((gp, C), idt)
+        c_col = np.zeros((gp, C), idt)
+        c_rank = np.zeros((gp, C), idt)
+        a_op = np.zeros((gp, CA), idt)
+        a_col = np.zeros((gp, CA), idt)
+        a_rank = np.zeros((gp, CA), idt)
         a_weight = np.zeros((gp, CA), np.float32)
         # The [gp, Np] ask-side planes are DEFAULT for nearly every
         # fresh-job batch (all-true host masks, no penalties, no
@@ -901,7 +926,7 @@ class Tensorizer:
                  else self._shared_plane("coll0", gp, Np, N))
         penalty = (np.zeros((gp, Np), bool) if need_penalty
                    else self._shared_plane("penalty", gp, Np, N))
-        sp_col = np.full((gp, S), -1, np.int32)
+        sp_col = np.full((gp, S), -1, idt)
         sp_weight = np.zeros((gp, S), np.float32)
         sp_targeted = np.zeros((gp, S), bool)
         sp_desired = np.full((gp, S, V), -1.0, np.float32)

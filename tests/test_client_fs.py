@@ -66,6 +66,18 @@ def cluster(tmp_path_factory):
     server.stop()
 
 
+def test_logs_visible_while_task_running(cluster):
+    """Live streaming: stdout written BEFORE the task's sleep must be
+    readable through /v1/client/fs/logs while the task is still up (the
+    round-5 regression: a buffered 64KiB pipe read held task output
+    back until exit)."""
+    server, c1, c2, h1, h2, api1, alloc = cluster
+    runner = c2.get_alloc_runner(alloc.id)
+    assert runner is not None and not runner.is_done(), \
+        "task must still be running for this test to mean anything"
+    assert "line1" in api1.allocations.logs(alloc.id, task="web")
+
+
 def test_fs_ls_and_stat(cluster):
     server, c1, c2, h1, h2, api1, alloc = cluster
     entries = api1.allocations.fs_ls(alloc.id, "/")

@@ -104,6 +104,34 @@ def test_spread_places_on_nodes_missing_the_attribute():
     assert not np.asarray(res.unfinished).any()
 
 
+@pytest.mark.parametrize("mode", ["topk", "score"])
+def test_pallas_path_matches_unfused_under_both_conflict_impls(
+        both_paths, mode):
+    """The pallas fused wave (interpreter mode on CPU) must commit the
+    SAME placements as the unfused kernel under BOTH same-wave conflict
+    implementations — the fused pass only changes how scores/top-K
+    reach the conflict stage, never what it decides."""
+    nodes, asks = build_problem()
+    pb = Tensorizer().pack(nodes, asks, None)
+    for force_sort in (False, True):
+        KM._FORCE_SORT_CONFLICTS = force_sort
+        jax.clear_caches()
+        r_ref = _run_kernel(pb)
+        ref = (np.asarray(r_ref.choice), np.asarray(r_ref.choice_ok),
+               np.asarray(r_ref.used_final))
+        jax.clear_caches()
+        from nomad_tpu.solver.solve import _kernel_args
+        r_pk = KM.solve_kernel(*_kernel_args(pb), has_spread=True,
+                               pallas_mode=mode)
+        n = pb.n_place
+        ok = ref[1][:n]
+        np.testing.assert_array_equal(ok, np.asarray(r_pk.choice_ok)[:n])
+        np.testing.assert_array_equal(
+            ref[0][:n][ok], np.asarray(r_pk.choice)[:n][ok])
+        np.testing.assert_allclose(ref[2], np.asarray(r_pk.used_final),
+                                   rtol=1e-6)
+
+
 def test_distinct_hosts_respected_under_sort_path(both_paths):
     KM._FORCE_SORT_CONFLICTS = True
     jax.clear_caches()

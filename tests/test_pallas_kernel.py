@@ -1,0 +1,205 @@
+"""Property tests: the pallas fused wave kernel must be
+placement-IDENTICAL to the solver/host.py exact twin.
+
+The pallas path reorganizes the wave's memory traffic (one fused pass
+per node tile, in-kernel per-tile top-K, tournament merge) without
+touching the math: every scoring formula keeps the unfused kernel's
+float summation order, and per-tile extraction + node-ordered merge is
+exact-equal to a full-row lax.top_k.  These tests pin that contract —
+on CPU the kernel runs in pallas INTERPRETER mode (same semantics as a
+Mosaic compile, no TPU needed), so tier-1 guards the fused path.
+"""
+import numpy as np
+import pytest
+
+from test_host_solver import SCENARIOS, assert_same, make_asks, make_nodes
+
+from nomad_tpu.solver import pallas_kernel as PK
+from nomad_tpu.solver.host import HostResidentSolver, host_solve_kernel
+from nomad_tpu.solver.kernel import solve_kernel
+from nomad_tpu.solver.resident import ResidentSolver
+from nomad_tpu.solver.solve import _kernel_args
+from nomad_tpu.solver.tensorize import PlacementAsk, Tensorizer
+
+
+@pytest.mark.parametrize("mode", ["topk", "score"])
+@pytest.mark.parametrize("style,n_nodes,count,seed,devices", SCENARIOS)
+def test_pallas_kernel_matches_host_twin(style, n_nodes, count, seed,
+                                         devices, mode):
+    """Every host-twin differential scenario, fused: same placements,
+    same scores, same explainability counters."""
+    nodes = make_nodes(n_nodes, devices=devices)
+    asks = make_asks(style, count=count)
+    pb = Tensorizer().pack(nodes, asks)
+    has_spread = bool((pb.sp_col[:, 0] >= 0).any())
+    args = _kernel_args(pb)
+    res_pk = solve_kernel(*args, seed, has_spread=has_spread,
+                          pallas_mode=mode)
+    res_host = host_solve_kernel(*args, seed, has_spread=has_spread)
+    assert_same(res_pk, res_host)
+
+
+@pytest.mark.parametrize("stack_commit", [False, True])
+def test_pallas_stack_commit_matches_host(stack_commit):
+    """The exact-quality mode (serial-fidelity stacking) through the
+    fused kernel — the quality duel's semantics."""
+    nodes = make_nodes(24)
+    asks = make_asks("constrained", count=10)
+    pb = Tensorizer().pack(nodes, asks)
+    args = _kernel_args(pb)
+    res_pk = solve_kernel(*args, 0, has_spread=True,
+                          stack_commit=stack_commit, pallas_mode="topk")
+    res_host = host_solve_kernel(*args, 0, has_spread=True,
+                                 stack_commit=stack_commit)
+    assert_same(res_pk, res_host)
+
+
+def test_pallas_randomized_property_sweep():
+    """Randomized problem generator: shapes, loads, constraint mixes
+    and seeds drawn per trial; every trial must be placement-identical
+    between the fused kernel and the host twin."""
+    rng = np.random.RandomState(7)
+    styles = ["binpack", "constrained", "devices", "distinct"]
+    for trial in range(8):
+        style = styles[trial % len(styles)]
+        n_nodes = int(rng.randint(10, 70))
+        count = int(rng.randint(2, 12))
+        seed = int(rng.randint(0, 10))
+        mode = "topk" if trial % 2 == 0 else "score"
+        nodes = make_nodes(n_nodes, devices=style == "devices")
+        asks = make_asks(style, count=count,
+                         n_groups=int(rng.randint(1, 4)))
+        pb = Tensorizer().pack(nodes, asks)
+        has_spread = bool((pb.sp_col[:, 0] >= 0).any())
+        args = _kernel_args(pb)
+        res_pk = solve_kernel(*args, seed, has_spread=has_spread,
+                              pallas_mode=mode)
+        res_host = host_solve_kernel(*args, seed,
+                                     has_spread=has_spread)
+        try:
+            assert_same(res_pk, res_host)
+        except AssertionError as e:
+            raise AssertionError(
+                f"trial {trial}: style={style} n={n_nodes} "
+                f"count={count} seed={seed} mode={mode}: {e}")
+
+
+def test_pallas_stream_matches_host_stream():
+    """Carried usage across multi-batch streams through the fused
+    kernel — the production resident path."""
+    nodes = make_nodes(50)
+    probe = make_asks("constrained", count=4)
+    rs = ResidentSolver(nodes, probe, gp=8, kp=32, pallas="topk")
+    hs = HostResidentSolver(nodes, probe, gp=8, kp=32,
+                            device_parity=True)
+    for seeds in (None, [3, 5, 9]):
+        rs.reset_usage()
+        hs.reset_usage()
+        batches_r, batches_h = [], []
+        for b in range(3):
+            asks = make_asks("constrained", count=4)
+            for a in asks:
+                a.job.id = f"job-{b}"
+            batches_r.append(rs.pack_batch(asks))
+            batches_h.append(hs.pack_batch(asks))
+        c_r, ok_r, s_r, st_r = rs.solve_stream(batches_r, seeds=seeds)
+        c_h, ok_h, s_h, st_h = hs.solve_stream(batches_h, seeds=seeds)
+        np.testing.assert_array_equal(ok_r, ok_h)
+        np.testing.assert_array_equal(np.where(ok_r, c_r, -1),
+                                      np.where(ok_h, c_h, -1))
+        np.testing.assert_array_equal(st_r, st_h)
+        u_r, _ = rs.usage()
+        u_h, _ = hs.usage()
+        np.testing.assert_allclose(u_r, u_h, rtol=1e-5)
+
+
+def test_pipelined_stream_matches_fused_stream():
+    """solve_stream_pipelined (pack b+1 under solve b, one concatenated
+    fetch) must produce exactly what the fused solve_stream produces,
+    and report its phase breakdown."""
+    nodes = make_nodes(40)
+    probe = make_asks("binpack", count=4)
+
+    def batches_for(rs):
+        out = []
+        for b in range(4):
+            asks = make_asks("binpack", count=4)
+            for a in asks:
+                a.job.id = f"job-{b}"
+            out.append(rs.pack_batch(asks))
+        return out
+
+    rs1 = ResidentSolver(nodes, probe, gp=8, kp=32)
+    c1, ok1, s1, st1 = rs1.solve_stream(batches_for(rs1),
+                                        seeds=[1, 2, 3, 4])
+    rs2 = ResidentSolver(nodes, probe, gp=8, kp=32)
+    c2, ok2, s2, st2 = rs2.solve_stream_pipelined(batches_for(rs2),
+                                                  seeds=[1, 2, 3, 4])
+    np.testing.assert_array_equal(ok1, ok2)
+    np.testing.assert_array_equal(np.where(ok1, c1, -1),
+                                  np.where(ok2, c2, -1))
+    np.testing.assert_array_equal(st1, st2)
+    stats = rs2.last_pipeline_stats
+    assert stats["n_dispatches"] == 4
+    assert all(k in stats for k in ("pack_s", "dispatch_s", "fetch_s"))
+
+
+def test_wave_instrumentation_and_traffic_model():
+    """Per-batch wave counts come back from the stream kernel, and the
+    traffic model reports the fused-vs-unfused byte budgets the bench's
+    achieved-GB/s report is built on."""
+    nodes = make_nodes(40)
+    probe = make_asks("binpack", count=4)
+    rs = ResidentSolver(nodes, probe, gp=8, kp=32, pallas="topk")
+    pb = rs.pack_batch(make_asks("binpack", count=4))
+    rs.solve_stream([pb])
+    waves = np.asarray(rs.last_waves)
+    assert waves.shape == (1,) and int(waves[0]) >= 1
+    tr = rs.wave_traffic([pb])
+    assert tr["mode"] == "topk"
+    assert tr["fused_pass_count"] == 1
+    assert tr["bytes_per_wave"] > 0 and tr["tile"] >= 1
+    rs_off = ResidentSolver(nodes, probe, gp=8, kp=32, pallas="off")
+    tr_off = rs_off.wave_traffic([pb])
+    assert tr_off["bytes_per_wave"] > tr["bytes_per_wave"], \
+        "the fused pass must model strictly less HBM traffic"
+
+
+def test_resolve_mode_gates():
+    """Static mode resolution: wide value vocabularies and oversized
+    candidate windows fall back rather than mis-fuse."""
+    assert PK.resolve_mode(1024, 4, 68, 4, True,
+                           enabled_hint=True) == "topk"
+    assert PK.resolve_mode(10240, 4, 1028, 4, True,
+                           enabled_hint=True) == "score"
+    assert PK.resolve_mode(1024, 4, 68, 64, True,
+                           enabled_hint=True) == "off"   # V too wide
+    assert PK.resolve_mode(1024, 4, 68, 4, True,
+                           enabled_hint=False) == "off"
+
+
+def test_merged_throughput_stream_pallas_score_mode():
+    """Merged few-group batches (throughput mode) through "score" mode:
+    placements identical to the unfused device kernel."""
+    nodes = make_nodes(60)
+    from nomad_tpu import mock
+    job = mock.job()
+    job.datacenters = ["dc0", "dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 48
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = 350
+    asks = [PlacementAsk(job=job, tg=tg, count=48)]
+    rs_on = ResidentSolver(nodes, asks, gp=1, kp=64, pallas="score")
+    rs_off = ResidentSolver(nodes, asks, gp=1, kp=64, pallas="off")
+    pb_on = rs_on.pack_batch(asks)
+    pb_off = rs_off.pack_batch(asks)
+    for seeds in (None, [5]):
+        rs_on.reset_usage()
+        rs_off.reset_usage()
+        c1, ok1, s1, st1 = rs_on.solve_stream([pb_on], seeds=seeds)
+        c2, ok2, s2, st2 = rs_off.solve_stream([pb_off], seeds=seeds)
+        np.testing.assert_array_equal(ok1, ok2)
+        np.testing.assert_array_equal(np.where(ok1, c1, -1),
+                                      np.where(ok2, c2, -1))
+        np.testing.assert_array_equal(st1, st2)

@@ -42,6 +42,30 @@ def test_pack_to_capacity_duel_small():
         f"vs stock {stock['placements']}")
 
 
+def test_pallas_exact_mode_is_placement_identical():
+    """The pallas fused path must not move a single placement of the
+    EXACT-mode duel workload: run the same pack-to-capacity stream with
+    the fused kernel (interpreter mode on CPU) and the unfused kernel —
+    placed/failed/retried must match exactly, so every quality-duel
+    result transfers to the pallas path unchanged."""
+    import bench
+
+    n_nodes, count = 64, 8
+    cap = int(n_nodes * (7500 / 625))
+    n_evals = int(cap * 1.1) // count
+    on = bench.run_ours(3, n_nodes=n_nodes, n_evals=n_evals,
+                        count=count, resident=0, evals_per_call=1,
+                        exact=True, pallas="topk")
+    off = bench.run_ours(3, n_nodes=n_nodes, n_evals=n_evals,
+                         count=count, resident=0, evals_per_call=1,
+                         exact=True, pallas="off")
+    assert (on["placements"], on["failed"], on["unresolved"]) == \
+        (off["placements"], off["failed"], off["unresolved"]), (
+        f"pallas exact mode diverged: {on['placements']}/"
+        f"{on['failed']}/{on['unresolved']} vs {off['placements']}/"
+        f"{off['failed']}/{off['unresolved']}")
+
+
 def test_pack_to_capacity_duel_pure_binpack():
     """Identical items: both engines must reach the same (maximal)
     fill; any loss here is a solver capacity-accounting bug."""
