@@ -46,13 +46,28 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _enable_compile_cache():
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/nomad_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # shared opt-in util (utils/compile_cache): agent config / env can
+    # point it anywhere durable; the bench defaults it on so warm
+    # restarts measure the failover-relevant startup
+    from nomad_tpu.utils.compile_cache import enable_compile_cache
+    return enable_compile_cache(
+        os.environ.get("NOMAD_TPU_COMPILE_CACHE")
+        or "/tmp/nomad_tpu_jax_cache")
 
 
 _enable_compile_cache()
+
+
+def _cache_report(entries_before):
+    """Compile-cache hit/miss report for the startup line: programs
+    persisted during THIS startup are misses; a fully warm start adds
+    none."""
+    from nomad_tpu.utils.compile_cache import (cache_entries,
+                                               enable_compile_cache)
+    d = enable_compile_cache(None)
+    added = cache_entries() - entries_before
+    return {"dir": d, "entries_before": entries_before,
+            "compiles_persisted": added, "warm_start": added == 0}
 STOCK_BIN = os.path.join(REPO, "bench", "stock_engine")
 STOCK_SRC = os.path.join(REPO, "bench", "stock_engine.cc")
 
@@ -168,6 +183,17 @@ def asks_for(job):
             for tg in job.task_groups]
 
 
+def _steady_alloc():
+    """A plan-apply-feedback alloc for the steady-state delta waves."""
+    from nomad_tpu import mock
+    a = mock.alloc()
+    tr = a.allocated_resources.tasks["web"]
+    tr.cpu, tr.memory_mb, tr.networks = 200, 256, []
+    a.allocated_resources.shared.networks = []
+    a.allocated_resources.shared.disk_mb = 300
+    return a
+
+
 def _harvest(status_row, pb, asks, STATUS_RETRY):
     """Vectorized per-batch result accounting: (placed, failed,
     [(ask, retry_count), ...])."""
@@ -206,6 +232,8 @@ def run_ours(config, n_nodes, n_evals, count, resident,
 
     devices = config == 4
     nodes = make_nodes(n_nodes, devices=devices, gen_seed=gen_seed)
+    from nomad_tpu.utils.compile_cache import cache_entries
+    cache0 = cache_entries()
     t0 = time.perf_counter()
     probe_job = make_job(config, 0, count, gen_seed=gen_seed)
     epc = min(evals_per_call, n_evals)
@@ -418,6 +446,60 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     unresolved += sum(r for _, r in cur)
     total_evals = n_evals
     elapsed_all = time.perf_counter() - t_start
+
+    # ---- steady-state delta waves (ISSUE 2 acceptance) ----
+    # The store-stable-jobs regime: the SAME eval population
+    # re-dispatched (blocked-eval retries, drain re-evals, rollouts)
+    # with a plan-apply usage changeset applied between waves.  Packing
+    # is the eval-cache hit, dispatch re-ships nothing (device-cached
+    # stacked args), and the device scatters only the delta rows —
+    # measured against the first-pass per-wave pack+dispatch cost.
+    steady = None
+    if merge and batches:
+        from nomad_tpu.solver.tensorize import ClusterDelta
+        n_steady = min(4, len(batches))
+        # warm the scatter-apply kernels at the steady shape (pow2-
+        # padded slot cardinality) outside the timed region
+        warm_d = ClusterDelta()
+        for k in range(32):
+            nid = nodes[(k * 41 + 3) % n_nodes].id
+            a = _steady_alloc()
+            warm_d.place.append((nid, a))
+            warm_d.stop.append((nid, a))
+        rs.apply_delta(warm_d)
+        deltas = []
+        for w in range(n_steady):
+            d = ClusterDelta()
+            for k in range(32):
+                nid = nodes[(w * 977 + k * 131) % n_nodes].id
+                a = _steady_alloc()
+                d.place.append((nid, a))
+                d.stop.append((nid, a))   # net-zero churn: place+stop
+            deltas.append(d)
+        t_s = time.perf_counter()
+        rs.solve_stream_pipelined(
+            batches[:n_steady], seeds=[7001 + b for b in range(n_steady)],
+            deltas=deltas)
+        steady_elapsed = time.perf_counter() - t_s
+        st = rs.last_pipeline_stats
+        main_pd = (pack_s + dispatch_s) / max(n_dispatches, 1)
+        steady_pd = (st["pack_s"] + st["dispatch_s"]) / n_steady
+        steady = {
+            "waves": n_steady,
+            "pack_ms_per_wave": round(1000 * st["pack_s"] / n_steady, 3),
+            "dispatch_ms_per_wave": round(
+                1000 * st["dispatch_s"] / n_steady, 3),
+            "delta_apply_ms_per_wave": round(
+                1000 * st["delta_apply_s"] / n_steady, 3),
+            "bytes_dispatched_delta_waves": st["bytes_dispatched"],
+            "elapsed_s": round(steady_elapsed, 4),
+            "first_pass_pack_dispatch_ms_per_wave": round(
+                1000 * main_pd, 3),
+            "steady_pack_dispatch_ms_per_wave": round(
+                1000 * steady_pd, 3),
+            "pack_dispatch_reduction": round(
+                main_pd / max(steady_pd, 1e-9), 1),
+        }
     # every eval in a fused call completes when the call completes
     latencies = [elapsed_all] * n_evals
     elapsed = elapsed_all
@@ -436,6 +518,9 @@ def run_ours(config, n_nodes, n_evals, count, resident,
             "dispatch": round(1000 * dispatch_s, 1),
             "solve_and_fetch_wait": round(1000 * fetch_wait_s, 1),
         },
+        "steady_state": steady,
+        "delta_counters": dict(rs.delta_counters),
+        "compile_cache": _cache_report(cache0),
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
@@ -574,6 +659,8 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
     from nomad_tpu.solver.resident import ResidentSolver, STATUS_RETRY
 
     nodes = make_nodes(n_nodes, devices=config == 4)
+    from nomad_tpu.utils.compile_cache import cache_entries
+    cache0 = cache_entries()
     t0 = time.perf_counter()
     probe_job = make_job(config, 0, count)
     gp_need = len(probe_job.task_groups)
@@ -622,6 +709,7 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
         "evals": n_evals, "placements": placed, "failed": failed,
         "retried": retried, "unresolved": unresolved,
         "n_device_calls": n_calls,
+        "compile_cache": _cache_report(cache0),
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(n_evals / elapsed, 1),
@@ -654,6 +742,8 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
     region_universe = make_nodes(n_nodes)
     all_jobs = [[make_job(5, r * n_evals + e, count)
                  for e in range(n_evals)] for r in range(n_regions)]
+    from nomad_tpu.utils.compile_cache import cache_entries
+    cache0 = cache_entries()
     t0 = time.perf_counter()
     # one shared universe across regions: the federated solver packs
     # it once (usage tensors stay per-region).  gp sized to the real
@@ -689,19 +779,47 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
     t_start = time.perf_counter()
     batches = [[] for _ in range(n_regions)]
     outs = []
+    pack_s = dispatch_s = 0.0
     for b in range(NB):
         i = b * epc
         step = []
+        t_p = time.perf_counter()
         for r in range(n_regions):
             masks, mkeys = fed.merge_asks(r, sum(
                 (asks_for(j) for j in all_jobs[r][i:i + epc]), []))
             pb = fed.pack_batch_cached(r, masks, job_keys=mkeys)
             batches[r].append(pb)
             step.append([pb])
+        t_d = time.perf_counter()
         outs.append(fed.solve_stream_async(
             step, seeds=[[r * NB + b + 1] for r in range(n_regions)]))
+        t_e = time.perf_counter()
+        pack_s += t_d - t_p
+        dispatch_s += t_e - t_d
     packed = np.asarray(concat_jit(*outs))            # ONE fetch
+    elapsed = time.perf_counter() - t_start
     status = packed[:, :, :, -1].astype(np.int32)     # [NB, R, K]
+
+    # steady-state delta waves: the same region-fused steps
+    # re-dispatched — the step-level device cache ships nothing
+    n_steady = min(4, NB)
+    t_s = time.perf_counter()
+    souts = [fed.solve_stream_async(
+        [[batches[r][b]] for r in range(n_regions)],
+        seeds=[[9000 + r * NB + b] for r in range(n_regions)])
+        for b in range(n_steady)]
+    t_sd = time.perf_counter()
+    np.asarray(concat_jit(*souts) if n_steady > 1 else souts[0])
+    main_pd = (pack_s + dispatch_s) / max(NB, 1)
+    steady_pd = (t_sd - t_s) / n_steady
+    steady = {
+        "waves": n_steady,
+        "steady_pack_dispatch_ms_per_wave": round(1000 * steady_pd, 3),
+        "first_pass_pack_dispatch_ms_per_wave": round(1000 * main_pd, 3),
+        "pack_dispatch_reduction": round(main_pd / max(steady_pd, 1e-9),
+                                         1),
+        "elapsed_s": round(time.perf_counter() - t_s, 4),
+    }
 
     placed = failed = unresolved = 0
     for r in range(n_regions):
@@ -710,7 +828,6 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
             placed += int((st == 1).sum())
             failed += int((st == 0).sum())
             unresolved += int((st == STATUS_RETRY).sum())
-    elapsed = time.perf_counter() - t_start
     total_evals = n_regions * n_evals
     return {
         "engine": f"nomad-tpu federated stream x{n_regions} regions, "
@@ -718,6 +835,12 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
         "evals": total_evals, "placements": placed, "failed": failed,
         "retried": 0, "unresolved": unresolved,
         "n_device_calls": 1,
+        "breakdown_ms": {
+            "pack": round(1000 * pack_s, 1),
+            "dispatch": round(1000 * dispatch_s, 1),
+        },
+        "steady_state": steady,
+        "compile_cache": _cache_report(cache0),
         "elapsed_s": round(elapsed, 4),
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
@@ -959,6 +1082,15 @@ def main():
             "numerator runs over a tunneled TPU transport with a fixed "
             "~100ms round trip per device call; local-attached TPU "
             "dispatch is ~100x lower latency",
+            "per-config ours.steady_state reports the DELTA-WAVE regime "
+            "(ISSUE 2): the same eval population re-dispatched with a "
+            "plan-apply usage changeset applied between waves — "
+            "pack_dispatch_reduction compares first-pass vs steady "
+            "per-wave pack+dispatch ms; ours.delta_counters carries "
+            "delta_applies / repack_fallbacks / last_delta_ratio / "
+            "bytes_dispatched_delta vs bytes_dispatched_full, and "
+            "ours.compile_cache the persistent-XLA-cache hit/miss of "
+            "this startup (warm_start = no new compiles persisted)",
             "numerator THROUGHPUT mode merges identical stateless asks "
             "at pack time (summed counts; distinct_hosts and stateful "
             "asks never merge) — the columnar payoff of coalescing "

@@ -1,0 +1,119 @@
+"""Pack-path probe (ISSUE 2): full-repack vs delta-pack vs device
+scatter-apply across resident-alloc counts.
+
+Stages, per resident count (10k / 50k / 100k on a 10k-node cluster):
+
+  full_pack_ms       — Tensorizer.pack of the whole world (node walk,
+                       attr interning, used0 accumulation): the cost a
+                       non-resident scheduler pays per eval
+  delta_pack_ms      — Tensorizer.delta_pack of a realistic changeset
+                       (64 allocs placed/stopped + 8 node updates +
+                       1 join + 1 drain) against the resident template
+  scatter_apply_ms   — ResidentSolver.apply_delta end to end: host
+                       apply + donate-buffer device scatter dispatch
+  repack_fallback_ms — apply_delta through the threshold fallback
+                       (full node-side re-put), the invalidation cost
+
+    python bench/probe_pack.py [resident ...]
+"""
+import json
+import sys
+import time
+
+import os as _os
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+
+import bench as B  # noqa: E402
+
+
+def make_delta(nodes, rng_seed=0):
+    import copy
+
+    from nomad_tpu.solver.tensorize import ClusterDelta
+    d = ClusterDelta()
+    for k in range(64):
+        nid = nodes[(rng_seed * 977 + k * 131) % len(nodes)].id
+        a = B._steady_alloc()
+        d.place.append((nid, a))
+        if k % 2:
+            d.stop.append((nid, a))
+    for k in range(8):
+        n = copy.copy(nodes[(rng_seed * 31 + k * 997) % len(nodes)])
+        n.node_resources = copy.deepcopy(n.node_resources)
+        n.node_resources.cpu += 1000
+        d.upsert_nodes.append(n)
+    join = B.make_nodes(1, gen_seed=rng_seed + 7)[0]
+    d.upsert_nodes.append(join)
+    d.remove_node_ids.append(
+        nodes[(rng_seed * 13 + 5) % len(nodes)].id)
+    return d
+
+
+def run(resident, n_nodes=10_000, trials=5):
+    import numpy as np
+
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.tensorize import Tensorizer
+
+    nodes = B.make_nodes(n_nodes)
+    probe_job = B.make_job(3, 0, 64)
+    asks = B.asks_for(probe_job)
+
+    # resident usage: allocs_by_node for the full pack; the resident
+    # solver takes the equivalent used0 tensor directly
+    by_node = {}
+    for i in range(resident):
+        nid = nodes[i % n_nodes].id
+        by_node.setdefault(nid, []).append(B._steady_alloc())
+
+    def best(f, *a):
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            f(*a)
+            ts.append(time.perf_counter() - t0)
+        return round(1000 * min(ts), 2)
+
+    out = {"n_nodes": n_nodes, "resident": resident}
+    out["full_pack_ms"] = best(
+        lambda: Tensorizer().pack(nodes, asks, by_node))
+
+    rs = ResidentSolver(nodes, asks, allocs_by_node=by_node)
+    tz = rs._tz
+    # changeset construction (mock allocs, node copies) happens outside
+    # every timed region — the stages measure tensorize/apply only
+    fixed_delta = make_delta(rs.nodes, 3)
+    out["delta_pack_ms"] = best(
+        lambda: tz.delta_pack(rs.template, rs.node_index, fixed_delta))
+
+    apply_deltas = [make_delta(rs.nodes, s) for s in range(1, 9)]
+    seq = [0]
+
+    def scatter_apply():
+        action = rs.apply_delta(apply_deltas[seq[0]
+                                             % len(apply_deltas)])
+        seq[0] += 1
+        assert action == "delta", action
+    out["scatter_apply_ms"] = best(scatter_apply)
+    out["delta_counters"] = dict(rs.delta_counters)
+
+    def repack_fallback():
+        rs.repack()
+    out["repack_fallback_ms"] = best(repack_fallback)
+    out["full_vs_delta_pack_x"] = round(
+        out["full_pack_ms"] / max(out["delta_pack_ms"], 1e-6), 1)
+    out["full_vs_scatter_apply_x"] = round(
+        out["full_pack_ms"] / max(out["scatter_apply_ms"], 1e-6), 1)
+    return out
+
+
+def main():
+    counts = ([int(a) for a in sys.argv[1:]]
+              or [10_000, 50_000, 100_000])
+    results = [run(c) for c in counts]
+    print(json.dumps({"probe": "pack", "results": results}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

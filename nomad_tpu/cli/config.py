@@ -35,6 +35,9 @@ class AgentConfig:
     http_port: int = 4646
     server_enabled: bool = True
     num_schedulers: int = 2
+    #: persistent XLA compile cache dir (utils/compile_cache) — warm
+    #: restarts skip the multi-second solver recompiles; "" = off
+    compile_cache_dir: str = ""
     client_enabled: bool = True
     datacenter: str = "dc1"
     meta: Dict[str, str] = field(default_factory=dict)
@@ -100,6 +103,8 @@ def _from_dict(d: dict) -> AgentConfig:
     cfg.server_enabled = bool(srv.get("enabled", cfg.server_enabled))
     cfg.num_schedulers = int(srv.get("num_schedulers",
                                      cfg.num_schedulers))
+    cfg.compile_cache_dir = srv.get("compile_cache_dir",
+                                    cfg.compile_cache_dir)
     cl = d.get("client") or {}
     cfg.client_enabled = bool(cl.get("enabled", cfg.client_enabled))
     cfg.datacenter = cl.get("datacenter", cfg.datacenter)

@@ -70,6 +70,10 @@ def cmd_agent(args) -> int:
     # themselves
     from ..utils.monitor import parse_level
     logging.getLogger("nomad_tpu").setLevel(parse_level(cfg.log_level))
+    # warm restarts skip the solver's XLA recompiles when a persistent
+    # compile cache dir is configured (config or env opt-in)
+    from ..utils.compile_cache import enable_compile_cache
+    enable_compile_cache(cfg.compile_cache_dir or None)
     if cfg.tls_rpc:
         print("WARNING: tls { rpc = true } has no effect in -dev mode "
               "(single process, no RPC sockets); serve_cluster wires "

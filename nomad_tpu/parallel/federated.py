@@ -225,7 +225,19 @@ class FederatedResidentSolver:
 
     def _stack_args(self, batches, NB):
         """[B, R, ...] host stack with the device-resident zero-constant
-        shortcut for the big [G, N] tensors (see ResidentSolver)."""
+        shortcut for the big [G, N] tensors (see ResidentSolver).  A
+        re-dispatched step (same PackedBatch objects — the steady-state
+        delta-wave schedule) returns its fully device-put dict from
+        cache and ships nothing."""
+        key = tuple(id(pb) for rb in batches for pb in rb)
+        cached = getattr(self, "_step_cache", None)
+        if cached is None:
+            cached = self._step_cache = {}
+        flat_pbs = [pb for rb in batches for pb in rb]
+        hit = cached.get(key)
+        if hit is not None and len(hit[0]) == len(flat_pbs) \
+                and all(a is b for a, b in zip(hit[0], flat_pbs)):
+            return hit[1]
         stacked = {}
         for name in _ASK_ARGS:
             mats = [[getattr(batches[r][b], name) for r in range(self.R)]
@@ -252,7 +264,12 @@ class FederatedResidentSolver:
                 continue
             stacked[name] = np.stack(
                 [np.stack(row) for row in mats])
-        return stacked
+        dev = {k: (jax.device_put(v) if isinstance(v, np.ndarray)
+                   else v) for k, v in stacked.items()}
+        if len(cached) > 64:
+            cached.clear()
+        cached[key] = (flat_pbs, dev)
+        return dev
 
     # ---------------- usage ----------------
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:

@@ -28,16 +28,23 @@ class RpcError(Exception):
 
 class RpcClient:
     def __init__(self, addr: Tuple[str, int], pool_size: int = 4,
-                 tls=None):
+                 tls=None, verify_hostname: str = ""):
         """`tls`: an ssl.SSLContext from tlsutil.client_context —
         presents this node's cert and verifies the server against the
-        cluster CA on every pooled dial."""
+        cluster CA on every pooled dial.
+
+        `verify_hostname`: expected SAN role of the PEER (e.g.
+        "server.global.nomad") — applied post-handshake on every fresh
+        dial (reference: VerifyServerHostname).  CA pinning alone
+        accepts ANY cluster cert; the role check stops a client-role
+        cert from impersonating a server."""
         self.addr = (addr[0], int(addr[1]))
         self._pool: List[socket.socket] = []
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._pool_size = pool_size
         self._tls = tls
+        self._verify_hostname = verify_hostname
 
     def call(self, method: str, params: List[Any],
              timeout: float = CALL_TIMEOUT_S) -> Any:
@@ -88,6 +95,17 @@ class RpcClient:
         if self._tls is not None:
             sock = self._tls.wrap_socket(
                 sock, server_hostname=self.addr[0])
+            if self._verify_hostname:
+                from ..utils.tlsutil import peer_role
+                role = peer_role(sock)
+                if role != self._verify_hostname:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise OSError(
+                        f"peer presented role {role!r}, expected "
+                        f"{self._verify_hostname!r}")
         return sock
 
     def _checkin(self, sock: socket.socket) -> None:
@@ -105,10 +123,11 @@ class ClientPool:
     """Keyed RpcClient pool shared by the raft transport and the server
     endpoints; replacing a key's address closes the old client."""
 
-    def __init__(self, tls=None):
+    def __init__(self, tls=None, verify_hostname: str = ""):
         self._clients: Dict[str, RpcClient] = {}
         self._lock = threading.Lock()
         self._tls = tls
+        self._verify_hostname = verify_hostname
 
     def get(self, key: str, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], int(addr[1]))
@@ -117,7 +136,8 @@ class ClientPool:
             if c is None or c.addr != addr:
                 if c is not None:
                     c.close()
-                c = RpcClient(addr, tls=self._tls)
+                c = RpcClient(addr, tls=self._tls,
+                              verify_hostname=self._verify_hostname)
                 self._clients[key] = c
             return c
 

@@ -29,11 +29,13 @@ class ServerRpc:
 
     def __init__(self, server, rpc_server: RpcServer,
                  peer_addrs: Optional[Dict[str, Tuple[str, int]]] = None,
-                 tls=None):
+                 tls=None, verify_hostname: str = ""):
         self.server = server
         self.rpc = rpc_server
         self.peer_addrs = dict(peer_addrs or {})
-        self._pool = ClientPool(tls=tls)
+        # follower->leader forwarding is server-to-server: pin the
+        # dialed peer's SAN role when verify_hostname is set
+        self._pool = ClientPool(tls=tls, verify_hostname=verify_hostname)
         # leader_only verbs forward to the leader up front (heartbeats
         # must reset the LEADER's failure detector, not a follower's
         # disabled one — nomad/rpc.go forward() runs before the handler);
@@ -185,7 +187,8 @@ class RpcServerEndpoints(ServerEndpoints):
 
 def serve_cluster(n: int = 3, host: str = "127.0.0.1", num_workers: int = 1,
                   server_kwargs: Optional[dict] = None,
-                  tls_server=None, tls_client=None):
+                  tls_server=None, tls_client=None,
+                  verify_hostname: str = ""):
     """Boot an n-server cluster wired over TCP: one RpcServer per member
     carrying both the raft verbs and the server endpoints. Returns
     (servers, server_rpcs, addrs). The reference's in-process test
@@ -199,12 +202,14 @@ def serve_cluster(n: int = 3, host: str = "127.0.0.1", num_workers: int = 1,
     addrs = {pid: rpc.addr for pid, rpc in zip(ids, rpcs)}
     servers, server_rpcs = [], []
     for pid, rpc in zip(ids, rpcs):
-        transport = TcpRaftTransport(rpc, addrs, tls=tls_client)
+        transport = TcpRaftTransport(rpc, addrs, tls=tls_client,
+                                     verify_hostname=verify_hostname)
         srv = Server(num_workers=num_workers,
                      raft_config=RaftConfig(node_id=pid, peers=list(ids)),
                      raft_transport=transport,
                      **(server_kwargs or {}))
-        server_rpcs.append(ServerRpc(srv, rpc, addrs, tls=tls_client))
+        server_rpcs.append(ServerRpc(srv, rpc, addrs, tls=tls_client,
+                                     verify_hostname=verify_hostname))
         servers.append(srv)
         rpc.start()
     for srv in servers:

@@ -17,13 +17,23 @@ _log = logging.getLogger(__name__)
 
 class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 tls=None):
+                 tls=None, region: str = "global"):
         """`tls`: an ssl.SSLContext from tlsutil.server_context —
         mutual TLS; a client with no CA-signed cert fails the
         handshake before a single frame is read (reference:
-        nomad/rpc.go:99-115 wraps every conn in tls.Server)."""
-        self._handlers: Dict[str, Callable[[List[Any]], Any]] = {}
+        nomad/rpc.go:99-115 wraps every conn in tls.Server).
+
+        `region` names the server SAN role (`server.<region>.nomad`)
+        that verbs registered with server_only=True require of the
+        PEER's certificate — the reference's certificate-role check
+        (nomad/rpc.go validateServerHostname): with mutual TLS on, a
+        client-role cert must not reach raft or other server-to-server
+        verbs."""
+        self._handlers: Dict[str, Tuple[Callable[[List[Any]], Any],
+                                        bool]] = {}
         self._tls = tls
+        self.region = region
+        self._server_role = f"server.{region}.nomad"
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -32,11 +42,13 @@ class RpcServer:
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
 
-    def register(self, method: str,
-                 fn: Callable[[List[Any]], Any]) -> None:
+    def register(self, method: str, fn: Callable[[List[Any]], Any],
+                 server_only: bool = False) -> None:
         """fn receives the params list and returns a JSON-able result;
-        raising RpcHandlerError sends a typed error frame."""
-        self._handlers[method] = fn
+        raising RpcHandlerError sends a typed error frame.
+        `server_only` verbs (raft, server-to-server forwarding) require
+        the mTLS peer to present a server.<region>.nomad role cert."""
+        self._handlers[method] = (fn, server_only)
 
     def start(self) -> None:
         self._accept_thread = threading.Thread(
@@ -62,6 +74,7 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        role: Optional[str] = None
         if self._tls is not None:
             try:
                 # a short handshake deadline so a plaintext client
@@ -76,6 +89,8 @@ class RpcServer:
                 except OSError:
                     pass
                 return
+            from ..utils.tlsutil import peer_role
+            role = peer_role(conn)
         try:
             while not self._shutdown.is_set():
                 try:
@@ -88,7 +103,7 @@ class RpcServer:
                 if self._shutdown.is_set():
                     return
                 try:
-                    resp = self._dispatch(req)
+                    resp = self._dispatch(req, role)
                     send_frame(conn, resp)
                 except OSError:
                     return
@@ -111,16 +126,28 @@ class RpcServer:
             except OSError:
                 pass
 
-    def _dispatch(self, req: Any) -> Any:
+    def _dispatch(self, req: Any, role: Optional[str] = None) -> Any:
         if not isinstance(req, dict):
             return {"id": None, "error": {"kind": "bad_request",
                                           "message": "frame is not an object"}}
         rid = req.get("id")
         method = req.get("method", "")
-        fn = self._handlers.get(method)
-        if fn is None:
+        ent = self._handlers.get(method)
+        if ent is None:
             return {"id": rid, "error": {"kind": "unknown_method",
                                          "message": method}}
+        fn, server_only = ent
+        if server_only and self._tls is not None \
+                and role != self._server_role:
+            # certificate-role confusion guard: with mTLS on, ANY
+            # CA-signed cert completes the handshake, but only a
+            # server-role cert may speak server-to-server verbs
+            _log.warning("rpc %s denied: peer role %r != %r", method,
+                         role, self._server_role)
+            return {"id": rid, "error": {
+                "kind": "permission_denied",
+                "message": f"{method} requires a "
+                           f"{self._server_role} certificate"}}
         try:
             return {"id": rid, "result": fn(req.get("params", []))}
         except RpcHandlerError as e:

@@ -35,13 +35,16 @@ VOTE_PROBE_WINDOW_S = 2 * VOTE_PROBE_TIMEOUT_S
 
 class TcpRaftTransport:
     def __init__(self, rpc_server: RpcServer,
-                 peer_addrs: Dict[str, Tuple[str, int]], tls=None):
+                 peer_addrs: Dict[str, Tuple[str, int]], tls=None,
+                 verify_hostname: str = ""):
         """peer_addrs: raft node id -> (host, port) of that peer's
         RpcServer (including this node's own).  `tls`: client-side
-        ssl context for peer dials (mutual TLS)."""
+        ssl context for peer dials (mutual TLS); `verify_hostname`
+        additionally pins the dialed peer's SAN role (raft peers must
+        present server.<region>.nomad)."""
         self.rpc_server = rpc_server
         self.peer_addrs = dict(peer_addrs)
-        self._pool = ClientPool(tls=tls)
+        self._pool = ClientPool(tls=tls, verify_hostname=verify_hostname)
         self._lock = threading.Lock()
         self._local: Dict[str, Any] = {}
         self._backoff: Dict[str, Tuple[float, int]] = {}  # until, fails
@@ -62,9 +65,12 @@ class TcpRaftTransport:
 
         for verb in ("rpc_request_vote", "rpc_append_entries",
                      "rpc_install_snapshot"):
+            # raft is strictly server-to-server: with mTLS on, a
+            # client-role cert must not be able to vote or append
             self.rpc_server.register(
                 f"raft.{verb}",
-                lambda params, _v=verb, _n=node: handler(params, _v, _n))
+                lambda params, _v=verb, _n=node: handler(params, _v, _n),
+                server_only=True)
 
     def unregister(self, node_id: str) -> None:
         self._local.pop(node_id, None)

@@ -5,13 +5,18 @@ from __future__ import annotations
 from ..structs import JOB_TYPE_BATCH, JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM
 
 
-def new_scheduler(sched_type: str, state, planner):
+def new_scheduler(sched_type: str, state, planner, solver=None):
+    """`solver`: the worker's long-lived Solver — sharing it across
+    evals is what keeps its resident cluster world (and tensorizer
+    memoization) warm between invocations."""
     from .generic import GenericScheduler
     from .system import SystemScheduler
     if sched_type == JOB_TYPE_SERVICE:
-        return GenericScheduler(state, planner, batch=False)
+        return GenericScheduler(state, planner, batch=False,
+                                solver=solver)
     if sched_type == JOB_TYPE_BATCH:
-        return GenericScheduler(state, planner, batch=True)
+        return GenericScheduler(state, planner, batch=True,
+                                solver=solver)
     if sched_type == JOB_TYPE_SYSTEM:
-        return SystemScheduler(state, planner)
+        return SystemScheduler(state, planner, solver=solver)
     raise ValueError(f"unknown scheduler type {sched_type!r}")

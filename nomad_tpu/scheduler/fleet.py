@@ -109,8 +109,14 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
 
     out = None
     if all_asks:
+        # fleet-mode proposed corrections: the shared world carries no
+        # stop exclusions (capacity freed by an eval's own stops lands
+        # after its plan commits — see module note); sticky probes from
+        # every fused eval overlay the resident world's usage
+        probes = [p for e in solvable for p in e.sched._sticky_probes]
         out = worker.fleet_solver().solve(nodes, all_asks, allocs_by_node,
-                                          by_dc)
+                                          by_dc, snapshot=snapshot,
+                                          proposed_delta=([], probes))
 
     for e in solvable:
         missing, ask_missing = e.prep

@@ -12,7 +12,7 @@ import copy
 import time as _time
 from typing import Dict, List, Optional, Tuple
 
-from ..solver.solve import Solver
+from ..solver.solve import LazyAllocsView, Solver
 from ..solver.tensorize import PlacementAsk
 from ..structs import (ALLOC_CLIENT_PENDING, ALLOC_DESIRED_RUN,
                        CONSTRAINT_DISTINCT_PROPERTY, EVAL_STATUS_BLOCKED,
@@ -152,6 +152,7 @@ class GenericScheduler:
         self.failed_tg_allocs = {}
         self.queued_allocs = {}
         self.followup_evals = []
+        self._sticky_probes = []
         self.plan = ev.make_plan(self.job)
 
         if not self.batch:
@@ -271,7 +272,14 @@ class GenericScheduler:
         if prep is None:
             return None
         nodes, by_dc, allocs_by_node, asks, ask_missing = prep
-        out = self.solver.solve(nodes, asks, allocs_by_node, by_dc)
+        # proposed-state corrections for the solver's resident world:
+        # this plan's eager stops and the sticky probes are the ONLY
+        # places the proposed usage differs from the store-tracked one
+        stops = [a for lst in self.plan.node_update.values()
+                 for a in lst]
+        out = self.solver.solve(
+            nodes, asks, allocs_by_node, by_dc, snapshot=snapshot,
+            proposed_delta=(stops, list(self._sticky_probes)))
         self._consume_solve(snapshot, out, nodes, allocs_by_node, missing,
                             ask_missing)
         return None
@@ -299,17 +307,24 @@ class GenericScheduler:
             if m.stop_previous and m.previous is not None:
                 self.plan.append_stopped_alloc(m.previous, m.stop_desc, "")
 
-        # proposed live allocs by node: state minus plan stops
+        # proposed live allocs by node: state minus plan stops.  With a
+        # resident solver world the eager O(cluster) walk collapses to a
+        # lazy per-node view — the solve reads usage from the
+        # delta-maintained tensors, and the host fixups only ever touch
+        # the chosen candidates' nodes
         if allocs_by_node is None:
             stopped_ids = {a.id for allocs in self.plan.node_update.values()
                            for a in allocs}
-            allocs_by_node = {}
-            for n in nodes:
-                live = [a for a in snapshot.allocs_by_node(n.id)
-                        if not a.terminal_status()
-                        and a.id not in stopped_ids]
-                if live:
-                    allocs_by_node[n.id] = live
+            if self.solver.resident_active(snapshot):
+                allocs_by_node = LazyAllocsView(snapshot, stopped_ids)
+            else:
+                allocs_by_node = {}
+                for n in nodes:
+                    live = [a for a in snapshot.allocs_by_node(n.id)
+                            if not a.terminal_status()
+                            and a.id not in stopped_ids]
+                    if live:
+                        allocs_by_node[n.id] = live
 
         # sticky-disk placements prefer their previous node (reference:
         # generic_sched.go:628 findPreferredNode)
@@ -335,13 +350,16 @@ class GenericScheduler:
         for m in batch_missing:
             by_tg.setdefault(m.tg.name, []).append(m)
 
+        # this job's proposed live allocs by node — the only slice the
+        # anti-affinity / distinct / spread seeds ever read
+        job_allocs = self._job_allocs_by_node(snapshot, allocs_by_node,
+                                              node_by_id)
         proposed_by_job_tg: Dict[str, Dict[str, int]] = {}
-        for nid, live in allocs_by_node.items():
+        for nid, live in job_allocs.items():
             for a in live:
-                if a.job_id == self.job.id:
-                    proposed_by_job_tg.setdefault(
-                        a.task_group, {}).setdefault(nid, 0)
-                    proposed_by_job_tg[a.task_group][nid] += 1
+                proposed_by_job_tg.setdefault(
+                    a.task_group, {}).setdefault(nid, 0)
+                proposed_by_job_tg[a.task_group][nid] += 1
 
         asks: List[PlacementAsk] = []
         ask_missing: List[List[_Missing]] = []
@@ -374,8 +392,8 @@ class GenericScheduler:
                 if m.reschedule and m.previous is not None)
             existing = dict(proposed_by_job_tg.get(tg_name, {}))
             blocked, prop_limits = self._distinct_state(
-                snapshot, tg, allocs_by_node, node_by_id)
-            spread_seed = self._spread_seed(tg, allocs_by_node, node_by_id)
+                snapshot, tg, job_allocs, node_by_id)
+            spread_seed = self._spread_seed(tg, job_allocs, node_by_id)
             asks.append(PlacementAsk(
                 job=self.job, tg=tg, count=len(ms),
                 penalty_nodes=penalty, existing_by_node=existing,
@@ -535,11 +553,40 @@ class GenericScheduler:
         if not fit:
             return None
         allocs_by_node.setdefault(node.id, []).append(probe)
+        # tracked separately: the solver's resident world overlays probe
+        # usage onto its delta-maintained tensors instead of re-walking
+        # allocs_by_node
+        self._sticky_probes.append(probe)
         return resources
 
-    def _distinct_state(self, snapshot, tg: TaskGroup, allocs_by_node,
+    def _job_allocs_by_node(self, snapshot, allocs_by_node, node_by_id
+                            ) -> Dict[str, List[Allocation]]:
+        """This job's proposed live allocs grouped by node — equal to
+        filtering allocs_by_node down to job_id, but O(job) via the job
+        index (plus the tracked sticky probes) when the view is lazy,
+        so the seed walks never materialize the cluster."""
+        out: Dict[str, List[Allocation]] = {}
+        if isinstance(allocs_by_node, LazyAllocsView):
+            for a in snapshot.allocs_by_job(self.job.namespace,
+                                            self.job.id):
+                if (a.terminal_status() or a.id in allocs_by_node.excluded
+                        or a.node_id not in node_by_id):
+                    continue
+                out.setdefault(a.node_id, []).append(a)
+            for p in self._sticky_probes:
+                out.setdefault(p.node_id, []).append(p)
+            return out
+        for nid, live in allocs_by_node.items():
+            lst = [a for a in live if a.job_id == self.job.id]
+            if lst:
+                out[nid] = lst
+        return out
+
+    def _distinct_state(self, snapshot, tg: TaskGroup, job_allocs,
                         node_by_id):
-        """Existing-state inputs for distinct_hosts / distinct_property."""
+        """Existing-state inputs for distinct_hosts / distinct_property.
+        `job_allocs` is this job's proposed live allocs by node
+        (_job_allocs_by_node)."""
         blocked = set()
         merged = hostfeas.merged_constraints(self.job, tg)
         has_job_distinct = any(
@@ -547,10 +594,8 @@ class GenericScheduler:
         has_distinct = has_job_distinct or any(
             c.operand == "distinct_hosts" for c in merged)
         if has_distinct:
-            for nid, live in allocs_by_node.items():
+            for nid, live in job_allocs.items():
                 for a in live:
-                    if a.job_id != self.job.id:
-                        continue
                     if has_job_distinct or a.task_group == tg.name:
                         blocked.add(nid)
                         break
@@ -567,10 +612,10 @@ class GenericScheduler:
                 except ValueError:
                     limit = 1
             counts: Dict[str, int] = {}
-            for nid, live in allocs_by_node.items():
+            for nid, live in job_allocs.items():
                 n_cnt = sum(
-                    1 for a in live if a.job_id == self.job.id
-                    and (job_scope or a.task_group == tg.name))
+                    1 for a in live
+                    if job_scope or a.task_group == tg.name)
                 if not n_cnt:
                     continue
                 node = node_by_id.get(nid)
@@ -598,17 +643,16 @@ class GenericScheduler:
                 add_prop(c, False)
         return frozenset(blocked), prop_limits
 
-    def _spread_seed(self, tg: TaskGroup, allocs_by_node, node_by_id):
+    def _spread_seed(self, tg: TaskGroup, job_allocs, node_by_id):
         seed: Dict[str, Dict[str, int]] = {}
         spreads = list(self.job.spreads) + list(tg.spreads)
         if not spreads:
             return seed
         for sp in spreads:
             counts: Dict[str, int] = {}
-            for nid, live in allocs_by_node.items():
+            for nid, live in job_allocs.items():
                 n_tg = sum(1 for a in live
-                           if a.job_id == self.job.id
-                           and a.task_group == tg.name)
+                           if a.task_group == tg.name)
                 if not n_tg:
                     continue
                 node = node_by_id.get(nid)

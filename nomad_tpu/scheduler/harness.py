@@ -22,6 +22,7 @@ class Harness:
         self.create_evals: List[Evaluation] = []
         self.reblock_evals: List[Evaluation] = []
         self.reject_plan = False
+        self.solver = None      # optional shared Solver (worker parity)
         self._lock = threading.Lock()
         self._index = self.store.latest_index() or 1000
 
@@ -45,6 +46,9 @@ class Harness:
             deployment_updates=plan.deployment_updates,
             alloc_index=index)
         self.store.upsert_plan_results(index, result, plan.job)
+        if self.solver is not None:
+            # mirror the worker's plan-apply feed into the resident world
+            self.solver.note_plan_result(plan, result)
         return result, None
 
     def update_eval(self, evaluation: Evaluation) -> None:
@@ -58,5 +62,6 @@ class Harness:
 
     # ---- driving ----
     def process(self, sched_type: str, evaluation: Evaluation):
-        sched = new_scheduler(sched_type, self.store, self)
+        sched = new_scheduler(sched_type, self.store, self,
+                              solver=self.solver)
         return sched.process(evaluation)

@@ -26,11 +26,14 @@ class Worker(threading.Thread):
         self._solver = None
 
     def fleet_solver(self):
-        """One Solver per worker: its tensorizer's computed-class memo is
-        shared across the fused batch."""
+        """One Solver per worker, store-attached: its tensorizer's
+        computed-class memo is shared across the fused batch, and its
+        resident cluster world advances by changesets (plan-apply feed
+        below + the store change log) instead of re-packing the world
+        per eval."""
         if self._solver is None:
             from ..solver.solve import Solver
-            self._solver = Solver()
+            self._solver = Solver(store=self.server.store)
         return self._solver
 
     def shutdown(self) -> None:
@@ -88,7 +91,8 @@ class Worker(threading.Thread):
                 CoreScheduler(server, server.store.snapshot()).process(ev)
                 err = None
             else:
-                sched = new_scheduler(ev.type, server.store, self)
+                sched = new_scheduler(ev.type, server.store, self,
+                                      solver=self.fleet_solver())
                 err = sched.process(ev)
         except Exception as e:
             # record the failure on the eval so a parked (delivery-limited)
@@ -126,6 +130,11 @@ class Worker(threading.Thread):
         _m.measure_since("worker.submit_plan", t0)
         if err is not None or result is None:
             return None, None
+        # feed the applied changeset into the solver's resident world:
+        # the next eval's solve starts from already-advanced tensors
+        # (the change-log sync then dedups these same writes)
+        if self._solver is not None:
+            self._solver.note_plan_result(plan, result)
         if result.refresh_index:
             # partial commit: catch up past the conflicting writes and hand
             # the scheduler a fresh snapshot to retry against

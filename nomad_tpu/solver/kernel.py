@@ -73,6 +73,44 @@ _MERGED_W_CAP = 1024
 _WIDE_W_CAP = 256
 
 
+# ---------------------------------------------------------------- delta
+# Scatter-apply kernels for the device-resident cluster state
+# (resident.apply_delta): the HBM arrays update in place — the old
+# buffer is DONATED where the backend supports it (TPU/GPU), so a delta
+# wave moves only the scattered rows, never a full [Np, ...] copy.
+# CPU ignores donation; building the jit without it avoids the
+# "donated buffers unused" warning storm in host-only runs.
+_DELTA_JITS: dict = {}
+
+
+def _delta_scatter(op: str):
+    """Lazily-built jit (backend probing at import would pay backend
+    init for every package import, including pure-host test runs)."""
+    fn = _DELTA_JITS.get(op)
+    if fn is None:
+        try:
+            donate = jax.default_backend() != "cpu"
+        except Exception:       # backend init can fail in odd sandboxes
+            donate = False
+        if op == "set":
+            def f(arr, idx, rows):
+                return arr.at[idx].set(rows)
+        else:
+            def f(arr, idx, rows):
+                return arr.at[idx].add(rows)
+        fn = jax.jit(f, donate_argnums=(0,) if donate else ())
+        _DELTA_JITS[op] = fn
+    return fn
+
+
+def delta_scatter_set(arr, idx, rows):
+    return _delta_scatter("set")(arr, idx, rows)
+
+
+def delta_scatter_add(arr, idx, rows):
+    return _delta_scatter("add")(arr, idx, rows)
+
+
 def _op_eval(vals: jnp.ndarray, op: jnp.ndarray, rank: jnp.ndarray
              ) -> jnp.ndarray:
     """Evaluate vectorizable constraint ops.

@@ -287,7 +287,9 @@ class ResidentSolver:
                  allocs_by_node: Optional[Dict[str, list]] = None,
                  gp: Optional[int] = None, kp: Optional[int] = None,
                  max_waves: int = 0, wave_mode: str = "scan",
-                 stack_commit: bool = False, pallas: str = "auto"):
+                 stack_commit: bool = False, pallas: str = "auto",
+                 delta_threshold: Optional[float] = None):
+        import os
         self.nodes = list(nodes)
         self.max_waves = max_waves        # 0 = kernel default
         self.wave_mode = wave_mode        # see kernel.py loop-shape note
@@ -299,12 +301,46 @@ class ResidentSolver:
         #: per-batch wave counts of the LAST dispatched stream (device
         #: array; fetch syncs — instrumentation consumers only)
         self.last_waves = None
+        #: delta waves touching more than this fraction of real node
+        #: slots fall back to a full repack (one contiguous re-put beats
+        #: a near-total scatter); NOMAD_TPU_DELTA_THRESHOLD overrides
+        self.delta_threshold = (
+            delta_threshold if delta_threshold is not None
+            else float(os.environ.get("NOMAD_TPU_DELTA_THRESHOLD",
+                                      "0.25")))
+        #: resident-delta observability (ISSUE 2 satellite): consumed by
+        #: wave_traffic / BENCH_DETAIL
+        self.delta_counters = {
+            "delta_applies": 0, "repack_fallbacks": 0,
+            "last_delta_ratio": 0.0,
+            "bytes_dispatched_delta": 0, "bytes_dispatched_full": 0,
+        }
+        #: bumps on every node-shape change; device-side stacked-batch
+        #: caches are keyed on it so a stale ask plane is never reused
+        self._node_epoch = 0
+        #: host bytes the LAST dispatch actually shipped (0 on a
+        #: device-cached re-dispatch)
+        self.last_dispatch_bytes = 0
+        self._probe_asks = list(probe_asks)
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
+        self.node_index = {n.id: i for i, n in enumerate(self.nodes)}
         self.gp = gp or self.template.ask_res.shape[0]
         self.kp = kp or self.template.p_ask.shape[0]
         self._drv_cache: Dict[str, np.ndarray] = {}
         self._row_cache: Dict = {}    # ask_signature -> packed spec row
+        self._eval_cache: Dict = {}       # see pack_batch_cached
+        # device-resident constants for the [G, N] ask-side arrays that
+        # are usually all-zero (fresh jobs) or at their universe default
+        # (host_ok): shipping them dense per call costs ~100MB/s-class
+        # transports far more than the solve itself
+        self._const_cache: Dict[Tuple[str, int], object] = {}
+        self._put_node_side()
+
+    def _put_node_side(self) -> None:
+        """Ship the full node-side tensors to device (initial build and
+        the repack-fallback path) and rebuild everything derived from
+        the node axis."""
         t = self.template
         self._dev_node = {
             "avail": jax.device_put(t.avail),
@@ -318,15 +354,160 @@ class ResidentSolver:
         self._dev_used = jax.device_put(t.dev_used0)
         # compact int16 result payload needs int16-expressible node ids
         self._compact = t.avail.shape[0] < 32768
-        self._eval_cache: Dict = {}       # see pack_batch_cached
-        # device-resident constants for the [G, N] ask-side arrays that
-        # are usually all-zero (fresh jobs) or at their universe default
-        # (host_ok): shipping them dense per call costs ~100MB/s-class
-        # transports far more than the solve itself
-        self._const_cache: Dict[Tuple[str, int], object] = {}
         self._default_host_ok = np.zeros((self.gp, t.avail.shape[0]),
                                          bool)
         self._default_host_ok[:, :t.n_real] = True
+        self.delta_counters["bytes_dispatched_full"] += int(
+            t.avail.nbytes + t.reserved.nbytes + t.valid.nbytes
+            + t.node_dc.nbytes + t.attr_rank.nbytes + t.dev_cap.nbytes
+            + t.used0.nbytes + t.dev_used0.nbytes)
+
+    # ------------------------------------------------- delta lifecycle
+    def apply_delta(self, delta) -> str:
+        """Apply a ClusterDelta to the device-resident cluster state.
+
+        The incremental path (returns "delta") scatters only the touched
+        rows into the HBM-resident avail/reserved/valid/attr/dev arrays
+        and the carried usage, via donate-buffer kernels — no [Np, ...]
+        re-tensorization, no full re-put.  Falls back to a full repack
+        (returns "repack") when the delta steps outside the interned
+        universe (new dc / attr value / device pattern — the
+        interning-table invalidation), overflows the padded node axis,
+        or touches more than `delta_threshold` of the real node slots.
+        """
+        from .kernel import delta_scatter_add, delta_scatter_set
+        from .tensorize import apply_node_delta_host
+        if delta.empty():
+            return "delta"
+        nd = self._tz.delta_pack(self.template, self.node_index, delta)
+        if nd is not None:
+            ratio = nd.ratio(self.template.n_real)
+            self.delta_counters["last_delta_ratio"] = round(ratio, 6)
+        if nd is None or nd.ratio(self.template.n_real) \
+                > self.delta_threshold:
+            self.repack(delta)
+            return "repack"
+        n_real_before = self.template.n_real
+        apply_node_delta_host(self.template, nd, self.nodes,
+                              self.node_index)
+        # pow2-pad the scatter payloads so steady-state delta waves
+        # (whose row counts vary wave to wave) reuse a handful of
+        # compiled scatter variants instead of retracing per shape:
+        # "set" pads by repeating row 0 (duplicate identical writes),
+        # "add" pads with zero rows at slot 0 (no-op adds)
+        def _pad(idx, rows, repeat_first):
+            M = idx.size
+            P = 8
+            while P < M:
+                P *= 2
+            if P == M:
+                return idx, rows
+            if repeat_first:
+                pad_i = np.full(P - M, idx[0], idx.dtype)
+                pads = [np.repeat(r[:1], P - M, axis=0) for r in rows]
+            else:
+                pad_i = np.zeros(P - M, idx.dtype)
+                pads = [np.zeros((P - M,) + r.shape[1:], r.dtype)
+                        for r in rows]
+            return (np.concatenate([idx, pad_i]),
+                    [np.concatenate([r, p]) for r, p in zip(rows, pads)])
+
+        if nd.touches_nodes():
+            dn = self._dev_node
+            idx, (r_avail, r_res, r_valid, r_dc, r_attr, r_dev) = _pad(
+                nd.idx, [nd.avail, nd.reserved, nd.valid,
+                         nd.node_dc.astype(np.asarray(
+                             dn["node_dc"]).dtype), nd.attr_rank,
+                         nd.dev_cap], repeat_first=True)
+            dn["avail"] = delta_scatter_set(dn["avail"], idx, r_avail)
+            dn["reserved"] = delta_scatter_set(dn["reserved"], idx,
+                                               r_res)
+            dn["valid"] = delta_scatter_set(dn["valid"], idx, r_valid)
+            dn["node_dc"] = delta_scatter_set(dn["node_dc"], idx, r_dc)
+            dn["attr_rank"] = delta_scatter_set(dn["attr_rank"], idx,
+                                                r_attr)
+            dn["dev_cap"] = delta_scatter_set(dn["dev_cap"], idx, r_dev)
+            # node-shape changes invalidate every cached host mask and
+            # packed batch (driver/volume feasibility, host_ok widths)
+            self._node_epoch += 1
+            self._row_cache.clear()
+            self._drv_cache.clear()
+            self._eval_cache.clear()
+            if self.template.n_real != n_real_before:
+                self._default_host_ok = np.zeros(
+                    (self.gp, self.template.avail.shape[0]), bool)
+                self._default_host_ok[:, :self.template.n_real] = True
+                self._const_cache = {
+                    k: v for k, v in self._const_cache.items()
+                    if k[0] != "host_ok"}
+        if nd.u_idx.size:
+            u_idx, (u_res, u_dev) = _pad(nd.u_idx, [nd.u_res, nd.u_dev],
+                                         repeat_first=False)
+            self._used = delta_scatter_add(self._used, u_idx, u_res)
+            self._dev_used = delta_scatter_add(self._dev_used, u_idx,
+                                               u_dev)
+        self.delta_counters["delta_applies"] += 1
+        self.delta_counters["bytes_dispatched_delta"] += nd.nbytes()
+        return "delta"
+
+    def repack(self, delta=None) -> None:
+        """Full-repack fallback: rebuild the node-side template from the
+        current node set (delta applied host-side first, removed nodes
+        compacted away) and re-put it whole.  Carried usage transfers by
+        node id; usage deltas in `delta` are folded in host-side."""
+        from .tensorize import alloc_usage_vector
+        used, dev_used = self.usage()        # one sync
+        old_ids = list(self.template.node_ids)
+        by_id = {n.id: n for n in self.nodes}
+        removed = set()
+        if delta is not None:
+            for n in delta.upsert_nodes:
+                by_id[n.id] = n
+            removed = set(delta.remove_node_ids)
+        # keep join order, compact tombstones away; an upsert in the
+        # triggering delta revives a previously-removed slot
+        upserted = ({n.id for n in delta.upsert_nodes}
+                    if delta is not None else set())
+        new_nodes = []
+        seen = set()
+        for i, nid in enumerate(old_ids):
+            if nid in removed:
+                continue
+            if not self.template.valid[i] and nid not in upserted:
+                continue              # old tombstone stays dead
+            new_nodes.append(by_id[nid])
+            seen.add(nid)
+        if delta is not None:
+            for n in delta.upsert_nodes:
+                if n.id not in seen and n.id not in removed:
+                    new_nodes.append(n)
+                    seen.add(n.id)
+        self.nodes = new_nodes
+        self.template = self._tz.pack(self.nodes, self._probe_asks)
+        self.node_index = {n.id: i for i, n in enumerate(self.nodes)}
+        # carry usage across by node id (slots moved in the compaction)
+        t = self.template
+        for i, nid in enumerate(old_ids):
+            j = self.node_index.get(nid)
+            if j is not None:
+                t.used0[j] = used[i]
+                t.dev_used0[j] = dev_used[i]
+        if delta is not None:
+            for nid, alloc in delta.place:
+                j = self.node_index.get(nid)
+                if j is not None:
+                    t.used0[j] += alloc_usage_vector(alloc)
+            for nid, alloc in delta.stop:
+                j = self.node_index.get(nid)
+                if j is not None:
+                    t.used0[j] -= alloc_usage_vector(alloc)
+        self._node_epoch += 1
+        self._row_cache.clear()
+        self._drv_cache.clear()
+        self._eval_cache.clear()
+        self._const_cache.clear()
+        self.delta_counters["repack_fallbacks"] += 1
+        self._put_node_side()
 
     def pack_batch(self, asks: Sequence[PlacementAsk],
                    job_keys: Optional[set] = None
@@ -425,6 +606,7 @@ class ResidentSolver:
         their transport round trips overlap — JAX dispatch is async, and
         the carried usage updates device-side immediately."""
         self._check_stream_jobs(batches)
+        self._check_batch_axis(batches)
         stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
@@ -447,53 +629,82 @@ class ResidentSolver:
                                           np.ndarray, np.ndarray]:
         return self._unpack(out)
 
-    def solve_stream_pipelined(self, chunks, seeds=None, pack=None
+    def solve_stream_pipelined(self, chunks, seeds=None, pack=None,
+                               deltas=None
                                ) -> Tuple[np.ndarray, np.ndarray,
                                           np.ndarray, np.ndarray]:
-        """Double-buffered pack→dispatch overlap: pack chunk b+1 on the
-        host WHILE chunk b's device call is in flight.  JAX dispatch is
+        """True double-buffered wave pipeline.
+
+        Every wave runs three overlapped stages: the DEVICE applies wave
+        b's usage-commit delta (scatter into the resident state) and
+        solves wave b, while the HOST packs wave b+1 — every dispatch is
         async and the carried usage chains the calls on device, so each
-        chunk's host-side packing rides entirely under the previous
-        chunks' solve; ONE concatenated fetch then pays the transport
-        round trip once for the whole stream (the fused-call schedule
-        pays the same single round trip but serializes ALL packing
-        before the first wave can start).
+        wave's host-side packing rides entirely under the previous
+        wave's delta-apply + solve; ONE concatenated fetch then pays the
+        transport round trip once for the whole stream (the fused-call
+        schedule pays the same single round trip but serializes ALL
+        packing before the first wave can start).
 
         `chunks`: sequence of PackedBatch, or of ask-lists packed via
-        `pack` (default pack_batch_cached).  Returns the solve_stream
-        tuple (choice [B,K,TOP_K], ok, score, status); per-phase timings
-        land in self.last_pipeline_stats and per-call wave counts in
+        `pack` (default pack_batch_cached).  `deltas`: optional per-wave
+        ClusterDelta (or None entries) applied through apply_delta
+        BEFORE that wave's solve — the plan-apply feedback path; a delta
+        that forces a full repack is still honored, it just pays the
+        re-put.  Returns the solve_stream tuple (choice [B,K,TOP_K], ok,
+        score, status); per-phase timings land in
+        self.last_pipeline_stats (incl. delta_apply_s and the bytes
+        each dispatch actually shipped) and per-call wave counts in
         self.last_waves (list of device scalars).
         """
         import time
+        chunks = list(chunks)
+        if not chunks:
+            raise ValueError("solve_stream_pipelined needs >= 1 chunk")
         outs, waves = [], []
-        pack_s = dispatch_s = 0.0
-        for b, chunk in enumerate(chunks):
-            t0 = time.perf_counter()
+        pack_s = dispatch_s = delta_s = 0.0
+        bytes_shipped = 0
+
+        def _pack(chunk):
             if isinstance(chunk, PackedBatch):
-                pb = chunk
-            else:
-                pb = (pack or self.pack_batch_cached)(chunk)
+                return chunk
+            pb = (pack or self.pack_batch_cached)(chunk)
             if pb is None:
                 raise ValueError(
                     "pipelined chunk fell outside the resident universe")
-            t1 = time.perf_counter()
+            return pb
+
+        t0 = time.perf_counter()
+        pb_next = _pack(chunks[0])
+        pack_s += time.perf_counter() - t0
+        for b in range(len(chunks)):
+            pb = pb_next
+            if deltas is not None and b < len(deltas) \
+                    and deltas[b] is not None:
+                t0 = time.perf_counter()
+                self.apply_delta(deltas[b])
+                delta_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
             outs.append(self.solve_stream_async(
                 [pb], seeds=None if seeds is None else [seeds[b]]))
             waves.append(self.last_waves)
-            t2 = time.perf_counter()
-            pack_s += t1 - t0
-            dispatch_s += t2 - t1
+            bytes_shipped += self.last_dispatch_bytes
+            t1 = time.perf_counter()
+            dispatch_s += t1 - t0
+            if b + 1 < len(chunks):
+                # host packs wave b+1 while the device is still applying
+                # wave b's delta and solving wave b (async dispatches)
+                pb_next = _pack(chunks[b + 1])
+                pack_s += time.perf_counter() - t1
         t3 = time.perf_counter()
-        if not outs:
-            raise ValueError("solve_stream_pipelined needs >= 1 chunk")
         packed = np.asarray(outs[0] if len(outs) == 1
                             else self._concat_jit(*outs))
         fetch_s = time.perf_counter() - t3
         self.last_waves = waves
         self.last_pipeline_stats = {
             "pack_s": pack_s, "dispatch_s": dispatch_s,
-            "fetch_s": fetch_s, "n_dispatches": len(outs)}
+            "delta_apply_s": delta_s,
+            "fetch_s": fetch_s, "n_dispatches": len(outs),
+            "bytes_dispatched": bytes_shipped}
         return self._unpack(packed)
 
     @functools.cached_property
@@ -542,7 +753,10 @@ class ResidentSolver:
             passes = 1
         return {"mode": mode, "tile": _pk.pick_tile(Np, Gp),
                 "bytes_per_wave": int(bytes_per_wave),
-                "fused_pass_count": passes}
+                "fused_pass_count": passes,
+                # resident-delta traffic counters (ISSUE 2): how much
+                # node-state each lifecycle path actually dispatched
+                "delta": dict(self.delta_counters)}
 
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:
@@ -594,9 +808,22 @@ class ResidentSolver:
         every batch carries the default value (all-zero coll0 / penalty
         / a_host, universe-default host_ok) — the common fresh-job case.
         A host-side compare costs milliseconds; shipping the dense zeros
-        costs hundreds on tunneled transports."""
+        costs hundreds on tunneled transports.
+
+        Single-batch dispatches (the pipelined steady-state schedule)
+        additionally cache the fully device-put stacked dict ON the
+        PackedBatch, keyed by the node epoch: a re-dispatched batch —
+        the blocked-eval retry / drain re-eval / same-jobs steady state
+        — ships ZERO ask bytes.  last_dispatch_bytes records what each
+        call actually moved (the delta-vs-full traffic counters)."""
         B = len(batches)
+        if B == 1:
+            cached = batches[0].__dict__.get("_dev_stacked")
+            if cached is not None and cached[0] == self._node_epoch:
+                self.last_dispatch_bytes = 0
+                return cached[1]
         stacked = {}
+        shipped = 0
         t = self.template
         # identity fast path: repack_asks hands out one shared read-only
         # plane per default [G, N] argument — recognizing it skips both
@@ -627,8 +854,26 @@ class ResidentSolver:
                         (B,) + self._default_host_ok.shape).copy())
                 stacked[name] = self._const_cache[key]
                 continue
-            stacked[name] = np.stack(mats)
+            arr = np.stack(mats)
+            shipped += arr.nbytes
+            stacked[name] = arr
+        self.last_dispatch_bytes = shipped
+        if B == 1:
+            dev = {k: (jax.device_put(v) if isinstance(v, np.ndarray)
+                       else v) for k, v in stacked.items()}
+            batches[0].__dict__["_dev_stacked"] = (self._node_epoch, dev)
+            return dev
         return stacked
+
+    def _check_batch_axis(self, batches: Sequence[PackedBatch]) -> None:
+        """A full repack can change the padded node axis; batches packed
+        before it carry [G, Np_old] planes and must be re-packed."""
+        Np = self.template.avail.shape[0]
+        for pb in batches:
+            if pb.host_ok.shape[1] != Np:
+                raise ValueError(
+                    "PackedBatch predates a full repack (node axis "
+                    f"{pb.host_ok.shape[1]} != {Np}); re-pack its asks")
 
     @staticmethod
     def _check_stream_jobs(batches: Sequence[PackedBatch]) -> None:
@@ -659,6 +904,7 @@ class ResidentSolver:
         (batches don't see each other's scoring state at all, only the
         revalidation)."""
         self._check_stream_jobs(batches)
+        self._check_batch_axis(batches)
         stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seeds = np.arange(1, len(batches) + 1, dtype=np.int32)

@@ -101,6 +101,26 @@ def alloc_usage_vector(alloc) -> np.ndarray:
     return v
 
 
+def alloc_device_usage(dev_pattern_ids, D: int, alloc
+                       ) -> Optional[np.ndarray]:
+    """[D] device-instance usage row for one alloc against a template's
+    interned device patterns, or None when it uses none of them."""
+    ar = getattr(alloc, "allocated_resources", None)
+    if not dev_pattern_ids or ar is None:
+        return None
+    row = None
+    from ..structs.resources import device_pattern_matches
+    for tr in ar.tasks.values():
+        for ad in tr.devices:
+            for key, dix in dev_pattern_ids.items():
+                if device_pattern_matches(key,
+                                          (ad.vendor, ad.type, ad.name)):
+                    if row is None:
+                        row = np.zeros(D, np.float32)
+                    row[dix] += len(ad.device_ids)
+    return row
+
+
 def _pad_pow2(n: int, floor: int = 8) -> int:
     p = floor
     while p < n:
@@ -196,6 +216,93 @@ class PackedBatch:
     dc_ids: Dict[str, int] = field(default_factory=dict)
     dev_pattern_ids: Dict[Tuple[str, str, str], int] = field(
         default_factory=dict)
+
+
+@dataclass
+class ClusterDelta:
+    """Changeset between two cluster states (the plan-apply feedback
+    unit): nodes joined/updated, nodes drained/removed, allocs placed,
+    allocs stopped.  The incremental tensorize path (delta_pack) turns
+    one of these into small scatter arrays instead of a full [N, R]/[A]
+    re-tensorization."""
+    upsert_nodes: List = field(default_factory=list)   # joined or changed
+    remove_node_ids: List[str] = field(default_factory=list)
+    place: List[Tuple[str, object]] = field(default_factory=list)
+    # ^ (node_id, alloc) usage adds
+    stop: List[Tuple[str, object]] = field(default_factory=list)
+    # ^ (node_id, alloc) usage subtracts
+
+    def empty(self) -> bool:
+        return not (self.upsert_nodes or self.remove_node_ids
+                    or self.place or self.stop)
+
+    def size(self) -> int:
+        return (len(self.upsert_nodes) + len(self.remove_node_ids)
+                + len(self.place) + len(self.stop))
+
+
+@dataclass
+class NodeDelta:
+    """Scatter-update arrays produced by Tensorizer.delta_pack: the
+    node-side rows a ClusterDelta touches, ready for an `.at[idx].set`
+    / `.at[idx].add` device apply (resident.apply_delta) or an in-place
+    numpy apply (apply_node_delta_host)."""
+    idx: np.ndarray          # [M] i32 touched node slots (upsert+remove)
+    avail: np.ndarray        # [M, R]
+    reserved: np.ndarray     # [M, R]
+    valid: np.ndarray        # [M] bool
+    node_class: np.ndarray   # [M] i32
+    node_dc: np.ndarray      # [M] i32
+    attr_rank: np.ndarray    # [M, A] template dtype
+    dev_cap: np.ndarray      # [M, D]
+    u_idx: np.ndarray        # [Mu] i32 usage-touched slots (deduped)
+    u_res: np.ndarray        # [Mu, R] signed usage adds
+    u_dev: np.ndarray        # [Mu, D] signed device-usage adds
+    new_nodes: List = field(default_factory=list)  # joins, slot order
+    n_real_new: int = 0
+
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in (
+            self.idx, self.avail, self.reserved, self.valid,
+            self.node_class, self.node_dc, self.attr_rank, self.dev_cap,
+            self.u_idx, self.u_res, self.u_dev))
+
+    def touches_nodes(self) -> bool:
+        return self.idx.size > 0
+
+    def ratio(self, n_real: int) -> float:
+        """Fraction of real node slots this delta touches — the
+        repack-fallback threshold input (scattering most of the array
+        is slower than one contiguous re-put)."""
+        touched = len(set(self.idx.tolist()) | set(self.u_idx.tolist()))
+        return touched / max(n_real, 1)
+
+
+def apply_node_delta_host(template: PackedBatch, nd: NodeDelta,
+                          nodes: List[Node],
+                          node_index: Dict[str, int]) -> None:
+    """Apply a NodeDelta to the numpy template in place (the host twin
+    of the device scatter apply), growing nodes/node_ids/n_real for
+    joins.  Removed nodes stay as valid=False tombstones so every
+    surviving slot keeps its index (and therefore its tie-break order
+    and its carried usage row)."""
+    for n in nd.new_nodes:
+        node_index[n.id] = len(nodes)
+        nodes.append(n)
+        template.node_ids.append(n.id)
+    template.n_real = nd.n_real_new
+    if nd.idx.size:
+        template.avail[nd.idx] = nd.avail
+        template.reserved[nd.idx] = nd.reserved
+        template.valid[nd.idx] = nd.valid
+        template.node_class[nd.idx] = nd.node_class
+        template.node_dc[nd.idx] = nd.node_dc
+        template.attr_rank[nd.idx] = nd.attr_rank
+        template.dev_cap[nd.idx] = nd.dev_cap
+    if nd.u_idx.size:
+        # u_idx rows are pre-aggregated per slot (no duplicate indices)
+        template.used0[nd.u_idx] += nd.u_res
+        template.dev_used0[nd.u_idx] += nd.u_dev
 
 
 class Tensorizer:
@@ -563,6 +670,153 @@ class Tensorizer:
             dc_ids=dict(dc_interner.items()),
             dev_pattern_ids=dict(dev_pattern_ix),
         )
+
+    def delta_pack(self, template: PackedBatch,
+                   node_index: Dict[str, int],
+                   delta: ClusterDelta) -> Optional[NodeDelta]:
+        """Incremental tensorize: turn a ClusterDelta into scatter-update
+        arrays against `template` instead of a full re-pack.
+
+        Returns None whenever the delta cannot be expressed inside the
+        template's interned universe — a joined/changed node carrying an
+        attribute value, datacenter or device pattern the rank tables
+        have never seen, or more joins than the padded node axis holds —
+        in which case the caller must fall back to a full repack (the
+        interning-table invalidation path).  Computed classes are the
+        one table that CAN grow in place: class ids live in an unbounded
+        int column, not a sized axis.
+
+        u_idx/u_res/u_dev are pre-aggregated per node slot so both the
+        numpy `+=` apply and the device `.at[].add` see each slot once.
+        """
+        R = template.avail.shape[1]
+        A = template.attr_rank.shape[1]
+        D = template.dev_cap.shape[1]
+        Np = template.avail.shape[0]
+        idt = template.attr_rank.dtype
+        n_real = template.n_real
+
+        new_nodes: List[Node] = []
+        slot_of: Dict[str, int] = {}
+
+        def slot_for(nid: str) -> Optional[int]:
+            s = node_index.get(nid)
+            if s is not None:
+                return s
+            return slot_of.get(nid)
+
+        # ---- node upserts (joins get tail slots in the padding) ----
+        rows: List[Tuple[int, Node]] = []
+        for n in delta.upsert_nodes:
+            s = slot_for(n.id)
+            if s is None:
+                s = n_real + len(new_nodes)
+                if s >= Np:
+                    return None                 # node axis overflow
+                slot_of[n.id] = s
+                new_nodes.append(n)
+            rows.append((s, n))
+
+        M = len(rows) + len(delta.remove_node_ids)
+        idx = np.zeros(M, np.int32)
+        avail = np.zeros((M, R), np.float32)
+        reserved = np.zeros((M, R), np.float32)
+        valid = np.zeros(M, bool)
+        node_class = np.zeros(M, np.int32)
+        node_dc = np.zeros(M, np.int32)
+        attr_rank = np.full((M, A), -1, idt)
+        dev_cap = np.zeros((M, D), np.float32)
+
+        for m, (s, n) in enumerate(rows):
+            cap, res = node_capacity_vectors(n)
+            idx[m] = s
+            avail[m] = cap - res
+            reserved[m] = res
+            valid[m] = n.ready() if hasattr(n, "ready") else True
+            did = template.dc_ids.get(n.datacenter)
+            if did is None:
+                return None                     # dc axis is sized
+            node_dc[m] = did
+            cls = n.computed_class or n.compute_class()
+            cid = template.class_ids.get(cls)
+            if cid is None:                     # class ids are unbounded
+                cid = (max(template.class_ids.values()) + 1
+                       if template.class_ids else 0)
+                template.class_ids[cls] = cid
+            node_class[m] = cid
+            for col, t in enumerate(template.attr_targets):
+                v, ok = resolve_node_target(n, t)
+                if not ok:
+                    continue
+                r = template.rank_columns[col].rank(str(v))
+                if r < 0:
+                    return None                 # unseen attr value
+                attr_rank[m, col] = r
+            if template.dev_pattern_ids:
+                from ..structs.resources import device_pattern_matches
+                for dev in n.node_resources.devices:
+                    healthy = sum(1 for i in dev.instances if i.healthy)
+                    for key, dix in template.dev_pattern_ids.items():
+                        if device_pattern_matches(key, dev.id_tuple()):
+                            dev_cap[m, dix] += healthy
+
+        # ---- removes: valid=False tombstones keeping current rows ----
+        for k, nid in enumerate(delta.remove_node_ids):
+            s = slot_for(nid)
+            if s is None:
+                return None                     # unknown node id
+            m = len(rows) + k
+            idx[m] = s
+            avail[m] = template.avail[s]
+            reserved[m] = template.reserved[s]
+            valid[m] = False
+            node_class[m] = template.node_class[s]
+            node_dc[m] = template.node_dc[s]
+            attr_rank[m] = template.attr_rank[s]
+            dev_cap[m] = template.dev_cap[s]
+
+        # ---- usage deltas (allocs placed / stopped), per-slot sums ----
+        u_res_by: Dict[int, np.ndarray] = {}
+        u_dev_by: Dict[int, np.ndarray] = {}
+
+        def charge(nid: str, alloc, sign: float) -> bool:
+            s = slot_for(nid)
+            if s is None:
+                return False
+            vec = u_res_by.get(s)
+            if vec is None:
+                vec = u_res_by[s] = np.zeros(R, np.float32)
+            vec += sign * alloc_usage_vector(alloc)
+            drow = alloc_device_usage(template.dev_pattern_ids, D, alloc)
+            if drow is not None:
+                dv = u_dev_by.get(s)
+                if dv is None:
+                    dv = u_dev_by[s] = np.zeros(D, np.float32)
+                dv += sign * drow
+            return True
+
+        for nid, alloc in delta.place:
+            if not charge(nid, alloc, 1.0):
+                return None
+        for nid, alloc in delta.stop:
+            if not charge(nid, alloc, -1.0):
+                return None
+
+        slots = sorted(set(u_res_by) | set(u_dev_by))
+        u_idx = np.asarray(slots, np.int32)
+        u_res = np.zeros((len(slots), R), np.float32)
+        u_dev = np.zeros((len(slots), D), np.float32)
+        for i, s in enumerate(slots):
+            if s in u_res_by:
+                u_res[i] = u_res_by[s]
+            if s in u_dev_by:
+                u_dev[i] = u_dev_by[s]
+
+        return NodeDelta(
+            idx=idx, avail=avail, reserved=reserved, valid=valid,
+            node_class=node_class, node_dc=node_dc, attr_rank=attr_rank,
+            dev_cap=dev_cap, u_idx=u_idx, u_res=u_res, u_dev=u_dev,
+            new_nodes=new_nodes, n_real_new=n_real + len(new_nodes))
 
     @staticmethod
     def ask_signature(ask: PlacementAsk):

@@ -198,6 +198,45 @@ class StateSnapshot:
         return self._ix.get(table, 0)
 
 
+class ChangeLog:
+    """Bounded append-only log of cluster-state-relevant writes (node
+    and alloc table mutations), keyed by raft index.  The solver's
+    device-resident cluster state (solver/solve.py ResidentWorld) pulls
+    `since(last, snapshot_index)` to build exact incremental deltas
+    instead of re-walking the whole world per eval; a consumer that
+    fell behind the ring gets None and must full-repack.
+
+    Appends are monotonically non-decreasing in index (raft apply
+    order), so `since` is a pair of bisects, not a scan."""
+
+    __slots__ = ("cap", "_entries", "_indexes", "floor")
+
+    def __init__(self, cap: int = 131072):
+        self.cap = cap
+        self._entries: List[tuple] = []     # (index, kind, key)
+        self._indexes: List[int] = []       # parallel, for bisect
+        self.floor = 0              # highest index ever evicted
+
+    def append(self, index: int, kind: str, key) -> None:
+        self._entries.append((index, kind, key))
+        self._indexes.append(index)
+        if len(self._entries) > 2 * self.cap:
+            cut = len(self._entries) - self.cap
+            self.floor = max(self.floor, self._indexes[cut - 1])
+            del self._entries[:cut]
+            del self._indexes[:cut]
+
+    def since(self, min_index: int, max_index: int):
+        """Entries with min_index < index <= max_index, or None when the
+        window reaches below the ring's floor (consumer must rebuild)."""
+        import bisect
+        if min_index < self.floor:
+            return None
+        lo = bisect.bisect_right(self._indexes, min_index)
+        hi = bisect.bisect_right(self._indexes, max_index)
+        return self._entries[lo:hi]
+
+
 class StateStore(StateSnapshot):
     """The live, writable store. Reads are inherited from StateSnapshot."""
 
@@ -208,6 +247,13 @@ class StateStore(StateSnapshot):
         super().__init__(tables, {}, 0)
         self._lock = threading.RLock()
         self._watch = threading.Condition(self._lock)
+        self.changelog = ChangeLog()
+
+    def changes_since(self, min_index: int, max_index: int):
+        """Node/alloc change entries in (min_index, max_index], or None
+        if the log was truncated past min_index (see ChangeLog)."""
+        with self._lock:
+            return self.changelog.since(min_index, max_index)
 
     # -- snapshot & watch --
     def snapshot(self) -> StateSnapshot:
@@ -268,11 +314,13 @@ class StateStore(StateSnapshot):
             if not node.computed_class:
                 node.compute_class()
             self._t["nodes"][node.id] = node
+            self.changelog.append(index, "node", node.id)
             self._bump("nodes", index)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             self._t["nodes"].pop(node_id, None)
+            self.changelog.append(index, "node", node_id)
             self._bump("nodes", index)
 
     def update_node_status(self, index: int, node_id: str, status: str,
@@ -287,6 +335,7 @@ class StateStore(StateSnapshot):
             n2.status_updated_at = updated_at
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
+            self.changelog.append(index, "node", node_id)
             self._bump("nodes", index)
 
     def update_node_eligibility(self, index: int, node_id: str,
@@ -300,6 +349,7 @@ class StateStore(StateSnapshot):
             n2.scheduling_eligibility = eligibility
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
+            self.changelog.append(index, "node", node_id)
             self._bump("nodes", index)
 
     def update_node_drain(self, index: int, node_id: str, drain_strategy,
@@ -318,6 +368,7 @@ class StateStore(StateSnapshot):
                 n2.scheduling_eligibility = NODE_SCHED_ELIGIBLE
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
+            self.changelog.append(index, "node", node_id)
             self._bump("nodes", index)
 
     # -- jobs --
@@ -516,6 +567,7 @@ class StateStore(StateSnapshot):
         self._update_deployment_with_alloc_locked(index, a, existing)
         self._update_summary_with_alloc_locked(index, a, existing)
         self._t["allocs"][a.id] = a
+        self.changelog.append(index, "alloc", a.id)
         self._t["_allocs_by_node"].setdefault(a.node_id, set()).add(a.id)
         self._t["_allocs_by_job"].setdefault(
             (a.namespace, a.job_id), set()).add(a.id)
@@ -602,6 +654,7 @@ class StateStore(StateSnapshot):
         a = self._t["allocs"].pop(alloc_id, None)
         if a is None:
             return
+        self.changelog.append(index or self.index, "alloc", alloc_id)
         s = self._t["_allocs_by_node"].get(a.node_id)
         if s:
             s.discard(alloc_id)
@@ -639,6 +692,7 @@ class StateStore(StateSnapshot):
                     # (reference: csi_hook postrun -> Volume.Unpublish)
                     self._release_csi_claims_locked(index, a.id)
                 self._t["allocs"][a.id] = a
+                self.changelog.append(index, "alloc", a.id)
                 self._sync_services_locked(index, a)
             for key in {(u.namespace, u.job_id) for u in updates}:
                 self._refresh_job_status(index, *key)

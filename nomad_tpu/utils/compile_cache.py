@@ -1,0 +1,49 @@
+"""Opt-in persistent XLA compilation cache.
+
+A cold solver start pays seconds of XLA compiles (bench startup_cold_s
+~3.4 s) that are byte-identical across restarts of the same binary on
+the same topology.  Pointing JAX's persistent compilation cache at a
+durable directory makes warm restarts skip them — the failover-relevant
+cost for a scheduler that must resume placing within a heartbeat.
+
+Opt-in via the NOMAD_TPU_COMPILE_CACHE env var or the agent config's
+server.compile_cache_dir (cli/config.py); callers may also pass an
+explicit directory (bench.py does).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "NOMAD_TPU_COMPILE_CACHE"
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None
+                         ) -> Optional[str]:
+    """Enable JAX's persistent compilation cache at `cache_dir` (or
+    $NOMAD_TPU_COMPILE_CACHE).  Returns the directory in effect, or
+    None when the knob is unset (no-op).  Idempotent."""
+    global _enabled_dir
+    cache_dir = cache_dir or os.environ.get(ENV_VAR, "")
+    if not cache_dir:
+        return _enabled_dir
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # sub-second compiles aren't worth the disk round trip
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _enabled_dir = cache_dir
+    return _enabled_dir
+
+
+def cache_entries(cache_dir: Optional[str] = None) -> int:
+    """Number of compiled programs persisted in the cache directory —
+    diffing before/after a startup gives the MISS count for the bench
+    report (entries that were already there were warm hits)."""
+    cache_dir = cache_dir or _enabled_dir
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    return sum(1 for e in os.scandir(cache_dir) if e.is_file())

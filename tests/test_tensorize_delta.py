@@ -1,0 +1,288 @@
+"""Incremental tensorize (ClusterDelta / delta_pack / apply_delta) must
+be placement-identical to a from-scratch repack.
+
+Property: random interleavings of place / stop / node-drain / node-join
+/ node-update deltas applied incrementally to a ResidentSolver give
+bit-identical results — same chosen NODE (compared by node id: the
+incremental state keeps valid=False tombstones so slot indices shift
+against a compacted from-scratch pack, but tie-break ORDER of surviving
+nodes is preserved), same score bits, same status — as packing the
+current cluster from scratch and solving the same batch.  Checked
+across pallas modes off / score / topk (interpreter mode on CPU).
+"""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.solver.resident import ResidentSolver
+from nomad_tpu.solver.tensorize import (ClusterDelta, PlacementAsk,
+                                        Tensorizer, alloc_usage_vector)
+
+
+def make_node(i, cpu=4000):
+    nd = mock.node(datacenter=f"dc{i % 2}")
+    nd.attributes["rack"] = f"r{i % 4}"
+    nd.node_resources.cpu = cpu
+    nd.node_resources.memory_mb = 16384
+    nd.node_resources.disk_mb = 100_000
+    nd.compute_class()
+    return nd
+
+
+def make_ask(count=3, cpu=500, rack=None, spread=False):
+    job = mock.job()
+    job.datacenters = ["dc0", "dc1"]
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.cpu = cpu
+    if rack:
+        from nomad_tpu.structs import Constraint
+        job.constraints = [Constraint("${attr.rack}", rack, "!=")]
+    if spread:
+        from nomad_tpu.structs import Spread
+        job.spreads = [Spread(attribute="${node.datacenter}",
+                              weight=100)]
+    return PlacementAsk(job=job, tg=tg, count=count)
+
+
+def make_alloc(cpu=300, mem=256):
+    a = mock.alloc()
+    tr = a.allocated_resources.tasks["web"]
+    tr.cpu = cpu
+    tr.memory_mb = mem
+    tr.networks = []
+    a.allocated_resources.shared.networks = []
+    a.allocated_resources.shared.disk_mb = 100
+    return a
+
+
+def _mirror_used(rs, live):
+    """[Np, R] usage tensor from the tracked live-alloc map, in the
+    incremental solver's slot order."""
+    used = np.zeros_like(rs.template.used0)
+    for aid, (nid, alloc) in live.items():
+        used[rs.node_index[nid]] += alloc_usage_vector(alloc)
+    return used
+
+
+def _solve_by_node_id(solver, pb, nodes_for_ids):
+    choice, ok, score, status = solver.solve_stream([pb])
+    n = pb.n_place
+    ids = []
+    for p in range(n):
+        ids.append(solver.template.node_ids[int(choice[0, p, 0])]
+                   if ok[0, p, 0] else None)
+    return ids, score[0, :n, 0].copy(), status[0, :n].copy()
+
+
+@pytest.mark.parametrize("pallas", ["off", "score", "topk"])
+def test_random_delta_interleavings_match_full_repack(pallas):
+    rng = np.random.default_rng(7)
+    probe = [make_ask(rack="r3", spread=True), make_ask()]
+
+    nodes = [make_node(i) for i in range(10)]
+    rs = ResidentSolver(nodes, probe, gp=4, kp=16, pallas=pallas)
+
+    live = {}                    # alloc_id -> (node_id, alloc)
+    cluster = {n.id: n for n in nodes}      # current (joined) nodes
+    join_seq = [n.id for n in nodes]        # join order, compacted
+    next_i = len(nodes)
+
+    for round_ in range(6):
+        # ---- one random delta ----
+        delta = ClusterDelta()
+        for _ in range(int(rng.integers(1, 4))):
+            op = rng.choice(["place", "stop", "drain", "join", "update"])
+            if op == "place" and cluster:
+                nid = join_seq[int(rng.integers(len(join_seq)))]
+                a = make_alloc(cpu=int(rng.integers(100, 400)))
+                delta.place.append((nid, a))
+                live[a.id] = (nid, a)
+            elif op == "stop" and live:
+                aid = list(live)[int(rng.integers(len(live)))]
+                nid, a = live.pop(aid)
+                delta.stop.append((nid, a))
+            elif op == "drain" and len(join_seq) > 4:
+                nid = join_seq.pop(int(rng.integers(len(join_seq))))
+                cluster.pop(nid)
+                delta.remove_node_ids.append(nid)
+                for aid in [aid for aid, (n2, _) in live.items()
+                            if n2 == nid]:
+                    del live[aid]   # drained node's allocs stop with it
+            elif op == "join":
+                n = make_node(next_i)
+                next_i += 1
+                delta.upsert_nodes.append(n)
+                cluster[n.id] = n
+                join_seq.append(n.id)
+            elif op == "update" and cluster:
+                nid = join_seq[int(rng.integers(len(join_seq)))]
+                import copy
+                n2 = copy.copy(cluster[nid])
+                n2.node_resources = copy.deepcopy(n2.node_resources)
+                n2.node_resources.cpu += 1000
+                delta.upsert_nodes.append(n2)
+                cluster[nid] = n2
+        # a drain can orphan placed allocs recorded in the delta; usage
+        # on a tombstoned slot is harmless (valid=False gates it), but
+        # keep the mirror consistent by re-adding only tracked allocs
+        rs.apply_delta(delta)
+        # the carried usage must reflect ONLY the delta-tracked allocs
+        # for the comparison (solve commits would otherwise diverge the
+        # two sides): reset both to the mirrored baseline
+        rs.reset_usage(used0=_mirror_used(rs, live))
+
+        # ---- compare vs from-scratch pack of the current cluster ----
+        cur_nodes = [cluster[nid] for nid in join_seq]
+        ref = ResidentSolver(cur_nodes, probe, gp=4, kp=16,
+                             pallas=pallas)
+        ref_used = np.zeros_like(ref.template.used0)
+        for aid, (nid, alloc) in live.items():
+            ref_used[ref.node_index[nid]] += alloc_usage_vector(alloc)
+        ref.reset_usage(used0=ref_used)
+
+        asks = [make_ask(count=3, cpu=int(400 + 100 * (round_ % 3)),
+                         spread=bool(round_ % 2))]
+        pb_inc = rs.pack_batch(asks)
+        pb_ref = ref.pack_batch(asks)
+        assert pb_inc is not None and pb_ref is not None
+        ids_inc, sc_inc, st_inc = _solve_by_node_id(rs, pb_inc, None)
+        ids_ref, sc_ref, st_ref = _solve_by_node_id(ref, pb_ref, None)
+        assert ids_inc == ids_ref, f"round {round_}: node choice diverged"
+        np.testing.assert_array_equal(st_inc, st_ref)
+        np.testing.assert_array_equal(sc_inc, sc_ref)
+        # solve committed usage on both sides — reset to mirrors again
+        rs.reset_usage(used0=_mirror_used(rs, live))
+
+
+def test_delta_pack_scatter_arrays_and_fallbacks():
+    tz = Tensorizer()
+    nodes = [make_node(i) for i in range(6)]
+    probe = [make_ask(rack="r3")]
+    rs = ResidentSolver(nodes, probe, gp=2, kp=8, pallas="off")
+    template, node_index = rs.template, rs.node_index
+
+    # usage-only delta: no node rows, aggregated per slot
+    a1, a2 = make_alloc(cpu=100), make_alloc(cpu=200)
+    nd = tz.delta_pack(template, node_index, ClusterDelta(
+        place=[(nodes[1].id, a1), (nodes[1].id, a2)]))
+    assert nd is not None and not nd.touches_nodes()
+    assert nd.u_idx.tolist() == [1]
+    assert nd.u_res[0, 0] == 300.0
+
+    # join within the universe gets a tail slot
+    nd = tz.delta_pack(template, node_index, ClusterDelta(
+        upsert_nodes=[make_node(6)]))
+    assert nd is not None and nd.n_real_new == 7
+    assert nd.idx.tolist() == [6] and bool(nd.valid[0])
+
+    # unseen datacenter -> interning invalidation -> fallback
+    weird = make_node(7)
+    weird.datacenter = "dc-new"
+    assert tz.delta_pack(template, node_index, ClusterDelta(
+        upsert_nodes=[weird])) is None
+
+    # unseen attr value in a referenced column -> fallback
+    weird2 = make_node(8)
+    weird2.attributes["rack"] = "r99"
+    assert tz.delta_pack(template, node_index, ClusterDelta(
+        upsert_nodes=[weird2])) is None
+
+    # drain -> tombstone row carrying current values, valid=False
+    nd = tz.delta_pack(template, node_index, ClusterDelta(
+        remove_node_ids=[nodes[2].id]))
+    assert nd is not None and nd.idx.tolist() == [2]
+    assert not nd.valid[0]
+    np.testing.assert_array_equal(nd.avail[0], template.avail[2])
+
+
+def test_apply_delta_threshold_forces_repack_and_counters():
+    nodes = [make_node(i) for i in range(8)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=2, kp=8, pallas="off",
+                        delta_threshold=0.25)
+    full0 = rs.delta_counters["bytes_dispatched_full"]
+    assert full0 > 0                      # initial put is counted
+
+    # small delta -> incremental
+    out = rs.apply_delta(ClusterDelta(
+        place=[(nodes[0].id, make_alloc())]))
+    assert out == "delta"
+    assert rs.delta_counters["delta_applies"] == 1
+    assert rs.delta_counters["bytes_dispatched_delta"] > 0
+
+    # touching 6/8 nodes blows the 0.25 threshold -> full repack
+    import copy
+    ups = []
+    for n in nodes[:6]:
+        n2 = copy.copy(n)
+        n2.node_resources = copy.deepcopy(n2.node_resources)
+        n2.node_resources.cpu += 500
+        ups.append(n2)
+    out = rs.apply_delta(ClusterDelta(upsert_nodes=ups))
+    assert out == "repack"
+    assert rs.delta_counters["repack_fallbacks"] == 1
+    assert rs.delta_counters["bytes_dispatched_full"] > full0
+    assert rs.delta_counters["last_delta_ratio"] > 0.25
+
+    # the repacked solver still solves (usage carried by node id)
+    pb = rs.pack_batch([make_ask(count=2)])
+    assert pb is not None
+    _, ok, _, status = rs.solve_stream([pb])
+    assert ok[0, :2, 0].all()
+
+
+def test_apply_delta_interning_escape_repacks_with_new_universe():
+    nodes = [make_node(i) for i in range(6)]
+    # two probes: the rack column plus the mock job's default
+    # ${attr.kernel.name} constraint
+    rs = ResidentSolver(nodes, [make_ask(rack="r3"), make_ask()],
+                        gp=2, kp=8, pallas="off")
+    weird = make_node(6)
+    weird.attributes["rack"] = "r99"      # outside the rank universe
+    assert rs.apply_delta(ClusterDelta(upsert_nodes=[weird])) == "repack"
+    assert rs.delta_counters["repack_fallbacks"] == 1
+    # the new universe interns r99: the join is now expressible
+    assert weird.id in rs.node_index
+    pb = rs.pack_batch([make_ask(count=1)])
+    assert pb is not None
+    choice, ok, _, _ = rs.solve_stream([pb])
+    assert ok[0, 0, 0]
+
+
+def test_pipelined_stream_with_deltas_and_device_cache():
+    """solve_stream_pipelined(deltas=...): the device applies wave b's
+    usage-commit before solving wave b; re-dispatched batches ship zero
+    ask bytes (device-cached stacked args) until a node-shape delta
+    bumps the epoch."""
+    # 9 nodes pad to 16 slots: the join below stays on the delta path
+    nodes = [make_node(i, cpu=8000) for i in range(9)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=2, kp=8, pallas="off")
+    pb = rs.pack_batch([make_ask(count=2, cpu=500)])
+    assert pb is not None
+
+    a = make_alloc(cpu=700)
+    deltas = [None,
+              ClusterDelta(place=[(nodes[0].id, a)]),
+              ClusterDelta(stop=[(nodes[0].id, a)])]
+    choice, ok, score, status = rs.solve_stream_pipelined(
+        [pb, pb, pb], deltas=deltas)
+    assert ok[:, :2, 0].all()
+    st = rs.last_pipeline_stats
+    assert st["n_dispatches"] == 3
+    assert st["delta_apply_s"] >= 0.0
+    # wave 1 shipped the batch; waves 2-3 hit the device cache
+    assert st["bytes_dispatched"] > 0
+    rs.solve_stream_pipelined([pb])
+    assert rs.last_pipeline_stats["bytes_dispatched"] == 0
+    # usage net effect: 4 dispatched batches x 2 placements of 500 cpu,
+    # the 700-cpu delta placed then stopped
+    used, _ = rs.usage()
+    assert used[:, 0].sum() == pytest.approx(500 * 8)
+
+    # a node-shape delta invalidates the cached device args (epoch
+    # bump): the next dispatch re-ships instead of reusing stale planes
+    assert rs.apply_delta(
+        ClusterDelta(upsert_nodes=[make_node(9, cpu=8000)])) == "delta"
+    rs.solve_stream_pipelined([pb])
+    assert rs.last_pipeline_stats["bytes_dispatched"] > 0

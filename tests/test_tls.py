@@ -20,11 +20,15 @@ from nomad_tpu.utils import tlsutil
 
 @pytest.fixture(scope="module")
 def pki(tmp_path_factory):
+    pytest.importorskip("cryptography",
+                        reason="PKI minting needs cryptography")
     return tlsutil.write_pki(str(tmp_path_factory.mktemp("pki")))
 
 
 @pytest.fixture(scope="module")
 def other_pki(tmp_path_factory):
+    pytest.importorskip("cryptography",
+                        reason="PKI minting needs cryptography")
     return tlsutil.write_pki(str(tmp_path_factory.mktemp("pki2")))
 
 
@@ -203,3 +207,85 @@ tls {
     assert cfg.tls_ca_file == "/pki/ca.pem"
     tls = cfg.tls_config()
     assert tls is not None and tls.enabled()
+
+
+# ------------------------------------------- certificate-role gating
+def test_client_role_cert_rejected_from_server_verbs(pki):
+    """ADVICE r5 item 1: with mTLS on, ANY CA-signed cert completes the
+    handshake — but raft / server-to-server verbs must additionally
+    require the server.<region>.nomad SAN role.  A client-role cert
+    gets a typed permission_denied, while public verbs still work."""
+    from nomad_tpu.rpc.client import RpcError
+
+    srv = RpcServer(tls=tlsutil.server_context(
+        pki["server.global.nomad"]), region="global")
+    srv.register("Status.Ping", lambda params: "pong")
+    srv.register("raft.rpc_request_vote", lambda params: "granted",
+                 server_only=True)
+    srv.start()
+    try:
+        # client-role cert: public verb ok, raft verb denied
+        cli = RpcClient(srv.addr, tls=tlsutil.client_context(
+            pki["client.global.nomad"]))
+        assert cli.call("Status.Ping", []) == "pong"
+        with pytest.raises(RpcError) as e:
+            cli.call("raft.rpc_request_vote", [])
+        assert e.value.kind == "permission_denied"
+        cli.close()
+        # server-role cert: raft verb allowed
+        peer = RpcClient(srv.addr, tls=tlsutil.client_context(
+            pki["server.global.nomad"]))
+        assert peer.call("raft.rpc_request_vote", []) == "granted"
+        peer.close()
+    finally:
+        srv.stop()
+
+
+def test_verify_hostname_rejects_non_server_peer(pki):
+    """RpcClient with verify_hostname set applies the post-handshake
+    SAN role check: a listener presenting a client-role cert (an
+    impersonating node) is rejected even though the CA pins."""
+    # a "server" armed with a client-role certificate
+    impostor = RpcServer(tls=tlsutil.server_context(
+        pki["client.global.nomad"]))
+    impostor.register("Status.Ping", lambda params: "pong")
+    impostor.start()
+    try:
+        cli = RpcClient(impostor.addr,
+                        tls=tlsutil.client_context(
+                            pki["server.global.nomad"]),
+                        verify_hostname="server.global.nomad")
+        with pytest.raises(ConnectionError):
+            cli.call("Status.Ping", [], timeout=3.0)
+        cli.close()
+        # without the pin the same dial succeeds (CA-only trust)
+        lax = RpcClient(impostor.addr, tls=tlsutil.client_context(
+            pki["server.global.nomad"]))
+        assert lax.call("Status.Ping", []) == "pong"
+        lax.close()
+    finally:
+        impostor.stop()
+
+
+def test_two_node_cluster_role_gated_raft(pki):
+    """serve_cluster with verify_hostname: raft still elects (server
+    certs pass the gate both ways)."""
+    import time as _time
+
+    from nomad_tpu.rpc.endpoints import serve_cluster
+    servers, _rpcs, _addrs = serve_cluster(
+        n=2, num_workers=0,
+        tls_server=tlsutil.server_context(pki["server.global.nomad"]),
+        tls_client=tlsutil.client_context(pki["server.global.nomad"]),
+        verify_hostname="server.global.nomad")
+    try:
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline:
+            if any(s.is_leader() for s in servers):
+                break
+            _time.sleep(0.05)
+        assert any(s.is_leader() for s in servers), \
+            "role-gated raft failed to elect"
+    finally:
+        for s in servers:
+            s.shutdown()
