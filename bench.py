@@ -980,6 +980,22 @@ def run_quality_sweep(seeds=(0, 1, 2, 3, 4)):
     }
 
 
+def lint_summary():
+    """nomadlint state for this run (analyzer version + finding
+    counts), recorded in BENCH_DETAIL so every benchmark carries the
+    lint state it was measured under."""
+    try:
+        from nomad_tpu.analysis import ANALYZER_VERSION, analyze
+        rep = analyze()
+        return {"version": ANALYZER_VERSION,
+                "unsuppressed": len(rep.findings),
+                "baselined": len(rep.suppressed),
+                "stale_baseline_keys": rep.stale_baseline_keys,
+                "by_rule": rep.counts_by_rule()}
+    except Exception as e:          # never lose the run over lint
+        return {"error": str(e)}
+
+
 def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         # subprocess mode: run one config, print its record as JSON
@@ -994,6 +1010,14 @@ def main():
                            "max_placed_ratio")}))
         return
     only = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    # lint state up front so BENCH_DETAIL records which invariants held
+    # for this run (pure-AST pass, no device; never blocks the bench)
+    lint = lint_summary()
+    sys.stderr.write(
+        f"nomadlint v{lint.get('version', '?')}: "
+        f"{lint.get('unsuppressed', '?')} unsuppressed, "
+        f"{lint.get('baselined', '?')} baselined"
+        + (f" ({lint['error']})" if "error" in lint else "") + "\n")
     results = []
     for c in sorted(CONFIGS):
         if only and c != only:
@@ -1040,7 +1064,8 @@ def main():
                 o["projected_local_attach_placements_per_sec"]
                 / max(r["stock"]["placements_per_sec"], 1e-9), 3)
     detail = {"configs": results,
-              "transport_rtt_ms": round(1000 * rtt, 1)}
+              "transport_rtt_ms": round(1000 * rtt, 1),
+              "lint": lint}
     if only is None:
         # multi-seed / multi-shape / both-load sweep (30 duels): the
         # quality claim must be systematic, not one lucky seed.  The

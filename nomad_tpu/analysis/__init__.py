@@ -1,0 +1,74 @@
+"""nomadlint: AST-driven invariant analyzer for the nomad_tpu package.
+
+Three passes over a module-level call graph (no imports are executed —
+everything is `ast` on source text, so the analyzer runs without JAX or
+a device):
+
+  * FSM determinism (fsm_pass):   the raft apply path must be
+    bit-deterministic across replicas — no wall clock, no randomness,
+    no unordered-set iteration feeding state writes, and no StateStore
+    mutation reachable from outside the apply path.
+  * jit purity / retrace hazards (jit_pass): functions traced under
+    jax.jit / pallas must stay host-effect free; Python-branching jit
+    params must be static; donated buffers must not be read after
+    dispatch.
+  * lock discipline (lock_pass):  shared attributes of the threaded
+    server plane must be written under their class lock; racy getters,
+    unlocked module-global mutation and lock-ordering cycles are
+    flagged.
+
+Checked-in suppressions live in baseline.toml next to this file; every
+entry must carry a non-empty justification. Run `python -m
+nomad_tpu.analysis`; exit 0 means zero unsuppressed findings.
+See STATIC_ANALYSIS.md at the repo root for the rule catalog.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .core import AnalysisConfig, Finding, PackageIndex, Report
+from .baseline import Baseline, BaselineError, load_baseline
+
+ANALYZER_VERSION = "1.0"
+
+# the directory CONTAINING the nomad_tpu package (analysis/ -> pkg -> root)
+_PKG_DIR = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.toml")
+
+
+def analyze(package_dir: Optional[str] = None,
+            package_name: str = "nomad_tpu",
+            baseline: Optional[Baseline] = None,
+            use_baseline: bool = True,
+            config: Optional[AnalysisConfig] = None) -> Report:
+    """Run all three passes; returns a Report with unsuppressed
+    findings, suppressed count and the per-rule tally."""
+    from .fsm_pass import run_fsm_pass
+    from .jit_pass import run_jit_pass
+    from .lock_pass import run_lock_pass
+
+    package_dir = package_dir or _PKG_DIR
+    cfg = config or AnalysisConfig()
+    index = PackageIndex.build(package_dir, package_name)
+    findings: List[Finding] = []
+    findings += run_fsm_pass(index, cfg)
+    findings += run_jit_pass(index, cfg)
+    findings += run_lock_pass(index, cfg)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if baseline is None and use_baseline:
+        path = default_baseline_path()
+        if os.path.exists(path):
+            baseline = load_baseline(path)
+    return Report.build(findings, baseline, version=ANALYZER_VERSION)
+
+
+__all__ = ["ANALYZER_VERSION", "AnalysisConfig", "Baseline",
+           "BaselineError", "Finding", "PackageIndex", "Report",
+           "analyze", "default_baseline_path", "load_baseline"]

@@ -1,0 +1,60 @@
+"""CLI: `python -m nomad_tpu.analysis` — exit 0 iff zero unsuppressed
+findings (baseline errors exit 2)."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (ANALYZER_VERSION, BaselineError, analyze,
+               default_baseline_path, load_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="nomadlint: FSM determinism / jit purity / lock "
+                    "discipline analyzer")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring baseline.toml")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="alternate baseline file "
+                         f"(default: {default_baseline_path()})")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = None
+        if not args.no_baseline:
+            path = args.baseline or default_baseline_path()
+            baseline = load_baseline(path)
+        rep = analyze(baseline=baseline, use_baseline=not args.no_baseline)
+    except BaselineError as e:
+        print(f"baseline error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "version": rep.version,
+            "unsuppressed": [vars(f) | {"key": f.key}
+                             for f in rep.findings],
+            "suppressed": len(rep.suppressed),
+            "stale_baseline_keys": rep.stale_baseline_keys,
+            "by_rule": rep.counts_by_rule(),
+        }, indent=1))
+    else:
+        for f in rep.findings:
+            print(f.render())
+        for k in rep.stale_baseline_keys:
+            print(f"warning: stale baseline entry matches nothing: {k}",
+                  file=sys.stderr)
+        print(f"nomadlint v{rep.version}: "
+              f"{len(rep.findings)} finding(s), "
+              f"{len(rep.suppressed)} baselined"
+              + (f" [{rep.counts_by_rule()}]" if rep.findings else ""))
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

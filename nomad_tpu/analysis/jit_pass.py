@@ -1,0 +1,371 @@
+"""Pass 2: jit purity / retrace hazards.
+
+Functions traced under jax.jit / pjit / pallas run as captured device
+programs: host side effects execute only at trace time (so they
+silently vanish on cache hits, or fire once per retrace), Python
+branching on non-static arguments raises or — worse, via weak types and
+`int` promotion — retraces per value, and donated buffers are dead the
+moment the call is dispatched.  Any of these in the solver dispatch
+path silently regresses the PR 1/2 wins into per-eval recompiles.
+
+Rules
+  JIT201  host side effect (I/O, logging, env, clock, randomness)
+          reachable from a jit/pallas root
+  JIT202  global/closure mutation reachable from a jit/pallas root
+          (trace-time write = tracer leak / stale capture)
+  JIT203  non-static jit parameter used in Python control flow
+          (retrace bomb / trace error)
+  JIT204  buffer passed at a donated position read again after the
+          dispatch
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisConfig, Finding, PackageIndex, _dotted
+
+HOST_EFFECT_EXACT = {"print", "input", "open", "exec", "eval"}
+HOST_EFFECT_PREFIXES = (
+    "os.", "sys.", "io.", "logging.", "time.", "random.",
+    "numpy.random.", "np.random.", "subprocess.", "socket.",
+    "builtins.print", "shutil.", "pathlib.",
+)
+# benign stdlib the prefixes above would otherwise catch
+HOST_EFFECT_ALLOW = {"os.path.join", "os.path.dirname",
+                     "os.path.abspath", "os.path.basename"}
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+
+JIT_NAMES = {"jax.jit", "jit", "functools.partial", "partial",
+             "jax.pjit", "pjit"}
+
+
+class JitRoot:
+    __slots__ = ("fkey", "static", "donate", "via")
+
+    def __init__(self, fkey: str, static: Set[str],
+                 donate: Tuple[int, ...], via: str):
+        self.fkey = fkey
+        self.static = static
+        self.donate = donate
+        self.via = via      # "decorator" | "call" | "pallas"
+
+
+def _const_tuple(node) -> Tuple:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant))
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    return ()
+
+
+def _jit_kwargs(call: ast.Call) -> Tuple[Set[str], Tuple[int, ...]]:
+    static: Set[str] = set()
+    donate: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static |= {s for s in _const_tuple(kw.value)
+                       if isinstance(s, str)}
+        elif kw.arg == "static_argnums":
+            static |= {f"#{i}" for i in _const_tuple(kw.value)
+                       if isinstance(i, int)}
+        elif kw.arg == "donate_argnums":
+            donate = tuple(i for i in _const_tuple(kw.value)
+                           if isinstance(i, int))
+    return static, donate
+
+
+def _is_jit_call(node: ast.Call, aliases: Dict[str, str]) -> bool:
+    d = _dotted(node.func)
+    if not d:
+        return False
+    head = d.split(".")[0]
+    resolved = aliases.get(head)
+    if resolved:
+        d = resolved + d[len(head):]
+    return d in ("jax.jit", "jax.pjit") or d.endswith(".jit")
+
+
+def _unwrap_partial(node: ast.Call, aliases: Dict[str, str]
+                    ) -> Optional[ast.Call]:
+    """functools.partial(jax.jit, ...) -> the jit call carrying the
+    kwargs."""
+    d = _dotted(node.func)
+    if not d:
+        return None
+    head = d.split(".")[0]
+    resolved = aliases.get(head)
+    full = (resolved + d[len(head):]) if resolved else d
+    if full in ("functools.partial", "partial") and node.args:
+        inner = node.args[0]
+        inner_d = _dotted(inner)
+        if inner_d:
+            ih = inner_d.split(".")[0]
+            ir = aliases.get(ih)
+            ifull = (ir + inner_d[len(ih):]) if ir else inner_d
+            if ifull in ("jax.jit", "jax.pjit"):
+                return node
+    return None
+
+
+def find_jit_roots(index: PackageIndex) -> List[JitRoot]:
+    roots: List[JitRoot] = []
+    for fkey, fi in index.functions.items():
+        aliases = index.modules[fi.module].aliases
+        for dec in getattr(fi.node, "decorator_list", ()):
+            if isinstance(dec, ast.Call):
+                p = _unwrap_partial(dec, aliases)
+                if p is not None:
+                    static, donate = _jit_kwargs(p)
+                    roots.append(JitRoot(fkey, static, donate,
+                                         "decorator"))
+                elif _is_jit_call(dec, aliases):
+                    static, donate = _jit_kwargs(dec)
+                    roots.append(JitRoot(fkey, static, donate,
+                                         "decorator"))
+            else:
+                d = _dotted(dec)
+                if d:
+                    head = d.split(".")[0]
+                    full = ((aliases.get(head) or head)
+                            + d[len(head):]) if head else d
+                    if full in ("jax.jit", "jax.pjit", "jit"):
+                        roots.append(JitRoot(fkey, set(), (),
+                                             "decorator"))
+    # call-form roots: jax.jit(f, ...) / pl.pallas_call(kernel, ...)
+    for fkey, fi in index.functions.items():
+        la = index._local_imports(fi)
+        aliases = dict(index.modules[fi.module].aliases)
+        aliases.update(la)
+        for node in index._own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not d:
+                continue
+            head = d.split(".")[0]
+            full = (aliases.get(head) or head) + d[len(head):]
+            if full in ("jax.jit", "jax.pjit") and node.args:
+                target = index.resolve_call(
+                    fi, ast.Call(func=node.args[0], args=[],
+                                 keywords=[]), la,
+                    index._local_var_types(fi)) \
+                    if isinstance(node.args[0],
+                                  (ast.Name, ast.Attribute)) else None
+                if target:
+                    static, donate = _jit_kwargs(node)
+                    roots.append(JitRoot(target, static, donate,
+                                         "call"))
+            elif full.endswith("pallas_call") and node.args:
+                if isinstance(node.args[0], (ast.Name, ast.Attribute)):
+                    target = index.resolve_call(
+                        fi, ast.Call(func=node.args[0], args=[],
+                                     keywords=[]), la,
+                        index._local_var_types(fi))
+                    if target:
+                        roots.append(JitRoot(target, set(), (),
+                                             "pallas"))
+    return roots
+
+
+def run_jit_pass(index: PackageIndex, cfg: AnalysisConfig
+                 ) -> List[Finding]:
+    findings: List[Finding] = []
+    roots = find_jit_roots(index)
+    root_keys = [r.fkey for r in roots]
+    reach = index.reachable(root_keys)
+
+    # ---- JIT201 / JIT202 over the traced closure
+    for fkey in sorted(reach):
+        fi = index.functions[fkey]
+        for name, lineno in index.external_calls(fkey):
+            if _is_host_effect(name):
+                findings.append(Finding(
+                    "JIT201", fi.module, fi.qual, name, fi.path, lineno,
+                    f"host side effect `{name}` inside a jit/pallas-"
+                    "traced closure; it runs at trace time only and "
+                    "vanishes on cache hits",
+                    hint="hoist the effect to the dispatch wrapper, or "
+                         "baseline if it is a deliberate trace-time "
+                         "config probe"))
+        for node in index._own_nodes(fi):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                base = _dotted(node.func.value)
+                if base and node.func.attr in LOG_METHODS and \
+                        _looks_like_logger(base):
+                    findings.append(Finding(
+                        "JIT201", fi.module, fi.qual,
+                        f"{base}.{node.func.attr}", fi.path,
+                        node.lineno,
+                        f"logging call `{base}.{node.func.attr}` "
+                        "inside a jit/pallas-traced closure",
+                        hint="log from the dispatch wrapper instead"))
+            if isinstance(node, ast.Global):
+                findings.append(Finding(
+                    "JIT202", fi.module, fi.qual,
+                    ",".join(node.names), fi.path, node.lineno,
+                    "global-statement write inside a jit/pallas-traced "
+                    "closure; trace-time mutation leaks tracers and "
+                    "goes stale on cache hits",
+                    hint="return the value from the traced function "
+                         "and assign it on the host"))
+        # subscript/attr stores on module globals
+        mi = index.modules[fi.module]
+        for node in index._own_nodes(fi):
+            tgt = None
+            if isinstance(node, ast.Assign):
+                tgt = node.targets
+            elif isinstance(node, ast.AugAssign):
+                tgt = [node.target]
+            if not tgt:
+                continue
+            for t in tgt:
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Name) and \
+                        base.id in mi.globals and base is not t:
+                    findings.append(Finding(
+                        "JIT202", fi.module, fi.qual, base.id,
+                        fi.path, node.lineno,
+                        f"mutation of module global `{base.id}` inside "
+                        "a jit/pallas-traced closure",
+                        hint="mutate from the un-traced wrapper"))
+
+    # ---- JIT203: non-static params in Python control flow
+    for r in roots:
+        fi = index.functions.get(r.fkey)
+        if fi is None:
+            continue
+        args = fi.node.args
+        names = [a.arg for a in list(args.args)
+                 + list(args.posonlyargs) + list(args.kwonlyargs)]
+        static = set()
+        for s in r.static:
+            if s.startswith("#"):
+                i = int(s[1:])
+                if i < len(names):
+                    static.add(names[i])
+            else:
+                static.add(s)
+        traced = [n for n in names if n not in static and n != "self"]
+        for node in index._own_nodes(fi):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            if test is None:
+                continue
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Name) and sub.id in traced:
+                    findings.append(Finding(
+                        "JIT203", fi.module, fi.qual, sub.id, fi.path,
+                        node.lineno,
+                        f"traced parameter `{sub.id}` drives Python "
+                        "control flow inside a jit root; every new "
+                        "value retraces (or errors) instead of "
+                        "compiling once",
+                        hint="mark it in static_argnames, or express "
+                             "the branch with lax.cond/jnp.where"))
+
+    # ---- JIT204: donated buffers read after dispatch
+    donating: Dict[str, Tuple[int, ...]] = {}
+    for r in roots:
+        if r.donate:
+            donating[r.fkey] = r.donate
+    # wrappers that forward to a donating jit (one hop), e.g.
+    # delta_scatter_set -> _delta_scatter("set")(arr, ...)
+    wrapper_names: Dict[str, Tuple[int, ...]] = {}
+    for fkey, fi in index.functions.items():
+        for callee in index.callees(fkey):
+            if callee in donating and fi.parent is None:
+                wrapper_names.setdefault(fkey, donating[callee])
+    for fkey, fi in sorted(index.functions.items()):
+        callees = index.callees(fkey)
+        targets = {c: donating[c] for c in callees if c in donating}
+        targets.update({c: wrapper_names[c] for c in callees
+                        if c in wrapper_names and c != fkey})
+        if not targets:
+            continue
+        findings.extend(_check_donated_reads(index, fi, targets))
+    return findings
+
+
+def _looks_like_logger(base: str) -> bool:
+    last = base.rsplit(".", 1)[-1].lstrip("_")
+    return last in ("log", "logger", "logging")
+
+
+def _is_host_effect(name: str) -> bool:
+    if name in HOST_EFFECT_ALLOW:
+        return False
+    if name in HOST_EFFECT_EXACT:
+        return True
+    return any(name.startswith(p) for p in HOST_EFFECT_PREFIXES)
+
+
+def _check_donated_reads(index: PackageIndex, fi,
+                         targets: Dict[str, Tuple[int, ...]]
+                         ) -> List[Finding]:
+    """Linear scan of the caller: after a call that donates `name` (or
+    self-contained subscript), a load of the same expression without an
+    intervening rebind is a read of a dead buffer."""
+    findings: List[Finding] = []
+    la = index._local_imports(fi)
+    lt = index._local_var_types(fi)
+    # collect (donated_expr_repr, call_lineno)
+    events: List[Tuple[str, int]] = []
+    rebinds: List[Tuple[str, int]] = []
+    loads: List[Tuple[str, int]] = []
+    for node in index._own_nodes(fi):
+        if isinstance(node, ast.Call):
+            r = index.resolve_call(fi, node, la, lt)
+            if r in targets:
+                for pos in targets[r]:
+                    if pos < len(node.args):
+                        key = _expr_key(node.args[pos])
+                        if key:
+                            events.append((key, node.lineno))
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                key = _expr_key(t)
+                if key:
+                    rebinds.append((key, node.lineno))
+        if isinstance(node, (ast.Name, ast.Subscript, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            key = _expr_key(node)
+            if key:
+                loads.append((key, node.lineno))
+    for key, cline in events:
+        rebind_line = min((ln for k, ln in rebinds
+                           if k == key and ln >= cline),
+                          default=None)
+        for k, ln in loads:
+            if k != key or ln <= cline:
+                continue
+            if rebind_line is not None and ln >= rebind_line:
+                continue
+            findings.append(Finding(
+                "JIT204", fi.module, fi.qual, key, fi.path, ln,
+                f"`{key}` is read after being passed at a donated "
+                f"position on line {cline}; the buffer is dead once "
+                "the call dispatches",
+                hint="use the call's RESULT (donation returns the "
+                     "updated buffer) or drop donate_argnums"))
+            break
+    return findings
+
+
+def _expr_key(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        base = _dotted(node.value)
+        if base and isinstance(node.slice, ast.Constant):
+            return f"{base}[{node.slice.value!r}]"
+    d = _dotted(node)
+    return d

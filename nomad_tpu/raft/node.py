@@ -129,7 +129,7 @@ class RaftNode:
         transport.register(self)
 
     # ------------------------------------------------------- persistence
-    def _save_meta(self) -> None:
+    def _save_meta_locked(self) -> None:
         self._meta_saved_commit = self.commit_index
         if not self._meta_path:
             return
@@ -184,19 +184,21 @@ class RaftNode:
             if self.running:
                 return
             self.running = True
-            self._reset_election_deadline()
+            self._reset_election_deadline_locked()
             if self.log.last_index() == 0 and self.term == 0:
                 self._deadline += self.cfg.join_grace_s
-        t = threading.Thread(target=self._run, daemon=True,
-                             name=f"raft-{self.id}")
-        t.start()
-        self._threads = [t]
+            # thread handle guarded by _lock (the loop's first action
+            # is to take it, so starting here just briefly blocks it)
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"raft-{self.id}")
+            t.start()
+            self._threads = [t]
 
     def stop(self) -> None:
         with self._lock:
             self.running = False
             self._closed = True
-            self._save_meta()
+            self._save_meta_locked()
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=2.0)
@@ -219,7 +221,7 @@ class RaftNode:
             self.term += 1
             self.voted_for = self.id
             self._become_leader_locked()
-            self._save_meta()
+            self._save_meta_locked()
         if not defer_events:
             self._fire_role_events()
 
@@ -245,7 +247,7 @@ class RaftNode:
                 time.sleep(0.01)
             self._fire_role_events()
 
-    def _reset_election_deadline(self) -> None:
+    def _reset_election_deadline_locked(self) -> None:
         lo, hi = self.cfg.election_timeout_s
         self._deadline = time.monotonic() + random.uniform(lo, hi)
 
@@ -263,8 +265,8 @@ class RaftNode:
             last_t = (self.log.term_at(last_i)
                       if last_i > self.snapshot_index
                       else self._snap_term())
-            self._save_meta()
-            self._reset_election_deadline()
+            self._save_meta_locked()
+            self._reset_election_deadline_locked()
         votes = 1
         for peer in self.cfg.peers:
             if peer == self.id:
@@ -303,8 +305,8 @@ class RaftNode:
         self.term = term
         self.role = ROLE_FOLLOWER
         self.voted_for = None
-        self._save_meta()
-        self._reset_election_deadline()
+        self._save_meta_locked()
+        self._reset_election_deadline_locked()
         if was_leader:
             self._role_events.append("follower")
 
@@ -325,7 +327,8 @@ class RaftNode:
                     self.on_follower()
 
     def _snap_term(self) -> int:
-        return self.snapshot_term
+        with self._lock:    # re-entrant; callers already hold it
+            return self.snapshot_term
 
     # -------------------------------------------------------- replication
     def _append_locked(self, etype: str, payload: Any) -> int:
@@ -470,7 +473,7 @@ class RaftNode:
             # on restart), not a safety requirement — batch it off the
             # hot path; stop()/compaction write the exact value
             if self.commit_index - self._meta_saved_commit >= 64:
-                self._save_meta()
+                self._save_meta_locked()
             self._cv.notify_all()
 
     def _apply_committed_locked(self) -> None:
@@ -496,14 +499,14 @@ class RaftNode:
         the stickiness guard)."""
         old = set(self.cfg.peers)
         self.cfg.peers = list(peers)
-        self._save_meta()
+        self._save_meta_locked()
         if self.role == ROLE_LEADER:
             if self.id not in peers:
                 # a leader that committed its own removal steps down
                 # (raft §6) — staying leader would let the stickiness
                 # guard pin the cluster to a non-member forever
                 self.role = ROLE_FOLLOWER
-                self._reset_election_deadline()
+                self._reset_election_deadline_locked()
                 self._role_events.append("follower")
                 return
             for p in peers:
@@ -577,7 +580,7 @@ class RaftNode:
                 f.write(data)
             os.replace(tmp, self._snap_path)
         self.log.compact_to(self.snapshot_index)
-        self._save_meta()
+        self._save_meta_locked()
 
     def _read_snapshot(self) -> bytes:
         if self._snap_path and os.path.exists(self._snap_path):
@@ -611,8 +614,8 @@ class RaftNode:
                               and last_log_index >= my_last))
             if (self.voted_for in (None, candidate)) and up_to_date:
                 self.voted_for = candidate
-                self._save_meta()
-                self._reset_election_deadline()
+                self._save_meta_locked()
+                self._reset_election_deadline_locked()
                 return self.term, True
             return self.term, False
 
@@ -627,13 +630,13 @@ class RaftNode:
                 self.term = term
                 self.role = ROLE_FOLLOWER
                 self.voted_for = None
-                self._save_meta()
+                self._save_meta_locked()
                 if was_leader:
                     self._role_events.append("follower")
                     events = True
             self.leader_id = leader
             self._last_leader_contact = time.monotonic()
-            self._reset_election_deadline()
+            self._reset_election_deadline_locked()
             # consistency check
             if prev_index > self.snapshot_index:
                 if (prev_index > self.log.last_index()
@@ -655,7 +658,7 @@ class RaftNode:
             if leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit,
                                         self.log.last_index())
-                self._save_meta()
+                self._save_meta_locked()
             self._apply_committed_locked()
             out = self.term, True, match
         if events:
@@ -671,7 +674,7 @@ class RaftNode:
             self.role = ROLE_FOLLOWER
             self.leader_id = leader
             self._last_leader_contact = time.monotonic()
-            self._reset_election_deadline()
+            self._reset_election_deadline_locked()
             if snap_index <= self.last_applied:
                 return self.term
             self.fsm.restore(data)
@@ -686,5 +689,5 @@ class RaftNode:
                     f.write(data if isinstance(data, bytes)
                             else bytes(data))
                 os.replace(tmp, self._snap_path)
-            self._save_meta()
+            self._save_meta_locked()
             return self.term

@@ -41,7 +41,8 @@ class BlockedEvals:
 
     @property
     def enabled(self) -> bool:
-        return self._enabled
+        with self._lock:    # guarded by _lock: see set_enabled
+            return self._enabled
 
     # --------------------------------------------------------------- block
     def block(self, ev: Evaluation) -> None:

@@ -61,20 +61,22 @@ class DeploymentWatcher:
 
     # ------------------------------------------------------------ control
     def set_enabled(self, enabled: bool) -> None:
+        thread = None
         with self._cv:
             if enabled == self._enabled:
                 return
             self._enabled = enabled
             if enabled:
+                # thread handle guarded by _cv (nomadlint LOCK301)
                 self._thread = threading.Thread(target=self._watch,
                                                 daemon=True)
                 self._thread.start()
             else:
                 self._state.clear()
+                thread, self._thread = self._thread, None
                 self._cv.notify_all()
-        if not enabled and self._thread is not None:
-            self._thread.join(timeout=1.0)
-            self._thread = None
+        if thread is not None:
+            thread.join(timeout=1.0)
 
     # ------------------------------------------------------------- loop
     def _watch(self) -> None:
@@ -97,11 +99,16 @@ class DeploymentWatcher:
                                   self.poll_interval_s * 4)
 
     # ------------------------------------------------------------ checks
+    def _dep_state(self, dep_id: str) -> "_DepState":
+        with self._cv:   # _state is cleared by set_enabled(False)
+            st = self._state.get(dep_id)
+            if st is None:
+                st = self._state[dep_id] = _DepState()
+            return st
+
     def _check(self, dep: Deployment) -> None:
         now = _time.time()
-        st = self._state.get(dep.id)
-        if st is None:
-            st = self._state[dep.id] = _DepState()
+        st = self._dep_state(dep.id)
         healthy = sum(s.healthy_allocs for s in dep.task_groups.values())
         unhealthy = sum(s.unhealthy_allocs
                         for s in dep.task_groups.values())

@@ -35,19 +35,21 @@ class NodeDrainer:
         self._thread: Optional[threading.Thread] = None
 
     def set_enabled(self, enabled: bool) -> None:
+        thread = None
         with self._cv:
             if enabled == self._enabled:
                 return
             self._enabled = enabled
             if enabled:
+                # thread handle guarded by _cv (nomadlint LOCK301)
                 self._thread = threading.Thread(target=self._watch,
                                                 daemon=True)
                 self._thread.start()
             else:
+                thread, self._thread = self._thread, None
                 self._cv.notify_all()
-        if not enabled and self._thread is not None:
-            self._thread.join(timeout=1.0)
-            self._thread = None
+        if thread is not None:
+            thread.join(timeout=1.0)
 
     # --------------------------------------------------------------- loop
     def _watch(self) -> None:

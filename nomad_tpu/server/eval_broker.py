@@ -86,19 +86,23 @@ class EvalBroker:
         with self._lock:
             prev = self._enabled
             self._enabled = enabled
+            if enabled and not prev:
+                # thread handle guarded by _lock (the watcher's first
+                # action is to take it, so starting under the lock just
+                # briefly blocks the new thread)
+                self._stop_delay.clear()
+                self._delay_thread = threading.Thread(
+                    target=self._run_delayed_watcher, daemon=True)
+                self._delay_thread.start()
         if prev and not enabled:
             self.flush()
-        if enabled and not prev:
-            self._stop_delay.clear()
-            self._delay_thread = threading.Thread(
-                target=self._run_delayed_watcher, daemon=True)
-            self._delay_thread.start()
         if not enabled:
             self._stop_delay.set()
 
     @property
     def enabled(self) -> bool:
-        return self._enabled
+        with self._lock:    # guarded by _lock: see set_enabled
+            return self._enabled
 
     def ready_count(self) -> int:
         """Evals ready for dequeue right now (not delayed/unacked)."""

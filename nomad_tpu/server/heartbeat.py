@@ -62,21 +62,23 @@ class NodeHeartbeater:
     def set_enabled(self, enabled: bool) -> None:
         """Leadership gate: the watcher only runs on the leader
         (reference: heartbeat.go:94-100 IsLeader check)."""
+        watcher = None
         with self._cv:
             if enabled == self._enabled:
                 return
             self._enabled = enabled
             if enabled:
+                # thread handle guarded by _cv (nomadlint LOCK301)
                 self._watcher = threading.Thread(target=self._watch,
                                                  daemon=True)
                 self._watcher.start()
             else:
                 self._deadlines.clear()
                 self._heap.clear()
+                watcher, self._watcher = self._watcher, None
                 self._cv.notify_all()
-        if not enabled and self._watcher is not None:
-            self._watcher.join(timeout=1.0)
-            self._watcher = None
+        if watcher is not None:
+            watcher.join(timeout=1.0)
 
     def initialize(self, node_ids) -> None:
         """On leadership gain, grant every known live node the failover TTL

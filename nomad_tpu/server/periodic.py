@@ -85,20 +85,22 @@ class PeriodicDispatcher:
 
     # ------------------------------------------------------------ control
     def set_enabled(self, enabled: bool) -> None:
+        runner = None
         with self._cv:
             if enabled == self._enabled:
                 return
             self._enabled = enabled
             if enabled:
+                # thread handle guarded by _cv (nomadlint LOCK301)
                 self._runner = threading.Thread(target=self._run, daemon=True)
                 self._runner.start()
             else:
                 self._tracked.clear()
                 self._heap.clear()
+                runner, self._runner = self._runner, None
                 self._cv.notify_all()
-        if not enabled and self._runner is not None:
-            self._runner.join(timeout=1.0)
-            self._runner = None
+        if runner is not None:
+            runner.join(timeout=1.0)
 
     def add(self, job: Job) -> None:
         """Track (or retrack) a periodic job; untracks if it stopped being

@@ -61,7 +61,8 @@ class PlanQueue:
 
     @property
     def enabled(self) -> bool:
-        return self._enabled
+        with self._lock:    # guarded by _lock: see set_enabled
+            return self._enabled
 
     def enqueue(self, plan: Plan) -> Optional[PendingPlan]:
         with self._lock:

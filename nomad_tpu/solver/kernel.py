@@ -39,6 +39,7 @@ every node — strictly better placements at far higher eval throughput.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple
 
 import jax
@@ -81,6 +82,7 @@ _WIDE_W_CAP = 256
 # CPU ignores donation; building the jit without it avoids the
 # "donated buffers unused" warning storm in host-only runs.
 _DELTA_JITS: dict = {}
+_DELTA_JITS_LOCK = threading.Lock()
 
 
 def _delta_scatter(op: str):
@@ -88,18 +90,21 @@ def _delta_scatter(op: str):
     init for every package import, including pure-host test runs)."""
     fn = _DELTA_JITS.get(op)
     if fn is None:
-        try:
-            donate = jax.default_backend() != "cpu"
-        except Exception:       # backend init can fail in odd sandboxes
-            donate = False
-        if op == "set":
-            def f(arr, idx, rows):
-                return arr.at[idx].set(rows)
-        else:
-            def f(arr, idx, rows):
-                return arr.at[idx].add(rows)
-        fn = jax.jit(f, donate_argnums=(0,) if donate else ())
-        _DELTA_JITS[op] = fn
+        with _DELTA_JITS_LOCK:     # double-checked cache fill
+            fn = _DELTA_JITS.get(op)
+            if fn is None:
+                try:
+                    donate = jax.default_backend() != "cpu"
+                except Exception:  # backend init can fail in sandboxes
+                    donate = False
+                if op == "set":
+                    def f(arr, idx, rows):
+                        return arr.at[idx].set(rows)
+                else:
+                    def f(arr, idx, rows):
+                        return arr.at[idx].add(rows)
+                fn = jax.jit(f, donate_argnums=(0,) if donate else ())
+                _DELTA_JITS[op] = fn
     return fn
 
 

@@ -922,6 +922,24 @@ class ResidentSolver:
         # default) — a cond would run every budget wave for every lane
         return self._unpack(out)
 
+    # ------------------------------------------------ retrace guard
+    @staticmethod
+    def compile_count() -> int:
+        """Total compiled variants across the resident dispatch
+        kernels (the jit compile-cache probe behind the retrace-count
+        regression guard, nomadlint JIT203's runtime twin): steady-state
+        streams over a fixed node/ask universe must not grow this —
+        every new entry is a silent recompile eating the PR 1/2 wins.
+        Returns -1 when the probe is unavailable (jax version without
+        _cache_size)."""
+        total = 0
+        for fn in (_stream_kernel, _parallel_kernel):
+            try:
+                total += fn._cache_size()
+            except Exception:
+                return -1
+        return total
+
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch the carried device usage (one sync — call sparingly)."""
         return np.asarray(self._used), np.asarray(self._dev_used)

@@ -297,7 +297,7 @@ class StateStore(StateSnapshot):
                 self._watch.wait(remain)
             return self.index
 
-    def _bump(self, table: str, index: int) -> None:
+    def _bump_locked(self, table: str, index: int) -> None:
         self.index = max(self.index, index)
         self._ix[table] = max(self._ix.get(table, 0), index)
         self._watch.notify_all()
@@ -315,13 +315,13 @@ class StateStore(StateSnapshot):
                 node.compute_class()
             self._t["nodes"][node.id] = node
             self.changelog.append(index, "node", node.id)
-            self._bump("nodes", index)
+            self._bump_locked("nodes", index)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             self._t["nodes"].pop(node_id, None)
             self.changelog.append(index, "node", node_id)
-            self._bump("nodes", index)
+            self._bump_locked("nodes", index)
 
     def update_node_status(self, index: int, node_id: str, status: str,
                            updated_at: float = 0.0) -> None:
@@ -336,7 +336,7 @@ class StateStore(StateSnapshot):
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
             self.changelog.append(index, "node", node_id)
-            self._bump("nodes", index)
+            self._bump_locked("nodes", index)
 
     def update_node_eligibility(self, index: int, node_id: str,
                                 eligibility: str) -> None:
@@ -350,7 +350,7 @@ class StateStore(StateSnapshot):
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
             self.changelog.append(index, "node", node_id)
-            self._bump("nodes", index)
+            self._bump_locked("nodes", index)
 
     def update_node_drain(self, index: int, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> None:
@@ -369,7 +369,7 @@ class StateStore(StateSnapshot):
             n2.modify_index = index
             self._t["nodes"][node_id] = n2
             self.changelog.append(index, "node", node_id)
-            self._bump("nodes", index)
+            self._bump_locked("nodes", index)
 
     # -- jobs --
     def upsert_job(self, index: int, job: Job) -> None:
@@ -397,8 +397,8 @@ class StateStore(StateSnapshot):
             else:
                 versions[0] = job
             self._t["job_versions"][key] = versions
-            self._ensure_summary(index, job)
-            self._bump("jobs", index)
+            self._ensure_summary_locked(index, job)
+            self._bump_locked("jobs", index)
 
     @staticmethod
     def _job_spec_changed(old: Job, new: Job) -> bool:
@@ -421,7 +421,7 @@ class StateStore(StateSnapshot):
             self._t["job_versions"].pop(key, None)
             self._t["job_summaries"].pop(key, None)
             self._t["periodic_launches"].pop(key, None)
-            self._bump("jobs", index)
+            self._bump_locked("jobs", index)
 
     def update_job_stability(self, index: int, namespace: str, job_id: str,
                              version: int, stable: bool) -> None:
@@ -449,14 +449,14 @@ class StateStore(StateSnapshot):
                 j2.stable = stable
                 versions[i] = j2
         self._t["job_versions"][key] = versions
-        self._bump("jobs", index)
+        self._bump_locked("jobs", index)
 
     def _mark_stable_locked(self, index: int, namespace: str,
                             job_id: str, version: int) -> None:
         self._update_job_stability_locked(index, namespace, job_id,
                                           version, True)
 
-    def _ensure_summary(self, index: int, job: Job) -> None:
+    def _ensure_summary_locked(self, index: int, job: Job) -> None:
         key = (job.namespace, job.id)
         summary = self._t["job_summaries"].get(key)
         if summary is None:
@@ -485,7 +485,7 @@ class StateStore(StateSnapshot):
                     "running": 0, "starting": 0, "lost": 0})["queued"] = n
             summary.modify_index = index
             self._t["job_summaries"][key] = summary
-            self._bump("job_summaries", index)
+            self._bump_locked("job_summaries", index)
 
     # -- evals --
     def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
@@ -498,8 +498,8 @@ class StateStore(StateSnapshot):
                     e.create_index = index
                 e.modify_index = index
                 self._t["evals"][e.id] = e
-                self._refresh_job_status(index, e.namespace, e.job_id)
-            self._bump("evals", index)
+                self._refresh_job_status_locked(index, e.namespace, e.job_id)
+            self._bump_locked("evals", index)
 
     def delete_eval(self, index: int, eval_ids: List[str],
                     alloc_ids: List[str] = ()) -> None:
@@ -507,12 +507,12 @@ class StateStore(StateSnapshot):
             for eid in eval_ids:
                 self._t["evals"].pop(eid, None)
             for aid in alloc_ids:
-                self._remove_alloc(aid, index)
-            self._bump("evals", index)
+                self._remove_alloc_locked(aid, index)
+            self._bump_locked("evals", index)
             if alloc_ids:
-                self._bump("allocs", index)
+                self._bump_locked("allocs", index)
 
-    def _refresh_job_status(self, index: int, namespace: str,
+    def _refresh_job_status_locked(self, index: int, namespace: str,
                             job_id: str) -> None:
         """Keep Job.status in sync as evals/allocs flow (simplified
         reference: state_store.go setJobStatus/getJobStatus — called from
@@ -548,9 +548,11 @@ class StateStore(StateSnapshot):
         with self._lock:
             for a in allocs:
                 self._upsert_alloc_locked(index, a)
-            for key in {(a.namespace, a.job_id) for a in allocs}:
-                self._refresh_job_status(index, *key)
-            self._bump("allocs", index)
+            # sorted: set order varies with PYTHONHASHSEED across
+            # replica processes (nomadlint FSM103)
+            for key in sorted({(a.namespace, a.job_id) for a in allocs}):
+                self._refresh_job_status_locked(index, *key)
+            self._bump_locked("allocs", index)
 
     def _upsert_alloc_locked(self, index: int, a: Allocation) -> None:
         existing = self._t["allocs"].get(a.id)
@@ -603,7 +605,7 @@ class StateStore(StateSnapshot):
             tg[new] = tg.get(new, 0) + 1
         s2.modify_index = index
         self._t["job_summaries"][key] = s2
-        self._bump("job_summaries", index)
+        self._bump_locked("job_summaries", index)
 
     def _update_deployment_with_alloc_locked(self, index: int, a: Allocation,
                                              existing) -> None:
@@ -650,7 +652,7 @@ class StateStore(StateSnapshot):
             state.placed_canaries.append(a.id)
         self._t["deployments"][d2.id] = d2
 
-    def _remove_alloc(self, alloc_id: str, index: int = 0) -> None:
+    def _remove_alloc_locked(self, alloc_id: str, index: int = 0) -> None:
         a = self._t["allocs"].pop(alloc_id, None)
         if a is None:
             return
@@ -694,9 +696,10 @@ class StateStore(StateSnapshot):
                 self._t["allocs"][a.id] = a
                 self.changelog.append(index, "alloc", a.id)
                 self._sync_services_locked(index, a)
-            for key in {(u.namespace, u.job_id) for u in updates}:
-                self._refresh_job_status(index, *key)
-            self._bump("allocs", index)
+            # sorted for replica determinism (nomadlint FSM103)
+            for key in sorted({(u.namespace, u.job_id) for u in updates}):
+                self._refresh_job_status_locked(index, *key)
+            self._bump_locked("allocs", index)
 
     # -- native service discovery (derived from task liveness) --
     def _sync_services_locked(self, index: int, alloc) -> None:
@@ -754,14 +757,16 @@ class StateStore(StateSnapshot):
             for k in desired))
         if same:
             return
-        for k in current.keys() - desired.keys():
+        # sorted: the table dict's residual insertion order must not
+        # depend on set-difference order (nomadlint FSM103)
+        for k in sorted(current.keys() - desired.keys()):
             del self._t["services"][k]
         for k, reg in desired.items():
             old = current.get(k)
             if old is not None:
                 reg.create_index = old.create_index
             self._t["services"][k] = reg
-        self._bump("services", index)
+        self._bump_locked("services", index)
 
     def _drop_services_locked(self, index: int, alloc_id: str,
                               bump: bool = True) -> bool:
@@ -770,7 +775,7 @@ class StateStore(StateSnapshot):
         for k in doomed:
             del self._t["services"][k]
         if doomed and bump:
-            self._bump("services", index)
+            self._bump_locked("services", index)
         return bool(doomed)
 
     def service_names(self, namespace: str = "default"):
@@ -795,13 +800,13 @@ class StateStore(StateSnapshot):
                       data: Dict[str, str]) -> None:
         with self._lock:
             self._t["secrets"][(namespace, path)] = dict(data)
-            self._bump("secrets", index)
+            self._bump_locked("secrets", index)
 
     def delete_secret(self, index: int, namespace: str,
                       path: str) -> None:
         with self._lock:
             self._t["secrets"].pop((namespace, path), None)
-            self._bump("secrets", index)
+            self._bump_locked("secrets", index)
 
     def secret_by_path(self, namespace: str, path: str):
         with self._lock:
@@ -817,7 +822,7 @@ class StateStore(StateSnapshot):
     def set_acl_bootstrapped(self, index: int) -> None:
         with self._lock:
             self._t["cluster_meta"]["acl_bootstrapped"] = True
-            self._bump("cluster_meta", index)
+            self._bump_locked("cluster_meta", index)
 
     def acl_bootstrapped(self) -> bool:
         with self._lock:
@@ -831,12 +836,12 @@ class StateStore(StateSnapshot):
             p.create_index = existing.create_index if existing else index
             p.modify_index = index
             self._t["acl_policies"][p.name] = p
-            self._bump("acl_policies", index)
+            self._bump_locked("acl_policies", index)
 
     def delete_acl_policy(self, index: int, name: str) -> None:
         with self._lock:
             self._t["acl_policies"].pop(name, None)
-            self._bump("acl_policies", index)
+            self._bump_locked("acl_policies", index)
 
     def acl_policy_by_name(self, name: str):
         with self._lock:
@@ -855,12 +860,12 @@ class StateStore(StateSnapshot):
             t.create_index = existing.create_index if existing else index
             t.modify_index = index
             self._t["acl_tokens"][t.accessor_id] = t
-            self._bump("acl_tokens", index)
+            self._bump_locked("acl_tokens", index)
 
     def delete_acl_token(self, index: int, accessor_id: str) -> None:
         with self._lock:
             self._t["acl_tokens"].pop(accessor_id, None)
-            self._bump("acl_tokens", index)
+            self._bump_locked("acl_tokens", index)
 
     def acl_token_by_accessor(self, accessor_id: str):
         with self._lock:
@@ -893,7 +898,7 @@ class StateStore(StateSnapshot):
                 v.create_index = existing.create_index
             v.modify_index = index
             self._t["csi_volumes"][(v.namespace, v.id)] = v
-            self._bump("csi_volumes", index)
+            self._bump_locked("csi_volumes", index)
 
     def delete_csi_volume(self, index: int, namespace: str,
                           vol_id: str) -> None:
@@ -902,7 +907,7 @@ class StateStore(StateSnapshot):
             if v is not None and v.in_use():
                 raise ValueError(f"volume {vol_id} is in use")
             self._t["csi_volumes"].pop((namespace, vol_id), None)
-            self._bump("csi_volumes", index)
+            self._bump_locked("csi_volumes", index)
 
     def csi_volume_by_id(self, namespace: str, vol_id: str):
         with self._lock:
@@ -927,7 +932,7 @@ class StateStore(StateSnapshot):
             v2.claim(mode, alloc_id, node_id)
             v2.modify_index = index
             self._t["csi_volumes"][(namespace, vol_id)] = v2
-            self._bump("csi_volumes", index)
+            self._bump_locked("csi_volumes", index)
 
     def release_csi_claims(self, index: int, alloc_id: str) -> None:
         with self._lock:
@@ -947,7 +952,7 @@ class StateStore(StateSnapshot):
                 self._t["csi_volumes"][key] = v2
                 changed = True
         if changed:
-            self._bump("csi_volumes", index)
+            self._bump_locked("csi_volumes", index)
 
     def update_alloc_desired_transition(self, index: int, alloc_ids: List[str],
                                         transition) -> None:
@@ -961,7 +966,7 @@ class StateStore(StateSnapshot):
                 a.desired_transition = transition
                 a.modify_index = index
                 self._t["allocs"][aid] = a
-            self._bump("allocs", index)
+            self._bump_locked("allocs", index)
 
     # -- plan results (the single commit path; reference fsm.go:918) --
     def upsert_plan_results(self, index: int, result: PlanResult,
@@ -996,15 +1001,16 @@ class StateStore(StateSnapshot):
                       result.node_preemptions):
                 for allocs in m.values():
                     touched.update((a.namespace, a.job_id) for a in allocs)
-            for key in touched:
-                self._refresh_job_status(index, *key)
-            self._bump("allocs", index)
+            # sorted for replica determinism (nomadlint FSM103)
+            for key in sorted(touched):
+                self._refresh_job_status_locked(index, *key)
+            self._bump_locked("allocs", index)
 
     # -- deployments --
     def upsert_deployment(self, index: int, dep: Deployment) -> None:
         with self._lock:
             self._upsert_deployment_locked(index, dep)
-            self._bump("deployments", index)
+            self._bump_locked("deployments", index)
 
     def _upsert_deployment_locked(self, index: int, dep: Deployment) -> None:
         existing = self._t["deployments"].get(dep.id)
@@ -1042,7 +1048,7 @@ class StateStore(StateSnapshot):
         with self._lock:
             for du in updates:
                 self._apply_deployment_update_locked(index, du)
-            self._bump("deployments", index)
+            self._bump_locked("deployments", index)
 
     def update_deployment_promotion(self, index: int, dep_id: str,
                                     groups=None) -> None:
@@ -1063,13 +1069,13 @@ class StateStore(StateSnapshot):
             d2.status_description = "Deployment is running"
             d2.modify_index = index
             self._t["deployments"][dep_id] = d2
-            self._bump("deployments", index)
+            self._bump_locked("deployments", index)
 
     def delete_deployment(self, index: int, dep_ids: List[str]) -> None:
         with self._lock:
             for did in dep_ids:
                 self._t["deployments"].pop(did, None)
-            self._bump("deployments", index)
+            self._bump_locked("deployments", index)
 
     # -- scheduler config --
     def set_scheduler_config(self, index: int,
@@ -1077,14 +1083,15 @@ class StateStore(StateSnapshot):
         with self._lock:
             cfg.modify_index = index
             self._t["scheduler_config"]["config"] = cfg
-            self._bump("scheduler_config", index)
+            self._bump_locked("scheduler_config", index)
 
     # -- periodic launches --
     def upsert_periodic_launch(self, index: int, namespace: str, job_id: str,
                                launch_time: float) -> None:
         with self._lock:
             self._t["periodic_launches"][(namespace, job_id)] = launch_time
-            self._bump("periodic_launches", index)
+            self._bump_locked("periodic_launches", index)
 
     def periodic_launch(self, namespace: str, job_id: str) -> Optional[float]:
-        return self._t["periodic_launches"].get((namespace, job_id))
+        with self._lock:    # guarded table; lockless read is racy
+            return self._t["periodic_launches"].get((namespace, job_id))

@@ -1,0 +1,372 @@
+"""nomadlint (nomad_tpu.analysis): each pass must catch its synthetic
+violation fixture, stay quiet on the clean twin, and the real package
+must carry zero unsuppressed findings.
+
+The fixtures are written as source files into a throwaway package —
+the analyzer is pure AST and never imports them, so they can reference
+jax freely without a device (and contain deliberate bugs without
+runtime consequences)."""
+import textwrap
+
+import pytest
+
+from nomad_tpu.analysis import (AnalysisConfig, BaselineError, analyze,
+                                default_baseline_path, load_baseline)
+from nomad_tpu.analysis.baseline import parse_baseline_text
+from nomad_tpu.analysis.core import PackageIndex
+
+
+def write_fixture(tmp_path, files):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+FIX_STORE = """
+    import time
+    import uuid
+
+
+    class FakeStore:
+        def __init__(self):
+            self._t = {"things": {}}
+
+        def upsert_thing(self, index, p):      # clean mutator
+            for key in sorted({("a", 1), ("b", 2)}):
+                self._t["things"][key] = index
+
+        def stamp_thing(self, index):
+            self._t["things"]["ts"] = time.time()          # FSM101
+
+        def tag_thing(self, index):
+            self._t["things"]["id"] = str(uuid.uuid4())    # FSM102
+
+        def shuffle_thing(self, index):
+            for key in {("x", 1), ("y", 2)}:               # FSM103
+                self._t["things"][key] = index
+"""
+
+FIX_FSM = """
+    from .store import FakeStore
+
+
+    class FSM:
+        def __init__(self, store: FakeStore):
+            self.store = store
+
+        def apply(self, index, p):
+            self._ap_upsert(index, p)
+
+        def _ap_upsert(self, index, p):
+            self.store.upsert_thing(index, p)
+            self.store.stamp_thing(index)
+            self.store.tag_thing(index)
+            self.store.shuffle_thing(index)
+"""
+
+FIX_ROGUE = """
+    from .store import FakeStore
+
+
+    def sneak_write(store: FakeStore):
+        store.upsert_thing(1, None)                        # FSM104
+
+
+    def innocent_read(store: FakeStore):
+        return store._t
+"""
+
+FIX_JIT = """
+    import functools
+    import logging
+
+    import jax
+
+    _log = logging.getLogger(__name__)
+    _CACHE = {}
+
+
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def good_kernel(x, mode="a"):
+        if mode == "a":          # static branch: fine
+            return x + 1
+        return x - 1
+
+
+    @jax.jit
+    def noisy_kernel(x):
+        print("tracing")                                   # JIT201
+        _log.info("traced")                                # JIT201
+        return x
+
+
+    @jax.jit
+    def branchy_kernel(x, flag):
+        if flag:                                           # JIT203
+            return x
+        return -x
+
+
+    @jax.jit
+    def leaky_kernel(x):
+        _CACHE["k"] = x                                    # JIT202
+        return x
+
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def donating_update(arr, rows):
+        return arr.at[0].set(rows)
+
+
+    def bad_caller(arr, rows):
+        out = donating_update(arr, rows)
+        return out + arr.sum()                             # JIT204
+
+
+    def good_caller(arr, rows):
+        arr = donating_update(arr, rows)
+        return arr + 1                # rebound to the result: fine
+"""
+
+FIX_LOCKS = """
+    import threading
+
+    _G = {}
+    _G_LOCK = threading.Lock()
+
+
+    def fill(k, v):
+        _G[k] = v                                          # LOCK303
+
+
+    def fill_safe(k, v):
+        with _G_LOCK:
+            _G[k] = v
+
+
+    class Chatty:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}
+            self._worker = None
+            self._enabled = False
+
+        def start(self):
+            self._worker = threading.Thread(target=self._run)  # LOCK301
+            self._worker.start()
+
+        def set_enabled(self, enabled):
+            with self._lock:
+                self._enabled = enabled
+
+        @property
+        def enabled(self):
+            return self._enabled                           # LOCK302
+
+        def _run(self):
+            with self._lock:
+                self._state["x"] = 1
+
+
+    class Quiet:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}
+            self._worker = None
+
+        def start(self):
+            with self._lock:
+                self._worker = threading.Thread(target=self._run)
+                self._worker.start()
+
+        @property
+        def state(self):
+            with self._lock:
+                return dict(self._state)
+
+        def _run(self):
+            with self._lock:
+                self._state["x"] = 1
+
+
+    class TwoLocks:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self.one)
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:                              # LOCK304
+                    pass
+"""
+
+
+FIX_CFG = AnalysisConfig(
+    fsm_roots=("fixpkg.fsm:FSM.apply", "fixpkg.fsm:FSM._ap_*"),
+    store_module="fixpkg.store",
+    store_class="FakeStore",
+    lock_module_prefixes=("fixpkg",),
+)
+
+
+@pytest.fixture(scope="module")
+def fixture_report(tmp_path_factory):
+    root = write_fixture(tmp_path_factory.mktemp("lintfix"), {
+        "store.py": FIX_STORE,
+        "fsm.py": FIX_FSM,
+        "rogue.py": FIX_ROGUE,
+        "jitmod.py": FIX_JIT,
+        "locks.py": FIX_LOCKS,
+    })
+    return analyze(package_dir=root, package_name="fixpkg",
+                   use_baseline=False, config=FIX_CFG)
+
+
+def _keys(report, rule):
+    return {f.key for f in report.findings if f.rule == rule}
+
+
+# ---------------------------------------------------------- FSM pass
+def test_fsm_wall_clock_detected(fixture_report):
+    assert _keys(fixture_report, "FSM101") == {
+        "FSM101:fixpkg.store:FakeStore.stamp_thing:time.time"}
+
+
+def test_fsm_randomness_detected(fixture_report):
+    assert _keys(fixture_report, "FSM102") == {
+        "FSM102:fixpkg.store:FakeStore.tag_thing:uuid.uuid4"}
+
+
+def test_fsm_set_iteration_detected_sorted_twin_clean(fixture_report):
+    keys = _keys(fixture_report, "FSM103")
+    assert any("shuffle_thing" in k for k in keys)
+    # the sorted() twin in upsert_thing must NOT fire
+    assert not any("upsert_thing" in k for k in keys)
+
+
+def test_fsm_out_of_band_mutation_detected(fixture_report):
+    keys = _keys(fixture_report, "FSM104")
+    assert keys == {
+        "FSM104:fixpkg.rogue:sneak_write:FakeStore.upsert_thing"}
+
+
+# ---------------------------------------------------------- jit pass
+def test_jit_host_effects_detected_clean_twin_quiet(fixture_report):
+    keys = _keys(fixture_report, "JIT201")
+    assert "JIT201:fixpkg.jitmod:noisy_kernel:print" in keys
+    assert any(k.startswith("JIT201:fixpkg.jitmod:noisy_kernel:_log")
+               for k in keys)
+    assert not any(":good_kernel:" in k for k in keys)
+
+
+def test_jit_global_mutation_detected(fixture_report):
+    assert _keys(fixture_report, "JIT202") == {
+        "JIT202:fixpkg.jitmod:leaky_kernel:_CACHE"}
+
+
+def test_jit_retrace_hazard_detected_static_twin_quiet(fixture_report):
+    keys = _keys(fixture_report, "JIT203")
+    assert keys == {"JIT203:fixpkg.jitmod:branchy_kernel:flag"}
+
+
+def test_jit_donated_read_detected_rebind_twin_quiet(fixture_report):
+    keys = _keys(fixture_report, "JIT204")
+    assert keys == {"JIT204:fixpkg.jitmod:bad_caller:arr"}
+
+
+# --------------------------------------------------------- lock pass
+def test_lock_unguarded_write_detected_clean_twin_quiet(fixture_report):
+    keys = _keys(fixture_report, "LOCK301")
+    assert keys == {"LOCK301:fixpkg.locks:Chatty.start:_worker"}
+
+
+def test_lock_racy_getter_detected(fixture_report):
+    keys = _keys(fixture_report, "LOCK302")
+    assert "LOCK302:fixpkg.locks:Chatty.enabled:_enabled" in keys
+    assert not any(":Quiet." in k for k in keys)
+
+
+def test_lock_global_mutation_detected_guarded_twin_quiet(
+        fixture_report):
+    keys = _keys(fixture_report, "LOCK303")
+    assert "LOCK303:fixpkg.locks:fill:_G" in keys
+    # the module-lock-guarded twin stays quiet
+    assert not any(":fill_safe:" in k for k in keys)
+    # (leaky_kernel's global write legitimately fires here too — a jit
+    # closure mutating a module global is both a purity and a lock
+    # problem)
+
+
+def test_lock_ordering_cycle_detected(fixture_report):
+    keys = _keys(fixture_report, "LOCK304")
+    assert len(keys) == 1
+    assert "TwoLocks._a" in next(iter(keys))
+
+
+# ----------------------------------------------------- baseline rules
+def test_baseline_requires_justification():
+    with pytest.raises(BaselineError):
+        parse_baseline_text(
+            'version = 1\n[[suppress]]\nrule = "FSM101"\n'
+            'key = "FSM101:m:f:time.time"\n')
+    with pytest.raises(BaselineError):
+        parse_baseline_text(
+            '[[suppress]]\nrule = "FSM101"\n'
+            'key = "FSM101:m:f:time.time"\njustification = "  "\n')
+
+
+def test_baseline_suppresses_matching_finding(tmp_path):
+    root = write_fixture(tmp_path, {"store.py": FIX_STORE,
+                                    "fsm.py": FIX_FSM})
+    bl = parse_baseline_text(
+        '[[suppress]]\nrule = "FSM101"\n'
+        'key = "FSM101:fixpkg.store:FakeStore.stamp_thing:*"\n'
+        'justification = "fixture"\n')
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  baseline=bl, config=FIX_CFG)
+    assert not _keys(rep, "FSM101")
+    assert any(f.rule == "FSM101" for f in rep.suppressed)
+    assert rep.stale_baseline_keys == []
+
+
+# -------------------------------------------------- the real package
+def test_repo_baseline_is_valid_and_fresh():
+    bl = load_baseline(default_baseline_path())   # raises on missing
+    assert all(e.get("justification", "").strip()  # justifications
+               for e in bl.entries)
+
+
+def test_repo_has_zero_unsuppressed_findings():
+    """The tier-1 gate: any new unsuppressed finding fails the suite.
+    Fix the code or add a JUSTIFIED baseline entry."""
+    rep = analyze()
+    assert rep.ok, "unsuppressed nomadlint findings:\n" + "\n".join(
+        f.render() for f in rep.findings)
+    # and the baseline itself must not rot
+    assert rep.stale_baseline_keys == [], (
+        "baseline entries matching nothing (remove them): "
+        f"{rep.stale_baseline_keys}")
+
+
+def test_repo_index_sanity():
+    """The call graph actually resolved the load-bearing edges (guards
+    against the passes going silently blind after a refactor)."""
+    import os
+    import nomad_tpu
+    pkg_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(nomad_tpu.__file__)))
+    idx = PackageIndex.build(pkg_dir, "nomad_tpu")
+    apply_key = "nomad_tpu.raft.fsm:StateFSM._ap_node_upsert"
+    assert ("nomad_tpu.state.store:StateStore.upsert_node"
+            in idx.callees(apply_key))
+    reach = idx.reachable([apply_key])
+    assert "nomad_tpu.state.store:StateStore._bump_locked" in reach

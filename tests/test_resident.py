@@ -206,3 +206,43 @@ def test_merge_asks_semantics():
     assert keys == {("default", f"j{i}") for i in range(1, 5)}
     pb = rs.pack_batch(merged, job_keys=keys)
     assert pb.job_keys == keys
+
+
+def test_steady_state_waves_zero_recompiles():
+    """Retrace-count regression guard (ISSUE 3 satellite): after the
+    first wave compiles the stream kernel, identical-shape steady-state
+    waves must hit the jit cache — zero new compiled variants. A
+    failure here means a dispatch argument stopped being
+    shape/static-stable and every eval is paying a silent recompile."""
+    nodes = make_nodes(16)
+    probe = [make_ask(count=2, rack="r1", spread=True), make_ask(count=2)]
+    rs = ResidentSolver(nodes, probe, pallas="off")
+    asks = [make_ask(count=2)]
+    pb = rs.pack_batch(asks)
+    assert pb is not None
+    rs.solve_stream([pb])            # warm-up: pays the one compile
+    c0 = ResidentSolver.compile_count()
+    if c0 < 0:
+        pytest.skip("jit compile-cache probe unavailable in this jax")
+    for _ in range(3):
+        pb2 = rs.pack_batch(asks)    # fresh pack, same shapes
+        rs.solve_stream([pb2])
+    assert ResidentSolver.compile_count() == c0, \
+        "steady-state waves triggered a recompile"
+
+
+def test_pipelined_steady_state_zero_recompiles():
+    """The double-buffered pipelined schedule must be as retrace-free
+    as the plain stream: chunked waves over one resident universe
+    reuse the single compiled variant."""
+    nodes = make_nodes(16)
+    probe = [make_ask(count=2, rack="r1", spread=True), make_ask(count=2)]
+    rs = ResidentSolver(nodes, probe, pallas="off")
+    chunks = [[make_ask(count=2)], [make_ask(count=2)]]
+    rs.solve_stream_pipelined(chunks)    # warm-up
+    c0 = ResidentSolver.compile_count()
+    if c0 < 0:
+        pytest.skip("jit compile-cache probe unavailable in this jax")
+    rs.solve_stream_pipelined([[make_ask(count=2)], [make_ask(count=2)]])
+    assert ResidentSolver.compile_count() == c0, \
+        "pipelined steady-state waves triggered a recompile"
