@@ -14,9 +14,14 @@ Rules
   JIT202  global/closure mutation reachable from a jit/pallas root
           (trace-time write = tracer leak / stale capture)
   JIT203  non-static jit parameter used in Python control flow
-          (retrace bomb / trace error)
+          (retrace bomb / trace error) — if/while/ternary tests AND
+          `for _ in range(param)` loop bounds (the shortlist-era
+          kernel surface: widths like shortlist_c drive Python loop
+          unrolling and MUST be static)
   JIT204  buffer passed at a donated position read again after the
-          dispatch
+          dispatch — including subscript/attribute reads through the
+          donated name (`carry[0]` after donating `carry`, the
+          wave-loop carry shape)
 """
 from __future__ import annotations
 
@@ -258,6 +263,14 @@ def run_jit_pass(index: PackageIndex, cfg: AnalysisConfig
                 test = node.test
             elif isinstance(node, ast.IfExp):
                 test = node.test
+            elif isinstance(node, ast.For):
+                # `for _ in range(param)`: the loop unrolls at trace
+                # time — a traced bound retraces per value exactly like
+                # a traced `if` (the shortlist-width class of hazard)
+                it = node.iter
+                if isinstance(it, ast.Call) and \
+                        _dotted(it.func) in ("range", "builtins.range"):
+                    test = it
             if test is None:
                 continue
             for sub in ast.walk(test):
@@ -344,8 +357,17 @@ def _check_donated_reads(index: PackageIndex, fi,
         rebind_line = min((ln for k, ln in rebinds
                            if k == key and ln >= cline),
                           default=None)
+        # a bare donated NAME is also dead through subscript/attribute
+        # reads (`carry[0]` / `carry.shape` after donating `carry` —
+        # the wave-loop carry shape)
+        bare = "[" not in key and "." not in key
+
+        def _hits(k):
+            return k == key or (bare and (k.startswith(key + "[")
+                                          or k.startswith(key + ".")))
+
         for k, ln in loads:
-            if k != key or ln <= cline:
+            if not _hits(k) or ln <= cline:
                 continue
             if rebind_line is not None and ln >= rebind_line:
                 continue

@@ -63,7 +63,11 @@ def _federated_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
             # exactly as many waves as the slowest region needs
             return _solve_one(av, rs_, vl, ndc, ar, dcp, u, du, b, n, s,
                               has_spread, group_count_hint, max_waves,
-                              "while", has_distinct, has_devices)
+                              "while", has_distinct, has_devices,
+                              # under the region vmap the shortlist
+                              # cond lowers to select (both branches
+                              # run every wave) — keep it off
+                              shortlist_c=-1)
 
         res = jax.vmap(one_region)(avail, reserved, valid, node_dc,
                                    attr_rank, dev_cap, used, dev_used,

@@ -102,7 +102,9 @@ def sharded_solve(pb: PackedBatch, mesh: Mesh):
 # select and pays the full wave budget per lane (see kernel.py loop-
 # shape note); the while_loop runs only as deep as the slowest region.
 _federated_kernel = jax.jit(jax.vmap(
-    functools.partial(solve_kernel, wave_mode="while")))
+    # shortlist off: under vmap its cond degrades to select and both
+    # branches would execute every wave for every lane
+    functools.partial(solve_kernel, wave_mode="while", shortlist_c=-1)))
 
 
 def federated_solve(pbs: Sequence[PackedBatch], mesh: Mesh):

@@ -13,6 +13,15 @@ tie-breaks), differential-tested to produce identical placements.
 Scope: exact only where the device kernel is exact — the dispatch
 gate (`prefer_host`) excludes padded node counts that would take the
 device's `approx_max_k` path, so host argsort and device top_k agree.
+
+Shortlist note (ISSUE 4): the device kernel's contention waves may
+re-rank a carried top-C shortlist instead of re-scoring all N
+(kernel.py `shortlist_c`).  This twin deliberately stays FULL-RESCORE
+on every wave: it is the semantic reference the shortlist path must
+equal bit-for-bit — the kernel only takes a shortlist wave when its
+validity triggers PROVE the result identical to this full rescore,
+and escapes back to a full-N wave otherwise.  tests/test_shortlist.py
+pins that contract; `n_rescore == n_waves` here by construction.
 """
 from __future__ import annotations
 
@@ -487,7 +496,7 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         dim_exhausted=out_dimexh, feas=feas,
         cons_filtered=cons_filtered, used_final=used,
         dev_used_final=dev_used, n_waves=np.int32(wave),
-        unfinished=unfinished)
+        unfinished=unfinished, n_rescore=np.int32(wave))
 
 
 class HostResidentSolver:

@@ -152,13 +152,95 @@ class SolveResult(NamedTuple):
     n_waves: jnp.ndarray       # [] wave-loop iterations that did work
     unfinished: jnp.ndarray    # [K] active but undecided after MAX_WAVES
     #  (rare; absorbed by the blocked-eval retry path)
+    n_rescore: jnp.ndarray = None  # [] waves that ran the full-N pass
+    #  (shortlist-resident waves make up n_waves - n_rescore; None when
+    #   a kernel predates / sidesteps the shortlist path)
+
+
+# ------------------------------------------------------- shortlist
+# Contention waves (waves >= 2) only ever re-rank nodes that already
+# scored on top: the carried per-group top-C shortlist lets them gather
+# live usage for <= C nodes and re-rank in VMEM instead of re-reading
+# every [Gp, Np] plane from HBM.  Exactness is trigger-guarded — see
+# solve_kernel's wave loop.
+_SHORTLIST_TILE = 128          # auto width rounds up to this
+
+
+class _SLState(NamedTuple):
+    """Wave-loop carry for the shortlist-resident contention path.
+
+    Per-entry planes are [Gp, C] gathered once per full-N wave; `vn` /
+    `de` are the hoisted spread lookups restricted to shortlist nodes.
+    `cut_s`/`cut_i` hold the era cutoff key (the C-th best (score,
+    node) at the building wave): every non-shortlisted node's key was
+    strictly worse and — under the validity triggers — stays frozen,
+    so a re-ranked window whose TK-th key still dominates the cutoff
+    provably equals the full-N window.  `comp` marks groups whose
+    entire placeable set fit inside C (outsiders are permanently
+    NEG_INF: every trigger is bypassed).  `win_*`/`nfeas`/`nexh`/
+    `ndim`/`gany` are the NEXT wave's pre-computed window and
+    explainability counters; `ok` gates using them."""
+    idx: jnp.ndarray           # [Gp, C] node ids, ascending
+    feas: jnp.ndarray          # [Gp, C] static feasibility
+    pen: jnp.ndarray           # [Gp, C] penalty flag
+    aff: jnp.ndarray           # [Gp, C] affinity score
+    vn: jnp.ndarray            # [S, Gp, C] spread value ranks
+    de: jnp.ndarray            # [S, Gp, C] spread desired counts
+    coll: jnp.ndarray          # [Gp, C] own-group collocation counts
+    cut_s: jnp.ndarray         # [Gp] era cutoff score
+    cut_i: jnp.ndarray         # [Gp] era cutoff node id
+    comp: jnp.ndarray          # [Gp] shortlist holds ALL placeable
+    nfeas: jnp.ndarray         # [Gp] n_feasible for the next wave
+    nexh: jnp.ndarray          # [Gp] n_exhausted for the next wave
+    ndim: jnp.ndarray          # [Gp, R] dim_exhausted for the next wave
+    win_s: jnp.ndarray         # [Gp, TK] next wave's window scores
+    win_i: jnp.ndarray         # [Gp, TK] next wave's window nodes
+    gany: jnp.ndarray          # [Gp] next wave's grp_any
+    ok: jnp.ndarray            # [] next wave may skip the full pass
+
+
+def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
+    """Static shortlist width C for a solve (0 = path disabled).
+
+    `requested` 0 auto-sizes: the candidate window TK rounded UP to the
+    next _SHORTLIST_TILE multiple (so there is always slack above the
+    window for entries that drain), clamped to the node axis.  -1
+    disables the path.  Explicit values are validated — never silently
+    clamped: they must cover TOP_K fall-through slots, lie within the
+    node axis, satisfy lane alignment (multiple of 8), and be at least
+    the candidate window TK (narrower could not even fill one wave's
+    window).  NOMAD_TPU_SHORTLIST_C feeds this via ResidentSolver."""
+    if requested == -1:
+        return 0
+    if requested in (0, None):
+        return min(Np, (TK // _SHORTLIST_TILE + 1) * _SHORTLIST_TILE)
+    if not isinstance(requested, int) or requested < TOP_K:
+        raise ValueError(
+            f"shortlist_c={requested!r} invalid: must be -1 (off), 0 "
+            f"(auto) or an int >= TOP_K ({TOP_K})")
+    if requested % 8:
+        raise ValueError(
+            f"shortlist_c={requested} invalid: must be a multiple of 8 "
+            "(vector lane alignment)")
+    if requested > Np:
+        raise ValueError(
+            f"shortlist_c={requested} exceeds the padded node axis "
+            f"({Np}); pick <= Np — it will not be clamped silently")
+    if requested < TK:
+        raise ValueError(
+            f"shortlist_c={requested} is narrower than the candidate "
+            f"window TK={TK} for this problem shape; the shortlist "
+            "could not fill a single wave's window. Pass a value >= TK "
+            "or 0 for auto sizing")
+    return requested
 
 
 @functools.partial(jax.jit,
                    static_argnames=("has_spread", "group_count_hint",
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
-                                    "stack_commit", "pallas_mode"))
+                                    "stack_commit", "pallas_mode",
+                                    "shortlist_c"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -169,7 +251,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  group_count_hint=0, max_waves=0,
                  wave_mode="scan", has_distinct=True,
                  has_devices=True, stack_commit=False,
-                 pallas_mode="off") -> SolveResult:
+                 pallas_mode="off", shortlist_c=0) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -197,6 +279,14 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
     TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, Np)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
+    # shortlist width C (0 = disabled): waves >= 2 re-rank the carried
+    # top-C instead of re-reading the full node planes, whenever the
+    # validity triggers prove the result identical to a full rescore.
+    # distinct_hosts blocking mutates feasibility across groups through
+    # nodes outside any shortlist — those batches always full-rescore.
+    C = 0 if has_distinct else resolve_shortlist_c(Np, TK, shortlist_c)
+    use_sl = C > 0
+    NE = C if use_sl else TK        # full-wave extraction width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)
 
@@ -306,8 +396,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     use_pk = pallas_mode != "off"
     if use_pk:
         from . import pallas_kernel as _pk
-        pk_feas = feas.astype(jnp.int8)
-        pk_pen = penalty.astype(jnp.int8)
+        from .masks import pack_bool_u32
+        # bitpacked static planes: 32 node columns per uint32 lane —
+        # 1/8th the bytes of the int8 planes on every full wave's
+        # HBM re-read (packed ONCE per solve, outside the wave loop)
+        pk_feas = pack_bool_u32(feas)
+        pk_pen = pack_bool_u32(penalty)
         pk_sp_has = ((sp_col >= 0).astype(jnp.int8) if has_spread
                      else None)
         # int16 value ranks: bounded by the padded vocab (< 2^15
@@ -407,155 +501,367 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         score = jnp.where(placeable, total, NEG_INF)
         return score, placeable, feas_b, fit, fit_dims, dev_fit
 
+    # ---------- shortlist scoring twin ----------
+    Vs_i = sp_desired.shape[2]
+    want_tables = has_spread and Vs_i <= 8 and not stack_commit
+    if use_sl:
+        def _sl_eval(sl, used_x, dev_used_x, sp_used_x):
+            """EXACT score/indicator recompute for the <= C shortlist
+            entries from gathered live state.  Every float expression
+            mirrors group_scores term for term (same op order), so the
+            result is bitwise the full rescore restricted to these
+            nodes.  Returns (score, placeable, exh_ind, dim_ind)."""
+            idx = sl.idx
+            u = used_x[idx]                            # [Gp, C, R]
+            av = avail[idx]
+            rsv = reserved[idx]
+            after = u + ask_res[:, None, :]
+            fit_dims = after <= av
+            fit = fit_dims.all(axis=-1)
+            if has_devices:
+                dev_fit = (dev_used_x[idx] + dev_ask[:, None, :]
+                           <= dev_cap[idx]).all(axis=-1)
+            else:
+                dev_fit = jnp.ones((Gp, C), bool)
+            placeable = sl.feas & fit & dev_fit
+
+            denom_cpu = av[:, :, R_CPU]
+            denom_mem = av[:, :, R_MEM]
+            util_cpu = after[:, :, R_CPU] + rsv[:, :, R_CPU]
+            util_mem = after[:, :, R_MEM] + rsv[:, :, R_MEM]
+            ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+            free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
+            free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
+            raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
+            binpack = jnp.where(ok_denoms,
+                                jnp.clip(raw, 0.0, 18.0) / 18.0, 0.0)
+
+            anti = jnp.where(sl.coll > 0,
+                             -(sl.coll + 1.0) / ask_desired[:, None],
+                             0.0)
+            anti_counts = sl.coll > 0
+
+            if has_spread:
+                spread_total = jnp.zeros((Gp, C), jnp.float32)
+                for s in range(S):
+                    col = sp_col[:, s]
+                    has = col >= 0
+                    v = sl.vn[s]
+                    has_v = v >= 0
+                    used_vec = sp_used_x[:, s]
+                    cur = jnp.where(v >= 0, jnp.take_along_axis(
+                        used_vec, jnp.maximum(v, 0), axis=1), 0.0)
+                    desired = sl.de[s]
+                    boost = ((desired - (cur + 1.0))
+                             / jnp.maximum(desired, 1e-9)
+                             ) * sp_weight[:, s][:, None]
+                    targeted = jnp.where(~has_v, -1.0,
+                                         jnp.where(desired <= 0, -1.0,
+                                                   boost))
+                    present = used_vec > 0
+                    any_present = present.any(axis=1)[:, None]
+                    minc = jnp.min(jnp.where(present, used_vec,
+                                             jnp.inf), axis=1)[:, None]
+                    maxc = jnp.max(jnp.where(present, used_vec,
+                                             -jnp.inf), axis=1)[:, None]
+                    delta_boost = (minc - cur) / jnp.maximum(minc, 1e-9)
+                    even = jnp.where(cur != minc, delta_boost,
+                                     jnp.where(minc == maxc, -1.0,
+                                               (maxc - minc)
+                                               / jnp.maximum(minc,
+                                                             1e-9)))
+                    even = jnp.where(~has_v, -1.0, even)
+                    even = jnp.where(any_present, even, 0.0)
+                    contrib = jnp.where(sp_targeted[:, s][:, None],
+                                        targeted, even)
+                    spread_total = spread_total + jnp.where(
+                        has[:, None], contrib, 0.0)
+                spread_counts = spread_total != 0.0
+            else:
+                spread_total = 0.0
+                spread_counts = False
+
+            aff_counts = sl.aff != 0.0
+            pen_sc = jnp.where(sl.pen, -1.0, 0.0)
+            n_scorers = (1.0 + anti_counts + sl.pen + aff_counts
+                         + spread_counts)
+            total = (binpack + anti + pen_sc + sl.aff
+                     + spread_total) / n_scorers
+            total = jnp.where(jnp.int32(seed) == 0, total,
+                              jnp.floor(total / SCORE_BIN) * SCORE_BIN)
+            h2 = (idx.astype(jnp.uint32) * jnp.uint32(2654435761)
+                  + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
+                     + jnp.uint32(seed)) * jnp.uint32(40503))
+            h2 = (h2 ^ (h2 >> 16)) * jnp.uint32(2246822519)
+            jit_sl = jnp.where(jnp.int32(seed) == 0, 0.0,
+                               (h2 & jnp.uint32(1023)).astype(
+                                   jnp.float32) * (SCORE_BIN / 1023.0))
+            total = total + jit_sl
+            score = jnp.where(placeable, total, NEG_INF)
+            exh = sl.feas & ~(fit & dev_fit)
+            dim_ind = sl.feas[:, :, None] & ~fit_dims
+            return score, placeable, exh, dim_ind
+
+        def _lex_topk(score, idx, k):
+            """Descending (score, ascending node id) top-k — the exact
+            tie order lax.top_k uses over the full node axis."""
+            neg, six = lax.sort((-score, idx), num_keys=2)
+            return -neg[:, :k], six[:, :k]
+
+        sl0 = _SLState(
+            idx=jnp.zeros((Gp, C), jnp.int32),
+            feas=jnp.zeros((Gp, C), bool),
+            pen=jnp.zeros((Gp, C), bool),
+            aff=jnp.zeros((Gp, C), jnp.float32),
+            vn=jnp.zeros((S, Gp, C) if has_spread else (1, 1, 1),
+                         jnp.int32),
+            de=jnp.zeros((S, Gp, C) if has_spread else (1, 1, 1),
+                         jnp.float32),
+            coll=jnp.zeros((Gp, C), jnp.float32),
+            cut_s=jnp.zeros(Gp, jnp.float32),
+            cut_i=jnp.zeros(Gp, jnp.int32),
+            comp=jnp.zeros(Gp, bool),
+            nfeas=jnp.zeros(Gp, jnp.int32),
+            nexh=jnp.zeros(Gp, jnp.int32),
+            ndim=jnp.zeros((Gp, R), jnp.int32),
+            win_s=jnp.full((Gp, TK), NEG_INF, jnp.float32),
+            win_i=jnp.zeros((Gp, TK), jnp.int32),
+            gany=jnp.zeros(Gp, bool),
+            ok=jnp.bool_(False))
+    else:
+        sl0 = None
+
     # ---------- wave loop ----------
     # The carry is kept COMPACT (per-placement vectors, no [Gp, Np]
     # matrices): tunneled transports copy the whole carry every
     # iteration, so collocation counts and distinct-hosts blocking are
     # rebuilt each wave from the committed outputs with one scatter
-    # instead of being carried.
+    # instead of being carried.  The shortlist-resident path
+    # additionally carries the [Gp, C] shortlist state (_SLState) and a
+    # wave splits statically into:
+    #
+    #   full wave  — scores all N (pallas fused or jnp), extracts the
+    #                top-C shortlist along with the TK window;
+    #   shortlist  — uses the window + counters pre-computed at the end
+    #                of the previous wave from the carried shortlist
+    #                (fresh gathers of live usage, bitwise the full
+    #                rescore restricted to those nodes).
+    #
+    # Validity is decided at the END of each wave, when the post-commit
+    # state already equals the next wave's input: the carried window is
+    # used only if (a) the group's whole placeable set fits in C
+    # (`comp` — outsiders are permanently NEG_INF since usage only
+    # grows), or (b) every commit this wave landed inside the group's
+    # shortlist (no outsider's bin-pack score moved), the group has no
+    # spread (a spread-state change shifts ALL the group's node scores)
+    # and the re-ranked window's TK-th key still dominates the era
+    # cutoff (no frozen outsider can rank inside the window).  Any
+    # other condition falls back to a full-N rescore wave — the escape
+    # hatch that keeps placements bit-identical to the host twin.
     def body(st):
         (used, dev_used, sp_used, done,
          out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
-         wave) = st
+         wave, n_resc, SL) = st
         active = ~done & (ks < n_place)
         g_idx = p_ask
+        used_pre, dev_used_pre = used, dev_used
 
-        committed = done & out_ok[:, 0]
-        chosen = jnp.where(committed, out_idx[:, 0], 0)
-        coll = coll0.at[g_idx, chosen].add(
-            committed.astype(jnp.float32))
-        if has_distinct:
-            dg_all = distinct[g_idx]
-            hit = jnp.zeros((Gp, Np), jnp.int32).at[
-                jnp.maximum(dg_all, 0), chosen].add(
-                (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
-            blocked = hit[jnp.maximum(distinct, 0)] \
-                & (distinct >= 0)[:, None]
-        else:
-            blocked = jnp.zeros((Gp, Np), bool)
-
-        Vs_i = sp_desired.shape[2]
-        want_tables = has_spread and Vs_i <= 8 and not stack_commit
-        pk = None
-        if use_pk:
-            # fused pallas pass: scoring chain + counters (+ top-K and
-            # per-value tables in "topk" mode) in ONE walk of each node
-            # tile; no [Gp, Np, R] intermediate ever reaches HBM
-            if has_spread:
-                pres = sp_used > 0                     # [Gp, S, V]
-                anyp = pres.any(axis=2)
-                minc_w = jnp.min(jnp.where(pres, sp_used, jnp.inf),
-                                 axis=2)
-                maxc_w = jnp.max(jnp.where(pres, sp_used, -jnp.inf),
-                                 axis=2)
-                # masked rows (nothing present) are pinned finite: the
-                # kernel's contribution for them is masked to 0 either
-                # way, and finite inputs keep the VPU out of inf/nan
-                spread_pack = (
-                    pk_vnode, sp_des, sp_used,
-                    sp_weight, sp_targeted, pk_sp_has,
-                    jnp.where(anyp, minc_w, 0.0).astype(jnp.float32),
-                    jnp.where(anyp, maxc_w, 0.0).astype(jnp.float32),
-                    anyp.astype(jnp.int8))
-            else:
-                spread_pack = None
-            pk = _pk.fused_wave(
-                mode=pallas_mode, feas=pk_feas,
-                blocked=(blocked.astype(jnp.int8) if has_distinct
-                         else None),
-                aff=aff_score, pen=pk_pen, jitter=jitter, coll=coll,
-                used=used, avail=avail, reserved=reserved,
-                ask_res=ask_res, ask_desired=ask_desired,
-                dev=((dev_used, dev_cap, dev_ask) if has_devices
-                     else None),
-                spread=spread_pack, seed=jnp.int32(seed), TK=TK,
-                tables_v=(Vs_i if (want_tables
-                                   and pallas_mode == "topk") else 0))
-            n_feas_g, n_exh_g = pk["n_feas"], pk["n_exh"]
-            dim_exh_g, grp_any = pk["dim_exh"], pk["grp_any"]
-            score = pk.get("score")          # None in "topk" mode
-        else:
-            score, placeable, feas_b, fit, fit_dims, dev_fit = \
-                group_scores(used, dev_used, coll, sp_used, blocked)
-        # full sort-based top_k dominates wave cost at scale; TPU's
-        # approx_max_k (recall ~0.95 over near-tied scores) is the
-        # hardware-native candidate search — the solve still scores every
-        # node, only the top-W *extraction* is approximate, a far smaller
-        # perturbation than the reference's 14-node subsample. Small
-        # problems (tests, dryruns) keep the exact path.
-        if use_pk and pallas_mode == "topk":
-            top_score, top_idx = pk["top_score"], pk["top_idx"]
-        elif Np >= _APPROX_MIN_NP:
-            top_score, top_idx = lax.approx_max_k(score, TK)
-        else:
-            top_score, top_idx = lax.top_k(score, TK)      # [Gp, TK]
-
-        # spread-aware candidate interleaving (slot 0): when node
-        # classes correlate with the spread attribute (racks live in one
-        # dc, zones in one region — the common cluster layout), a
-        # group's global top-W concentrates in ONE value and the spread
-        # quota strands all but a few commits per wave. Instead, build a
-        # per-value top list and interleave (slot j -> value j mod V),
-        # so a group's candidates arrive pre-balanced across values;
-        # holes (exhausted values) compact to the tail to keep the
-        # rank-wrap contiguous. Skipped for huge vocabularies where
-        # per-value extraction would dominate.
-        # (skipped in stack_commit mode: stacking aims every placement
-        # at slot 0, and the reference picks the max TOTAL score — the
-        # spread term is already inside the score; forcing slot 0 to
-        # the spread-preferred value would override the argmax)
         Vs = Vs_i
-        if want_tables:
-            has0 = sp_col[:, 0] >= 0                       # [Gp]
-            # one class per value PLUS a class for nodes MISSING the
-            # spread attribute — the reference still places on those
-            # with a -1 score penalty (spread.go), so they must stay
-            # candidates or feasible nodes would livelock unplaced
-            TKv = -(-TK // (Vs + 1))
-            if use_pk and pallas_mode == "topk":
-                # per-value tables came out of the fused pass; the
-                # tile-partial merge is exact-equal to the full-row
-                # top_k below (tournament + node-order tie-break)
-                tab_s, tab_i = pk["tab_s"], pk["tab_i"]
+
+        def full_wave(SL):
+            """The full-N pass: rebuild coll/blocked from the committed
+            outputs, score every (group, node) pair (pallas fused or
+            jnp), extract the top-NE, window the first TK (+ spread
+            interleave), reduce the explainability counters — and, when
+            the shortlist path is on, rebuild the carried shortlist
+            from the same extraction."""
+            committed = done & out_ok[:, 0]
+            chosen = jnp.where(committed, out_idx[:, 0], 0)
+            coll = coll0.at[g_idx, chosen].add(
+                committed.astype(jnp.float32))
+            if has_distinct:
+                dg_all = distinct[g_idx]
+                hit = jnp.zeros((Gp, Np), jnp.int32).at[
+                    jnp.maximum(dg_all, 0), chosen].add(
+                    (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
+                blocked = hit[jnp.maximum(distinct, 0)] \
+                    & (distinct >= 0)[:, None]
             else:
-                vnode = sp_vnode[0]                        # [Gp, Np]
-                tabs_i, tabs_s = [], []
-                for v in range(Vs + 1):
-                    vmask = (vnode == v) if v < Vs else (vnode < 0)
-                    sv = jnp.where(vmask, score, NEG_INF)
-                    if Np >= _APPROX_MIN_NP:
-                        ts, ti = lax.approx_max_k(sv, TKv)
-                    else:
-                        ts, ti = lax.top_k(sv, TKv)
-                    tabs_i.append(ti)
-                    tabs_s.append(ts)
-                tab_i = jnp.stack(tabs_i, axis=1)          # [Gp, V+1, TKv]
-                tab_s = jnp.stack(tabs_s, axis=1)
-            # visit values in each group's preference order (best head
-            # candidate first), so the first interleaved slot — where a
-            # lone remaining placement always lands — is the value the
-            # spread scoring actually favors this wave
-            vord = jnp.argsort(-tab_s[:, :, 0], axis=1)    # [Gp, V+1]
-            j = jnp.arange(TK)
-            vj = vord[:, j % (Vs + 1)]                     # [Gp, TK]
-            inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
-            inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
-            order = jnp.argsort((inter_s <= NEG_INF / 2)
-                                .astype(jnp.int32), axis=1, stable=True)
-            inter_i = jnp.take_along_axis(inter_i, order, axis=1)
-            inter_s = jnp.take_along_axis(inter_s, order, axis=1)
-            top_idx = jnp.where(has0[:, None], inter_i, top_idx)
-            top_score = jnp.where(has0[:, None], inter_s, top_score)
+                blocked = jnp.zeros((Gp, Np), bool)
 
-        if not use_pk:
-            grp_any = placeable.any(axis=1)                # [Gp]
+            pk = None
+            if use_pk:
+                # fused pallas pass: scoring chain + counters (+ top-K
+                # and per-value tables in "topk" mode) in ONE walk of
+                # each node tile; no [Gp, Np, R] intermediate ever
+                # reaches HBM
+                if has_spread:
+                    pres = sp_used > 0                 # [Gp, S, V]
+                    anyp = pres.any(axis=2)
+                    minc_w = jnp.min(jnp.where(pres, sp_used, jnp.inf),
+                                     axis=2)
+                    maxc_w = jnp.max(jnp.where(pres, sp_used, -jnp.inf),
+                                     axis=2)
+                    # masked rows (nothing present) are pinned finite:
+                    # the kernel's contribution for them is masked to 0
+                    # either way, and finite inputs keep the VPU out of
+                    # inf/nan
+                    spread_pack = (
+                        pk_vnode, sp_des, sp_used,
+                        sp_weight, sp_targeted, pk_sp_has,
+                        jnp.where(anyp, minc_w, 0.0).astype(jnp.float32),
+                        jnp.where(anyp, maxc_w, 0.0).astype(jnp.float32),
+                        anyp.astype(jnp.int8))
+                else:
+                    spread_pack = None
+                from .masks import pack_bool_u32 as _pack
+                pk = _pk.fused_wave(
+                    mode=pallas_mode, feas=pk_feas,
+                    blocked=(_pack(blocked) if has_distinct
+                             else None),
+                    aff=aff_score, pen=pk_pen, jitter=jitter, coll=coll,
+                    used=used, avail=avail, reserved=reserved,
+                    ask_res=ask_res, ask_desired=ask_desired,
+                    dev=((dev_used, dev_cap, dev_ask) if has_devices
+                         else None),
+                    spread=spread_pack, seed=jnp.int32(seed), TK=TK,
+                    n_extract=NE,
+                    tables_v=(Vs_i if (want_tables
+                                       and pallas_mode == "topk")
+                              else 0))
+                n_feas_g, n_exh_g = pk["n_feas"], pk["n_exh"]
+                dim_exh_g, grp_any = pk["dim_exh"], pk["grp_any"]
+                score = pk.get("score")          # None in "topk" mode
+            else:
+                score, placeable, feas_b, fit, fit_dims, dev_fit = \
+                    group_scores(used, dev_used, coll, sp_used, blocked)
+                grp_any = placeable.any(axis=1)            # [Gp]
+                # metrics snapshot for placements finishing this wave
+                n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
+                n_exh_g = (feas_b & valid[None, :]
+                           & ~(fit & dev_fit)).sum(axis=1)
+                dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
+                             & ~fit_dims).sum(axis=1)      # [Gp, R]
 
-            # metrics snapshot for placements finishing this wave
-            n_feas_g = (feas_b & valid[None, :]).sum(axis=1)
-            n_exh_g = (feas_b & valid[None, :]
-                       & ~(fit & dev_fit)).sum(axis=1)
-            dim_exh_g = (feas_b[:, :, None] & valid[None, :, None]
-                         & ~fit_dims).sum(axis=1)          # [Gp, R]
+            # full sort-based top_k dominates wave cost at scale; TPU's
+            # approx_max_k (recall ~0.95 over near-tied scores) is the
+            # hardware-native candidate search — the solve still scores
+            # every node, only the top-W *extraction* is approximate, a
+            # far smaller perturbation than the reference's 14-node
+            # subsample. Small problems (tests, dryruns) keep the exact
+            # path.
+            if use_pk and pallas_mode == "topk":
+                ext_s, ext_i = pk["top_score"], pk["top_idx"]
+            elif Np >= _APPROX_MIN_NP:
+                ext_s, ext_i = lax.approx_max_k(score, NE)
+            else:
+                ext_s, ext_i = lax.top_k(score, NE)        # [Gp, NE]
+            top_score, top_idx = ext_s[:, :TK], ext_i[:, :TK]
+
+            # spread-aware candidate interleaving (slot 0): when node
+            # classes correlate with the spread attribute (racks live
+            # in one dc, zones in one region — the common cluster
+            # layout), a group's global top-W concentrates in ONE value
+            # and the spread quota strands all but a few commits per
+            # wave. Instead, build a per-value top list and interleave
+            # (slot j -> value j mod V), so a group's candidates arrive
+            # pre-balanced across values; holes (exhausted values)
+            # compact to the tail to keep the rank-wrap contiguous.
+            # Skipped for huge vocabularies where per-value extraction
+            # would dominate.
+            # (skipped in stack_commit mode: stacking aims every
+            # placement at slot 0, and the reference picks the max
+            # TOTAL score — the spread term is already inside the
+            # score; forcing slot 0 to the spread-preferred value would
+            # override the argmax)
+            if want_tables:
+                has0 = sp_col[:, 0] >= 0                   # [Gp]
+                # one class per value PLUS a class for nodes MISSING
+                # the spread attribute — the reference still places on
+                # those with a -1 score penalty (spread.go), so they
+                # must stay candidates or feasible nodes would livelock
+                # unplaced
+                TKv = -(-TK // (Vs + 1))
+                if use_pk and pallas_mode == "topk":
+                    # per-value tables came out of the fused pass; the
+                    # tile-partial merge is exact-equal to the full-row
+                    # top_k below (tournament + node-order tie-break)
+                    tab_s, tab_i = pk["tab_s"], pk["tab_i"]
+                else:
+                    vnode = sp_vnode[0]                    # [Gp, Np]
+                    tabs_i, tabs_s = [], []
+                    for v in range(Vs + 1):
+                        vmask = (vnode == v) if v < Vs else (vnode < 0)
+                        sv = jnp.where(vmask, score, NEG_INF)
+                        if Np >= _APPROX_MIN_NP:
+                            ts, ti = lax.approx_max_k(sv, TKv)
+                        else:
+                            ts, ti = lax.top_k(sv, TKv)
+                        tabs_i.append(ti)
+                        tabs_s.append(ts)
+                    tab_i = jnp.stack(tabs_i, axis=1)      # [Gp, V+1, TKv]
+                    tab_s = jnp.stack(tabs_s, axis=1)
+                # visit values in each group's preference order (best
+                # head candidate first), so the first interleaved
+                # slot — where a lone remaining placement always
+                # lands — is the value the spread scoring actually
+                # favors this wave
+                vord = jnp.argsort(-tab_s[:, :, 0], axis=1)  # [Gp, V+1]
+                j = jnp.arange(TK)
+                vj = vord[:, j % (Vs + 1)]                 # [Gp, TK]
+                inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+                inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+                order = jnp.argsort((inter_s <= NEG_INF / 2)
+                                    .astype(jnp.int32), axis=1,
+                                    stable=True)
+                inter_i = jnp.take_along_axis(inter_i, order, axis=1)
+                inter_s = jnp.take_along_axis(inter_s, order, axis=1)
+                top_idx = jnp.where(has0[:, None], inter_i, top_idx)
+                top_score = jnp.where(has0[:, None], inter_s, top_score)
+
+            if use_sl:
+                # rebuild the carried shortlist from this extraction
+                # (stored node-ascending so commit positions resolve
+                # with one searchsorted); the cutoff key freezes the
+                # best possible outsider for the whole era
+                perm = jnp.argsort(ext_i, axis=1)
+                sl_i = jnp.take_along_axis(ext_i, perm, axis=1)
+                if has_spread:
+                    bidx = jnp.broadcast_to(sl_i, (S, Gp, C))
+                    vn = jnp.take_along_axis(sp_vnode, bidx, axis=2)
+                    de = jnp.take_along_axis(sp_des, bidx, axis=2)
+                else:
+                    vn, de = SL.vn, SL.de
+                SL = _SLState(
+                    idx=sl_i,
+                    feas=jnp.take_along_axis(feas, sl_i, axis=1),
+                    pen=jnp.take_along_axis(penalty, sl_i, axis=1),
+                    aff=jnp.take_along_axis(aff_score, sl_i, axis=1),
+                    vn=vn, de=de,
+                    coll=jnp.take_along_axis(coll, sl_i, axis=1),
+                    cut_s=ext_s[:, NE - 1],
+                    cut_i=ext_i[:, NE - 1],
+                    comp=(n_feas_g - n_exh_g) <= jnp.int32(C),
+                    nfeas=n_feas_g, nexh=n_exh_g, ndim=dim_exh_g,
+                    win_s=top_score, win_i=top_idx,
+                    gany=grp_any, ok=jnp.bool_(False))
+            return (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g,
+                    grp_any, SL, jnp.int32(1))
+
+        if use_sl:
+            def carried_wave(SL):
+                # shortlist wave: the window and counters were
+                # pre-computed at the end of the previous wave from the
+                # carried shortlist — no [Gp, Np] plane is touched
+                return (SL.win_s, SL.win_i, SL.nfeas, SL.nexh, SL.ndim,
+                        SL.gany, SL, jnp.int32(0))
+
+            (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g, grp_any,
+             SL, resc) = lax.cond(SL.ok, carried_wave, full_wave, SL)
+        else:
+            (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g, grp_any,
+             SL, resc) = full_wave(SL)
+        n_resc = n_resc + resc
 
         # rank each active placement within its group, then assign the
         # r-th remaining placement the group's (r mod M)-th best node,
@@ -759,9 +1065,137 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         out_nexh = jnp.where(newly, n_exh_g[g_idx], out_nexh)
         out_dimexh = jnp.where(newly[:, None], dim_exh_g[g_idx], out_dimexh)
         done = done | newly
+
+        if use_sl:
+            # ---- end-of-wave shortlist maintenance ----
+            # Post-commit state here IS the next wave's input, so the
+            # next window and its validity are decided now: the next
+            # wave either reads the carried [Gp, TK] window or runs the
+            # full pass — never both.
+            active_next = active & ~newly
+            act_next_g = jnp.zeros(Gp, jnp.int32).at[g_idx].add(
+                active_next.astype(jnp.int32)) > 0
+            any_next = active_next.any()
+            cf = commit.astype(jnp.float32)
+            tot = cf.sum()
+            # TR1: every commit this wave (any group's) landed inside
+            # this group's shortlist — otherwise an outsider's bin-pack
+            # score moved and the frozen cutoff bound is void
+            mark = jnp.zeros(Np, jnp.float32).at[cand].add(cf)
+            tr1_g = mark[SL.idx].sum(axis=1) == tot
+            g_committed = jnp.zeros(Gp, jnp.float32).at[g_idx].add(
+                cf) > 0
+            if has_spread:
+                has_sp_g = (sp_col >= 0).any(axis=1)
+            else:
+                has_sp_g = jnp.zeros(Gp, bool)
+            # spread groups shift ALL their node scores when their OWN
+            # sp_used changes (a commit with a spread value); a wave
+            # where the group committed nothing leaves its spread state
+            # — and so every outsider's score — frozen, and TR1/TR3
+            # carry the proof.  Groups riding the per-value interleave
+            # (want_tables + slot-0 spread) additionally need FULL
+            # class coverage: their window draws from per-class tables
+            # whose tails can rank below the global top-C, so only a
+            # COMPLETE shortlist (outsiders permanently NEG_INF) makes
+            # their re-rank provably exact.
+            sp_gate = has_sp_g & g_committed
+            if want_tables:
+                sp_gate = sp_gate | (sp_col[:, 0] >= 0)
+            ok_pre_g = SL.comp | (tr1_g & ~sp_gate)
+            pre_ok = any_next & (ok_pre_g | ~act_next_g).all()
+
+            # own-group commit counts fold into the carried coll (the
+            # window's shortlist positions resolve by bisection; a
+            # full-wave window may hold interleave entries outside the
+            # shortlist — those drop here AND fail TR1, forcing the
+            # rescore that rebuilds coll from the plane)
+            win_pos = jax.vmap(jnp.searchsorted)(SL.idx, top_idx)
+            pos_hit = jnp.take_along_axis(
+                SL.idx, jnp.minimum(win_pos, C - 1), axis=1) == top_idx
+            win_pos = jnp.where(pos_hit, win_pos, C)       # drop slot
+            cand_pos = win_pos[g_idx, cr]
+            SL = SL._replace(coll=SL.coll.at[g_idx, cand_pos].add(
+                cf, mode="drop"))
+
+            def rerank(sl):
+                """Fresh re-rank of the shortlist against post-commit
+                state + TR3 cutoff audit + incremental counters."""
+                _, _, exh_pre, dim_pre = _sl_eval(
+                    sl, used_pre, dev_used_pre, sp_used)
+                f_score, f_place, exh_post, dim_post = _sl_eval(
+                    sl, used, dev_used, sp_used)
+                # only shortlist nodes changed (TR1-guarded), so the
+                # full-N counters advance by the shortlist delta
+                d_exh = (exh_post.astype(jnp.int32)
+                         - exh_pre.astype(jnp.int32)).sum(axis=1)
+                d_dim = (dim_post.astype(jnp.int32)
+                         - dim_pre.astype(jnp.int32)).sum(axis=1)
+                nexh_next = n_exh_g + d_exh
+                ndim_next = dim_exh_g + d_dim
+                w_s, w_i = _lex_topk(f_score, sl.idx, TK)
+                # TR3: the re-ranked TK-th key must still dominate the
+                # era cutoff — no frozen outsider can rank inside
+                ls, li = w_s[:, TK - 1], w_i[:, TK - 1]
+                tr3_g = (ls > sl.cut_s) | ((ls == sl.cut_s)
+                                           & (li <= sl.cut_i))
+                if want_tables:
+                    # spread interleave from shortlist-local per-value
+                    # tables: exact for the groups that reach here
+                    # (`comp` guarantees every placeable class member
+                    # is present; NEG_INF filler indices differ from
+                    # the full pass but are compacted to the tail and
+                    # never commit)
+                    has0 = sp_col[:, 0] >= 0
+                    TKv = -(-TK // (Vs + 1))
+                    vnode0 = sl.vn[0]
+                    tabs_s, tabs_i = [], []
+                    for v in range(Vs + 1):
+                        vmask = ((vnode0 == v) if v < Vs
+                                 else (vnode0 < 0))
+                        sv = jnp.where(vmask, f_score, NEG_INF)
+                        ts, ti = _lex_topk(sv, sl.idx, TKv)
+                        tabs_s.append(ts)
+                        tabs_i.append(ti)
+                    tab_s = jnp.stack(tabs_s, axis=1)
+                    tab_i = jnp.stack(tabs_i, axis=1)
+                    vord = jnp.argsort(-tab_s[:, :, 0], axis=1)
+                    j = jnp.arange(TK)
+                    vj = vord[:, j % (Vs + 1)]
+                    inter_i = tab_i[gs[:, None], vj,
+                                    (j // (Vs + 1))[None, :]]
+                    inter_s = tab_s[gs[:, None], vj,
+                                    (j // (Vs + 1))[None, :]]
+                    order = jnp.argsort((inter_s <= NEG_INF / 2)
+                                        .astype(jnp.int32), axis=1,
+                                        stable=True)
+                    inter_i = jnp.take_along_axis(inter_i, order,
+                                                  axis=1)
+                    inter_s = jnp.take_along_axis(inter_s, order,
+                                                  axis=1)
+                    w_i = jnp.where(has0[:, None], inter_i, w_i)
+                    w_s = jnp.where(has0[:, None], inter_s, w_s)
+                gany_next = jnp.where(sl.comp, f_place.any(axis=1),
+                                      jnp.bool_(True))
+                ok_next = ((tr3_g | sl.comp) | ~act_next_g).all()
+                return (w_s, w_i, nexh_next, ndim_next, gany_next,
+                        ok_next)
+
+            def skip(sl):
+                return (jnp.full((Gp, TK), NEG_INF, jnp.float32),
+                        jnp.zeros((Gp, TK), jnp.int32),
+                        sl.nexh, sl.ndim, jnp.zeros(Gp, bool),
+                        jnp.bool_(False))
+
+            nw_s, nw_i, n_nexh, n_ndim, n_gany, sl_ok = lax.cond(
+                pre_ok, rerank, skip, SL)
+            SL = SL._replace(win_s=nw_s, win_i=nw_i, nfeas=n_feas_g,
+                             nexh=n_nexh, ndim=n_ndim, gany=n_gany,
+                             ok=pre_ok & sl_ok)
+
         return (used, dev_used, sp_used, done,
                 out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
-                wave + jnp.int32(1))
+                wave + jnp.int32(1), n_resc, SL)
 
     # Two loop shapes, chosen statically by the caller:
     #
@@ -790,7 +1224,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
            jnp.zeros(K, jnp.int32),
            jnp.zeros(K, jnp.int32),
            jnp.zeros((K, R), jnp.int32),
-           jnp.int32(0))
+           jnp.int32(0), jnp.int32(0), sl0)
     if wave_mode == "while":
         def w_cond(st):
             return ((~st[3] & (ks < n_place)).any()
@@ -804,7 +1238,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
         (st_final, _) = lax.scan(body_scan, st0, None, length=max_waves)
     (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
-     out_nfeas, out_nexh, out_dimexh, waves) = st_final
+     out_nfeas, out_nexh, out_dimexh, waves, n_resc, _) = st_final
     unfinished = ~done & (ks < n_place)
 
     return SolveResult(choice=out_idx, choice_ok=out_ok, score=out_score,
@@ -812,4 +1246,5 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                        dim_exhausted=out_dimexh, feas=feas,
                        cons_filtered=cons_filtered, used_final=used_final,
                        dev_used_final=dev_used_final, n_waves=waves,
-                       unfinished=unfinished)
+                       unfinished=unfinished,
+                       n_rescore=(n_resc if use_sl else waves))

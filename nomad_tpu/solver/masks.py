@@ -1,16 +1,80 @@
-"""Standalone feasibility-mask kernel.
+"""Standalone feasibility-mask kernel + bitpacked boolean planes.
 
 Computes only the static [G, N] feasibility mask (constraints + dc +
 host-evaluated ops) without the placement scan — used by the system
 scheduler, which forces placements onto specific nodes and only needs
 the mask (reference analog: feasible.go checks without rank/limit).
+
+Bitpacking: the solve's boolean planes (feasibility, penalty,
+distinct-blocking) are one int8 lane per (group, node) cell when they
+ride along the fused wave kernel, and one full bool per cell on the
+host/device fetch path.  `pack_bool_u32` folds 32 node columns into one
+uint32 lane — 8x fewer HBM bytes per wave re-read of the static planes
+(kernel.py feeds the pallas pass packed words) and 8x fewer transport
+bytes when a mask is fetched whole (`static_feasibility` below fetches
+words and unpacks host-side).  Bit j of word w is node column
+``w * 32 + j``; the node axis must be a multiple of 32, which every
+tensorize padding (pow2 >= 32, or 1024-multiples) guarantees.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .kernel import _op_eval
+
+#: node columns folded per packed word
+PACK_LANES = 32
+
+
+def pack_bool_u32(mask: jnp.ndarray) -> jnp.ndarray:
+    """[..., N] bool/int mask -> [..., ceil(N/32)] uint32 words (jnp;
+    traceable inside jit).  Node axes below a 32-multiple (tiny test
+    pads) zero-fill the trailing bits."""
+    n = mask.shape[-1]
+    if n % PACK_LANES:
+        pad = PACK_LANES - n % PACK_LANES
+        mask = jnp.concatenate(
+            [mask, jnp.zeros(mask.shape[:-1] + (pad,), mask.dtype)],
+            axis=-1)
+        n += pad
+    bits = mask.astype(jnp.uint32).reshape(
+        mask.shape[:-1] + (n // PACK_LANES, PACK_LANES))
+    shifts = jnp.arange(PACK_LANES, dtype=jnp.uint32)
+    return (bits << shifts).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bool_u32(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[..., N // 32] uint32 -> [..., n] bool (jnp; traceable inside
+    jit and inside a pallas kernel body)."""
+    shifts = jnp.arange(PACK_LANES, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1]
+                        + (words.shape[-1] * PACK_LANES,))[..., :n] != 0
+
+
+def np_pack_bool_u32(mask: np.ndarray) -> np.ndarray:
+    """Host-side (numpy) twin of pack_bool_u32."""
+    n = mask.shape[-1]
+    if n % PACK_LANES:
+        pad = PACK_LANES - n % PACK_LANES
+        mask = np.concatenate(
+            [mask, np.zeros(mask.shape[:-1] + (pad,), mask.dtype)],
+            axis=-1)
+        n += pad
+    bits = np.asarray(mask, bool).reshape(
+        mask.shape[:-1] + (n // PACK_LANES, PACK_LANES))
+    weights = (np.uint32(1) << np.arange(PACK_LANES, dtype=np.uint32))
+    return (bits * weights).sum(axis=-1, dtype=np.uint64).astype(np.uint32)
+
+
+def np_unpack_bool_u32(words: np.ndarray, n: int) -> np.ndarray:
+    """Host-side (numpy) twin of unpack_bool_u32."""
+    shifts = np.arange(PACK_LANES, dtype=np.uint32)
+    bits = (words[..., None] >> shifts) & np.uint32(1)
+    return bits.reshape(words.shape[:-1]
+                        + (words.shape[-1] * PACK_LANES,))[..., :n] != 0
 
 
 @jax.jit
@@ -26,11 +90,15 @@ def _feas_kernel(valid, node_dc, attr_rank, dc_ok, host_ok, c_op, c_col,
         return base & ok.all(axis=1)
 
     Gp = c_op.shape[0]
-    return lax.map(per_ask, jnp.arange(Gp))
+    feas = lax.map(per_ask, jnp.arange(Gp))
+    # fetch bitpacked words, not bools: the [G, N] plane crosses the
+    # transport 8x smaller (the system scheduler fetches this whole)
+    return pack_bool_u32(feas)
 
 
 def static_feasibility(pb) -> np.ndarray:
-    """[G, N] bool mask for a PackedBatch."""
-    out = _feas_kernel(pb.valid, pb.node_dc, pb.attr_rank, pb.dc_ok,
-                       pb.host_ok, pb.c_op, pb.c_col, pb.c_rank)
-    return np.asarray(out)
+    """[G, N] bool mask for a PackedBatch (fetched as packed uint32
+    words, unpacked host-side)."""
+    words = _feas_kernel(pb.valid, pb.node_dc, pb.attr_rank, pb.dc_ok,
+                         pb.host_ok, pb.c_op, pb.c_col, pb.c_rank)
+    return np_unpack_bool_u32(np.asarray(words), pb.valid.shape[0])

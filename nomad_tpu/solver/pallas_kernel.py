@@ -42,6 +42,18 @@ interpreter mode — same semantics, no Mosaic — which is what tier-1
 exercises; on TPU `available()` compile-probes a representative kernel
 once and disables the path rather than let a Mosaic regression take
 the scheduler down.
+
+Two shortlist-era (ISSUE 4) extensions:
+
+  * the boolean planes (feasibility, penalty, distinct-blocking)
+    arrive BITPACKED — uint32 words of 32 node columns
+    (masks.pack_bool_u32) — and unpack per tile inside the kernel, so
+    the static masks cost 1/8th of their int8 bytes on every full
+    wave's HBM re-read;
+  * `n_extract` decouples the in-kernel extraction width from the
+    candidate window TK: the full wave extracts the top-C shortlist
+    (C >= TK) in one pass, the caller windows the first TK and carries
+    the rest for shortlist-resident contention waves (kernel.py).
 """
 from __future__ import annotations
 
@@ -104,13 +116,14 @@ def available() -> bool:
     of crashing the scheduler."""
     try:
         import numpy as np
+        from .masks import pack_bool_u32
         Gp, Np, R, S, V, D = 2, 256, 4, 1, 4, 2
         out = fused_wave(
             mode="topk",
-            feas=jnp.ones((Gp, Np), jnp.int8),
-            blocked=jnp.zeros((Gp, Np), jnp.int8),
+            feas=pack_bool_u32(jnp.ones((Gp, Np), bool)),
+            blocked=pack_bool_u32(jnp.zeros((Gp, Np), bool)),
             aff=jnp.zeros((Gp, Np), jnp.float32),
-            pen=jnp.zeros((Gp, Np), jnp.int8),
+            pen=pack_bool_u32(jnp.zeros((Gp, Np), bool)),
             jitter=jnp.zeros((Gp, Np), jnp.float32),
             coll=jnp.zeros((Gp, Np), jnp.float32),
             used=jnp.zeros((Np, R), jnp.float32),
@@ -175,33 +188,46 @@ def _specs(shape, tile_map, memory_space=None):
 
 def fused_wave(*, mode, feas, blocked, aff, pen, jitter,
                coll, used, avail, reserved, ask_res, ask_desired,
-               dev=None, spread=None, seed=0, TK=4, tables_v=0):
+               dev=None, spread=None, seed=0, TK=4, n_extract=0,
+               tables_v=0):
     """One fused pass over node tiles producing the wave's scoring
     outputs.  Returns a dict:
 
       mode "score": score [Gp, Np] f32, counters (see below)
-      mode "topk":  top_score/top_idx [Gp, TK] (exact, merged from
-                    per-tile partials), counters, and when tables_v>0
+      mode "topk":  top_score/top_idx [Gp, n_extract] (exact, merged
+                    from per-tile partials; n_extract defaults to TK —
+                    the shortlist path extracts top-C >= TK in the same
+                    pass), counters, and when tables_v>0
                     tab_s/tab_i [Gp, tables_v+1, TKv] — the per-value
-                    candidate tables for spread-aware interleaving.
+                    candidate tables for spread-aware interleaving
+                    (TKv is derived from the WINDOW width TK, not
+                    n_extract, so the interleave matches the unfused
+                    kernel exactly).
 
     counters: n_feas [Gp] i32, n_exh [Gp] i32, grp_any [Gp] bool,
     dim_exh [Gp, R] i32 — the per-wave explainability reductions.
 
-    All tensors use the caller's (kernel.py) layouts; `spread` packs
+    All tensors use the caller's (kernel.py) layouts.  `feas`, `pen`
+    and `blocked` arrive BITPACKED: uint32 words over the node axis
+    (masks.pack_bool_u32), unpacked per tile in-kernel.  `spread` packs
     (sp_vnode [S,Gp,Np], sp_des [S,Gp,Np], sp_used [Gp,S,V],
     sp_weight [Gp,S], sp_targeted [Gp,S], sp_has [Gp,S] i8,
     minc [Gp,S], maxc [Gp,S], anyp [Gp,S] i8); `dev` packs
     (dev_used [Np,D], dev_cap [Np,D], dev_ask [Gp,D]).
     """
-    Gp, Np = feas.shape
-    R = used.shape[1]
+    Gp = feas.shape[0]
+    Np, R = used.shape[0], used.shape[1]
     has_devices = dev is not None
     has_spread = spread is not None
     has_blocked = blocked is not None
     T = pick_tile(Np, Gp)
     n_tiles = Np // T
-    TKt = min(TK, T)
+    # packed boolean planes: words per tile (T is a multiple of 32 for
+    # every multi-tile layout; single-tile layouts take the whole —
+    # possibly padded — word row)
+    Tw = -(-T // 32) if n_tiles == 1 else T // 32
+    NE = n_extract or TK
+    TKt = min(NE, T)
     want_tables = mode == "topk" and tables_v > 0
     Vs = tables_v
     TKv = -(-TK // (Vs + 1)) if want_tables else 0
@@ -226,11 +252,12 @@ def fused_wave(*, mode, feas, blocked, aff, pen, jitter,
     gp_t = lambda i: (0, i)              # [Gp, Np] planes  # noqa: E731
     np_r = lambda i: (i, 0)              # [Np, X] planes   # noqa: E731
     full = lambda i: (0, 0)              # whole small arrays # noqa: E731
-    inputs = [feas, aff, pen, jitter, coll]
-    in_specs = [_specs((Gp, T), gp_t)] * 5
+    inputs = [feas, pen, aff, jitter, coll]
+    in_specs = [_specs((Gp, Tw), gp_t)] * 2 \
+        + [_specs((Gp, T), gp_t)] * 3
     if has_blocked:
         inputs.append(blocked)
-        in_specs.append(_specs((Gp, T), gp_t))
+        in_specs.append(_specs((Gp, Tw), gp_t))
     inputs += [used, avail, reserved, ask_res,
                ask_desired.reshape(Gp, 1),
                jnp.asarray(seed, jnp.int32).reshape(1, 1)]
@@ -305,11 +332,11 @@ def fused_wave(*, mode, feas, blocked, aff, pen, jitter,
     else:
         ts_all, ti_all = outs[oi], outs[oi + 1]
         oi += 2
-        mTK = min(TK, n_tiles * TKt)
+        mTK = min(NE, n_tiles * TKt)
         ms, pos = lax.top_k(ts_all, mTK)
         mi = jnp.take_along_axis(ti_all, pos, axis=1)
-        if mTK < TK:                 # tiny problems: pad like top_k of
-            pad = TK - mTK           # a row narrower than k never is —
+        if mTK < NE:                 # tiny problems: pad like top_k of
+            pad = NE - mTK           # a row narrower than k never is —
             ms = jnp.concatenate(    # callers clamp TK <= Np upstream
                 [ms, jnp.full((Gp, pad), NEG_INF, jnp.float32)], axis=1)
             mi = jnp.concatenate(
@@ -361,12 +388,12 @@ def _wave_tile_kernel(*refs, mode, Gp, T, R, D, S, V, TKt, Vs, TKvt,
     """The fused per-tile pass.  Positional refs mirror fused_wave's
     input/output assembly exactly."""
     it = iter(refs)
-    feas_ref = next(it)
+    feas_ref = next(it)          # packed u32 words
+    pen_ref = next(it)           # packed u32 words
     aff_ref = next(it)
-    pen_ref = next(it)
     jitter_ref = next(it)
     coll_ref = next(it)
-    blocked_ref = next(it) if has_blocked else None
+    blocked_ref = next(it) if has_blocked else None   # packed u32
     used_ref = next(it)
     avail_ref = next(it)
     reserved_ref = next(it)
@@ -394,9 +421,10 @@ def _wave_tile_kernel(*refs, mode, Gp, T, R, D, S, V, TKt, Vs, TKvt,
     i = pl.program_id(0)
     f32 = jnp.float32
 
-    feas_b = feas_ref[...] != 0                        # [Gp, T]
+    from .masks import unpack_bool_u32
+    feas_b = unpack_bool_u32(feas_ref[...], T)         # [Gp, T]
     if has_blocked:
-        feas_b &= blocked_ref[...] == 0
+        feas_b &= ~unpack_bool_u32(blocked_ref[...], T)
 
     # ---- resource fit + bin-pack, one static unroll over R ----
     ask_res = ask_res_ref[...]                         # [Gp, R]
@@ -487,7 +515,7 @@ def _wave_tile_kernel(*refs, mode, Gp, T, R, D, S, V, TKt, Vs, TKvt,
     # EXACT float summation order of kernel.group_scores: f32 addition
     # is not associative, and the pallas path must be bitwise the
     # kernel/host twin's score for placement-identity to hold
-    pen_counts = pen_ref[...] != 0
+    pen_counts = unpack_bool_u32(pen_ref[...], T)
     pen_score = jnp.where(pen_counts, f32(-1.0), f32(0.0))
     aff_sc = aff_ref[...]
     aff_counts = aff_sc != 0.0

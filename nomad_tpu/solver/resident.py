@@ -65,6 +65,24 @@ def unpack_stream(out) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
     return choice, ok, score, status
 
 
+def _env_shortlist_c() -> int:
+    """NOMAD_TPU_SHORTLIST_C: unset/'auto' -> 0 (auto), 'off'/-1 ->
+    disabled, else an int handed to kernel.resolve_shortlist_c (which
+    validates it against the problem shape at trace time)."""
+    import os
+    raw = os.environ.get("NOMAD_TPU_SHORTLIST_C", "").strip().lower()
+    if raw in ("", "auto"):
+        return 0
+    if raw in ("off", "-1"):
+        return -1
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"NOMAD_TPU_SHORTLIST_C={raw!r} invalid: use 'auto', 'off' "
+            "or an integer shortlist width") from None
+
+
 def pack_out_compact(choice, score, status):
     """Device-side result compaction: node indices as int16, scores
     bitcast through bfloat16, status as int16 — [..., 2*TOP_K+1] int16,
@@ -126,6 +144,47 @@ def pack_batch_cached(solver, asks: Sequence[PlacementAsk],
                        {(a.job.namespace, a.job.id) for a in asks})
     return pb
 
+def model_wave_bytes(Np: int, Gp: int, K: int, S: int, R: int,
+                     has_spread: bool, mode: str, TK: int, C: int
+                     ) -> Tuple[int, int, int]:
+    """Two-tier per-wave HBM byte model: (bytes_wave1, bytes_rewave,
+    fused_pass_count).  bytes_wave1 models a full-N pass (wave 1 and
+    every shortlist-escape rescore); bytes_rewave a shortlist-resident
+    contention wave over the carried [Gp, C] state.  Pure function of
+    the solve shape so tests and the bench roofline share one model
+    (ResidentSolver.wave_traffic feeds it the live configuration)."""
+    plane = Gp * Np
+    spread_planes = (2 * S * plane * 4) if has_spread else 0
+    if mode == "off":
+        # the unfused chain: ~6 elementwise [Gp, Np] f32 passes plus
+        # the [Gp, Np, R] broadcast intermediates and the top-k read
+        bytes_wave1 = (plane * 4 * 6 + plane * R * 4 * 2
+                       + spread_planes + Np * R * 4 * 2
+                       + K * 4 * 6)
+        passes = 6
+    else:
+        # fused single pass: every plane read ONCE (feas/pen as
+        # BITPACKED u32 lanes — 1/8th of their former int8 bytes —
+        # aff f32, jitter f32, coll f32 + spread statics), node
+        # columns once, plus score write+read in "score" mode only
+        reads = plane * (4 + 4 + 4) + 2 * (plane // 8) \
+            + spread_planes + Np * R * 4 * 3
+        extra = (plane * 4 * 2 if mode == "score" else 0)
+        bytes_wave1 = reads + extra + K * 4 * 6
+        passes = 1
+    if C > 0:
+        # shortlist wave: carried [Gp, C] state read+written by the
+        # loop carry (idx/feas/pen/aff/coll + spread vn/de), live
+        # gathers of used/avail/reserved rows, the [Gp, TK] window,
+        # the [Np] commit-mark plane and the K-sized commit vectors
+        per_entry = (14 + (8 * S if has_spread else 0)) * 2 + 12 * R
+        bytes_rewave = (Gp * C * per_entry + Np * 4
+                        + Gp * TK * 12 + K * 4 * 2)
+    else:
+        bytes_rewave = bytes_wave1     # no shortlist: all waves full
+    return int(bytes_wave1), int(bytes_rewave), passes
+
+
 # ask-side solve_kernel args stacked per batch (see sharded._ARG_SPECS)
 _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
              "coll0", "penalty", "c_op", "c_col", "c_rank", "a_op", "a_col",
@@ -138,12 +197,23 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
                group_count_hint=0, max_waves=0, wave_mode="scan",
                has_distinct=True, has_devices=True, stack_commit=False,
-               pallas_mode="off"):
+               pallas_mode="off", shortlist_c=0):
+    # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
+    # lanes, 1/8th the transport bytes of the dense bool planes);
+    # unpack on device — dtype is static, so either form compiles once
+    from .masks import unpack_bool_u32
+    Np = avail.shape[0]
+    host_ok = batch["host_ok"]
+    if host_ok.dtype == jnp.uint32:
+        host_ok = unpack_bool_u32(host_ok, Np)
+    penalty = batch["penalty"]
+    if penalty.dtype == jnp.uint32:
+        penalty = unpack_bool_u32(penalty, Np)
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
-        batch["dc_ok"], batch["host_ok"], batch["coll0"],
-        batch["penalty"], batch["c_op"], batch["c_col"],
+        batch["dc_ok"], host_ok, batch["coll0"],
+        penalty, batch["c_op"], batch["c_col"],
         batch["c_rank"], batch["a_op"], batch["a_col"],
         batch["a_rank"], batch["a_weight"], batch["a_host"],
         batch["sp_col"], batch["sp_weight"], batch["sp_targeted"],
@@ -152,7 +222,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         seed, has_spread=has_spread, group_count_hint=group_count_hint,
         max_waves=max_waves, wave_mode=wave_mode,
         has_distinct=has_distinct, has_devices=has_devices,
-        stack_commit=stack_commit, pallas_mode=pallas_mode)
+        stack_commit=stack_commit, pallas_mode=pallas_mode,
+        shortlist_c=shortlist_c)
 
 
 @functools.partial(jax.jit,
@@ -176,7 +247,11 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                                    attr_rank, dev_cap, used0, dev_used0,
                                    b, n, s, has_spread,
                                    group_count_hint, max_waves,
-                                   wave_mode, has_distinct, has_devices)
+                                   wave_mode, has_distinct, has_devices,
+                                   # vmapped lanes turn the shortlist
+                                   # cond into a select (both branches
+                                   # run) — pure overhead here
+                                   shortlist_c=-1)
     )(stacked, n_places, seeds)
     # res.* have a leading [B] axis; slot-0 choices are the commits
     K = res.choice.shape[1]
@@ -234,17 +309,18 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
                                     "stack_commit", "compact",
-                                    "pallas_mode"))
+                                    "pallas_mode", "shortlist_c"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
                    has_spread=True, group_count_hint=0, max_waves=0,
                    wave_mode="scan", has_distinct=True,
                    has_devices=True, stack_commit=False, compact=True,
-                   pallas_mode="off"):
+                   pallas_mode="off", shortlist_c=0):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device.  Also
-    returns the per-batch wave count [B] — the instrumentation hook the
-    HBM-GB/s report multiplies against the per-wave byte model."""
+    returns the per-batch wave and full-rescore counts [B] — the
+    instrumentation the two-tier HBM byte model multiplies against
+    (bytes_wave1 x rescore + bytes_rewave x shortlist waves)."""
 
     def step(carry, xs):
         used, dev_used = carry
@@ -253,7 +329,7 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                          dev_cap, used, dev_used, batch, n_place, seed,
                          has_spread, group_count_hint, max_waves,
                          wave_mode, has_distinct, has_devices,
-                         stack_commit, pallas_mode)
+                         stack_commit, pallas_mode, shortlist_c)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -264,11 +340,11 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                 [res.choice.astype(jnp.float32), res.score,
                  status.astype(jnp.float32)[:, None]], axis=-1)
         return ((res.used_final, res.dev_used_final),
-                (packed, res.n_waves))
+                (packed, res.n_waves, res.n_rescore))
 
-    (used_f, dev_used_f), (out, waves) = jax.lax.scan(
+    (used_f, dev_used_f), (out, waves, rescores) = jax.lax.scan(
         step, (used0, dev_used0), (stacked, n_places, seeds))
-    return used_f, dev_used_f, out, waves
+    return used_f, dev_used_f, out, waves, rescores
 
 
 class ResidentSolver:
@@ -288,7 +364,8 @@ class ResidentSolver:
                  gp: Optional[int] = None, kp: Optional[int] = None,
                  max_waves: int = 0, wave_mode: str = "scan",
                  stack_commit: bool = False, pallas: str = "auto",
-                 delta_threshold: Optional[float] = None):
+                 delta_threshold: Optional[float] = None,
+                 shortlist_c: Optional[int] = None):
         import os
         self.nodes = list(nodes)
         self.max_waves = max_waves        # 0 = kernel default
@@ -298,9 +375,21 @@ class ResidentSolver:
         #: fused wave kernel on TPU / forced via NOMAD_TPU_PALLAS);
         #: "off"/"score"/"topk" pin it (tests, benchmarks)
         self.pallas = pallas
+        #: shortlist width for contention waves: 0 auto-sizes (the
+        #: candidate window rounded up a tile), -1 disables, explicit
+        #: values are validated at trace time (kernel.py
+        #: resolve_shortlist_c — invalid values RAISE, never clamp).
+        #: NOMAD_TPU_SHORTLIST_C overrides when the ctor arg is None
+        #: ("auto"/"off" accepted as spellings of 0/-1).
+        self.shortlist_c = (
+            shortlist_c if shortlist_c is not None
+            else _env_shortlist_c())
         #: per-batch wave counts of the LAST dispatched stream (device
         #: array; fetch syncs — instrumentation consumers only)
         self.last_waves = None
+        #: per-batch FULL-rescore wave counts of the last stream (the
+        #: remainder up to last_waves ran shortlist-resident)
+        self.last_rescore_waves = None
         #: delta waves touching more than this fraction of real node
         #: slots fall back to a full repack (one contiguous re-put beats
         #: a near-total scatter); NOMAD_TPU_DELTA_THRESHOLD overrides
@@ -611,7 +700,8 @@ class ResidentSolver:
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
                     else np.asarray(list(seeds), np.int32))
-        self._used, self._dev_used, out, self.last_waves = _stream_kernel(
+        (self._used, self._dev_used, out, self.last_waves,
+         self.last_rescore_waves) = _stream_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
@@ -622,7 +712,7 @@ class ResidentSolver:
             has_distinct=self._has_distinct(batches),
             has_devices=self._has_devices(batches),
             stack_commit=self.stack_commit, compact=self._compact,
-            pallas_mode=self.pallas)
+            pallas_mode=self.pallas, shortlist_c=self.shortlist_c)
         return out
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
@@ -660,7 +750,7 @@ class ResidentSolver:
         chunks = list(chunks)
         if not chunks:
             raise ValueError("solve_stream_pipelined needs >= 1 chunk")
-        outs, waves = [], []
+        outs, waves, rescores = [], [], []
         pack_s = dispatch_s = delta_s = 0.0
         bytes_shipped = 0
 
@@ -687,6 +777,7 @@ class ResidentSolver:
             outs.append(self.solve_stream_async(
                 [pb], seeds=None if seeds is None else [seeds[b]]))
             waves.append(self.last_waves)
+            rescores.append(self.last_rescore_waves)
             bytes_shipped += self.last_dispatch_bytes
             t1 = time.perf_counter()
             dispatch_s += t1 - t0
@@ -700,6 +791,7 @@ class ResidentSolver:
                             else self._concat_jit(*outs))
         fetch_s = time.perf_counter() - t3
         self.last_waves = waves
+        self.last_rescore_waves = rescores
         self.last_pipeline_stats = {
             "pack_s": pack_s, "dispatch_s": dispatch_s,
             "delta_apply_s": delta_s,
@@ -712,14 +804,23 @@ class ResidentSolver:
         return jax.jit(lambda *xs: jnp.concatenate(xs))
 
     def wave_traffic(self, batches: Sequence[PackedBatch]) -> Dict:
-        """Per-wave HBM byte model for the CURRENT solve configuration —
-        multiplied by the measured wave counts (last_waves) this yields
-        the achieved-GB/s numerator the roofline report compares against
-        the assumed HBM bandwidth.  Returns {mode, tile, bytes_per_wave,
-        fused_pass_count}."""
+        """Two-tier per-wave HBM byte model for the CURRENT solve
+        configuration (ISSUE 4).
+
+        `bytes_wave1` models a FULL-N pass (the first wave, and every
+        rescore-escape wave); `bytes_rewave` models a shortlist-
+        resident contention wave — the carried [Gp, C] state plus the
+        <= C live gathers, typically 10-100x below the full pass.
+        Combined with the measured per-batch counters (last_waves /
+        last_rescore_waves) the total is
+        ``bytes_wave1 x rescore_waves + bytes_rewave x shortlist
+        waves`` — the achieved-GB/s numerator of the roofline report.
+        `bytes_per_wave` stays as the full-pass alias for older
+        consumers.  Measured counters ride along under "measured" when
+        a stream has been dispatched."""
         from . import pallas_kernel as _pk
         from .kernel import (TOP_K as _TOP_K, WAVE_K, _MERGED_W_CAP,
-                             _WIDE_W_CAP)
+                             _WIDE_W_CAP, resolve_shortlist_c)
         t = self.template
         Np, R = t.avail.shape
         Gp = max(pb.ask_res.shape[0] for pb in batches)
@@ -729,34 +830,46 @@ class ResidentSolver:
         hint = self._group_count_hint(batches)
         w_cap = (_MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP)
         TK = min(max(WAVE_K, min(2 * hint, w_cap)) + _TOP_K, Np)
+        C = (0 if self._has_distinct(batches)
+             else resolve_shortlist_c(Np, TK, self.shortlist_c))
         mode = self.pallas
         if mode == "auto":
             V = t.sp_desired.shape[2]
             mode = _pk.resolve_mode(Np, Gp, TK, V, has_spread)
-        plane = Gp * Np
-        spread_planes = (2 * S * plane * 4) if has_spread else 0
-        if mode == "off":
-            # the unfused chain: ~6 elementwise [Gp, Np] f32 passes plus
-            # the [Gp, Np, R] broadcast intermediates and the top-k read
-            bytes_per_wave = (plane * 4 * 6 + plane * R * 4 * 2
-                              + spread_planes + Np * R * 4 * 2
-                              + K * 4 * 6)
-            passes = 6
-        else:
-            # fused single pass: every plane read ONCE (feas i8, aff
-            # f32, pen i8, jitter f32, coll f32 + spread statics), node
-            # columns once, plus score write+read in "score" mode only
-            reads = plane * (1 + 4 + 1 + 4 + 4) + spread_planes \
-                + Np * R * 4 * 3
-            extra = (plane * 4 * 2 if mode == "score" else 0)
-            bytes_per_wave = reads + extra + K * 4 * 6
-            passes = 1
-        return {"mode": mode, "tile": _pk.pick_tile(Np, Gp),
-                "bytes_per_wave": int(bytes_per_wave),
-                "fused_pass_count": passes,
-                # resident-delta traffic counters (ISSUE 2): how much
-                # node-state each lifecycle path actually dispatched
-                "delta": dict(self.delta_counters)}
+        bytes_wave1, bytes_rewave, passes = model_wave_bytes(
+            Np, Gp, K, S, R, has_spread, mode, TK, C)
+        out = {"mode": mode, "tile": _pk.pick_tile(Np, Gp),
+               "bytes_per_wave": int(bytes_wave1),
+               "bytes_wave1": int(bytes_wave1),
+               "bytes_rewave": int(bytes_rewave),
+               "shortlist_c": int(C),
+               "fused_pass_count": passes,
+               # resident-delta traffic counters (ISSUE 2): how much
+               # node-state each lifecycle path actually dispatched
+               "delta": dict(self.delta_counters)}
+        m = self.measured_wave_counters()
+        if m is not None:
+            m["modeled_bytes_total"] = int(
+                bytes_wave1 * m["rescore_waves"]
+                + bytes_rewave * m["shortlist_waves"])
+            out["measured"] = m
+        return out
+
+    def measured_wave_counters(self) -> Optional[Dict]:
+        """Waves / full-rescore waves of the LAST dispatched stream(s)
+        (fetch syncs).  shortlist_waves is the remainder — the waves
+        that ran shortlist-resident."""
+        if self.last_waves is None:
+            return None
+        def _tot(x):
+            if isinstance(x, list):
+                return int(sum(int(np.asarray(w).sum()) for w in x))
+            return int(np.asarray(x).sum())
+        waves = _tot(self.last_waves)
+        resc = (_tot(self.last_rescore_waves)
+                if self.last_rescore_waves is not None else waves)
+        return {"waves_total": waves, "rescore_waves": resc,
+                "shortlist_waves": waves - resc}
 
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:
@@ -855,6 +968,11 @@ class ResidentSolver:
                 stacked[name] = self._const_cache[key]
                 continue
             arr = np.stack(mats)
+            if name in ("host_ok", "penalty"):
+                # ship the bool planes bitpacked (uint32 lanes, 8x
+                # fewer transport bytes); _solve_one unpacks on device
+                from .masks import np_pack_bool_u32
+                arr = np_pack_bool_u32(arr)
             shipped += arr.nbytes
             stacked[name] = arr
         self.last_dispatch_bytes = shipped

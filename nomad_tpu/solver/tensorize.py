@@ -10,6 +10,16 @@ FeasibilityWrapper (scheduler/feasible.go:915) — and folded into a
 per-ask boolean `host_ok` mask.
 
 Resource dims (R=4): cpu MHz, memory MB, disk MB, network mbits.
+
+Boolean plane dtype contract: the eligibility masks packed here
+(`valid`, `dc_ok`, `host_ok`, `penalty`) stay dense bool on the host —
+the interning/memoization layer mutates and compares them row-wise.
+BITPACKING into uint32 lanes (1 bit per node column, masks.py
+pack_bool_u32) happens at the kernel/transport boundary instead:
+resident._stack_args packs `host_ok`/`penalty` before shipping, and
+kernel.solve_kernel packs the derived feasibility/penalty planes once
+per solve for the pallas fused wave — 8x fewer bytes everywhere the
+masks actually move, with zero churn to the host-side packing paths.
 """
 from __future__ import annotations
 

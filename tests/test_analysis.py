@@ -129,6 +129,35 @@ FIX_JIT = """
     def good_caller(arr, rows):
         arr = donating_update(arr, rows)
         return arr + 1                # rebound to the result: fine
+
+
+    @jax.jit
+    def loopy_kernel(x, n):
+        for i in range(n):                                 # JIT203
+            x = x + i
+        return x
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def loopy_static(x, n=4):
+        for i in range(n):            # static bound: fine
+            x = x + i
+        return x
+
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def donating_carry(carry, x):
+        return (carry[0] + x, carry[1])
+
+
+    def bad_carry_reader(carry, x):
+        out = donating_carry(carry, x)
+        return out[0] + carry[1]                           # JIT204
+
+
+    def good_carry_reader(carry, x):
+        carry = donating_carry(carry, x)
+        return carry[0]               # rebound carry: fine
 """
 
 FIX_LOCKS = """
@@ -275,12 +304,29 @@ def test_jit_global_mutation_detected(fixture_report):
 
 def test_jit_retrace_hazard_detected_static_twin_quiet(fixture_report):
     keys = _keys(fixture_report, "JIT203")
-    assert keys == {"JIT203:fixpkg.jitmod:branchy_kernel:flag"}
+    assert keys == {"JIT203:fixpkg.jitmod:branchy_kernel:flag",
+                    "JIT203:fixpkg.jitmod:loopy_kernel:n"}
+
+
+def test_jit_for_range_static_twin_quiet(fixture_report):
+    """`for _ in range(n)` with n static (the shortlist_c pattern) must
+    stay quiet; a traced bound fires (asserted above)."""
+    keys = _keys(fixture_report, "JIT203")
+    assert not any(":loopy_static:" in k for k in keys)
 
 
 def test_jit_donated_read_detected_rebind_twin_quiet(fixture_report):
     keys = _keys(fixture_report, "JIT204")
-    assert keys == {"JIT204:fixpkg.jitmod:bad_caller:arr"}
+    assert keys == {"JIT204:fixpkg.jitmod:bad_caller:arr",
+                    "JIT204:fixpkg.jitmod:bad_carry_reader:carry"}
+
+
+def test_jit_donated_carry_subscript_detected(fixture_report):
+    """Subscript reads through a donated carry name are dead-buffer
+    reads too (the wave-loop carry shape); the rebind twin is quiet."""
+    keys = _keys(fixture_report, "JIT204")
+    assert "JIT204:fixpkg.jitmod:bad_carry_reader:carry" in keys
+    assert not any(":good_carry_reader:" in k for k in keys)
 
 
 # --------------------------------------------------------- lock pass
