@@ -22,6 +22,12 @@ Rules
           dispatch — including subscript/attribute reads through the
           donated name (`carry[0]` after donating `carry`, the
           wave-loop carry shape)
+  JIT205  collective primitive (lax.psum / all_gather / ppermute /
+          axis_index ...) invoked outside a mesh context — the
+          function is not reachable from any shard_map/pmap root, so
+          the axis name cannot be bound and the call raises (or, in a
+          refactor that drops the shard_map wrapper, turns the mesh-
+          resident solve into a latent trace error)
 """
 from __future__ import annotations
 
@@ -44,6 +50,82 @@ LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
 
 JIT_NAMES = {"jax.jit", "jit", "functools.partial", "partial",
              "jax.pjit", "pjit"}
+
+# collective primitives that require a bound mesh axis name
+COLLECTIVE_SUFFIXES = (
+    "lax.psum", "lax.pmean", "lax.pmax", "lax.pmin", "lax.all_gather",
+    "lax.ppermute", "lax.pshuffle", "lax.all_to_all", "lax.axis_index",
+    "lax.psum_scatter",
+)
+
+
+def _is_collective(name: str) -> bool:
+    return any(name == s or name.endswith("." + s)
+               for s in COLLECTIVE_SUFFIXES)
+
+
+def _is_mesh_wrapper(full: str) -> bool:
+    """shard_map / pmap / xmap call names (any import spelling)."""
+    return (full.endswith("shard_map") or full in ("jax.pmap", "pmap")
+            or full.endswith(".pmap") or full.endswith("xmap"))
+
+
+def find_mesh_roots(index: PackageIndex) -> List[str]:
+    """Functions handed to shard_map/pmap — the roots under which a
+    collective primitive has a bound axis name.  Resolves the direct
+    callable, a functools.partial(f, ...) wrapper, and a local
+    `name = functools.partial(f, ...)` binding."""
+    roots: List[str] = []
+    for fkey, fi in index.functions.items():
+        la = index._local_imports(fi)
+        lt = index._local_var_types(fi)
+        aliases = dict(index.modules[fi.module].aliases)
+        aliases.update(la)
+
+        def _full(node) -> str:
+            d = _dotted(node)
+            if not d:
+                return ""
+            head = d.split(".")[0]
+            resolved = aliases.get(head)
+            return (resolved + d[len(head):]) if resolved else d
+
+        def _target_of(node):
+            """Resolve a callable expression to an internal func key:
+            bare name/attr, or functools.partial(f, ...)."""
+            if isinstance(node, ast.Call) and \
+                    _full(node.func) in ("functools.partial", "partial") \
+                    and node.args:
+                node = node.args[0]
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                return index.resolve_call(
+                    fi, ast.Call(func=node, args=[], keywords=[]),
+                    la, lt)
+            return None
+
+        # local `body = functools.partial(f, ...)` bindings
+        partial_locals: Dict[str, Optional[str]] = {}
+        for node in index._own_nodes(fi):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                tgt = _target_of(node.value)
+                if tgt:
+                    partial_locals[node.targets[0].id] = tgt
+        for node in index._own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            full = _full(node.func)
+            if not full or not _is_mesh_wrapper(full) or not node.args:
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Name) and arg0.id in partial_locals:
+                tgt = partial_locals[arg0.id]
+            else:
+                tgt = _target_of(arg0)
+            if tgt:
+                roots.append(tgt)
+    return roots
 
 
 class JitRoot:
@@ -284,6 +366,22 @@ def run_jit_pass(index: PackageIndex, cfg: AnalysisConfig
                         "compiling once",
                         hint="mark it in static_argnames, or express "
                              "the branch with lax.cond/jnp.where"))
+
+    # ---- JIT205: collectives outside a mesh/shard_map context
+    mesh_ok = index.reachable(find_mesh_roots(index))
+    for fkey, fi in sorted(index.functions.items()):
+        if fkey in mesh_ok:
+            continue
+        for name, lineno in index.external_calls(fkey):
+            if _is_collective(name):
+                findings.append(Finding(
+                    "JIT205", fi.module, fi.qual, name, fi.path, lineno,
+                    f"collective primitive `{name}` invoked outside a "
+                    "mesh/shard_map context: no axis name can be bound "
+                    "here, the call fails at trace time",
+                    hint="run the function under shard_map/pmap (or "
+                         "thread it from a mesh root), or gate the "
+                         "collective on the mesh_axis parameter"))
 
     # ---- JIT204: donated buffers read after dispatch
     donating: Dict[str, Tuple[int, ...]] = {}

@@ -232,13 +232,20 @@ class FederatedResidentSolver:
         shortcut for the big [G, N] tensors (see ResidentSolver).  A
         re-dispatched step (same PackedBatch objects — the steady-state
         delta-wave schedule) returns its fully device-put dict from
-        cache and ships nothing."""
-        key = tuple(id(pb) for rb in batches for pb in rb)
+        cache and ships nothing.
+
+        The cache key includes every region solver's resident NODE
+        EPOCH (bumped by apply_delta/repack): a delta applied to a
+        region between steps invalidates that step's cached stack, so a
+        re-dispatch can never serve ask planes packed against the old
+        node universe."""
+        step_key = (tuple(s._node_epoch for s in self.solvers),
+                    tuple(id(pb) for rb in batches for pb in rb))
         cached = getattr(self, "_step_cache", None)
         if cached is None:
             cached = self._step_cache = {}
         flat_pbs = [pb for rb in batches for pb in rb]
-        hit = cached.get(key)
+        hit = cached.get(step_key)
         if hit is not None and len(hit[0]) == len(flat_pbs) \
                 and all(a is b for a, b in zip(hit[0], flat_pbs)):
             return hit[1]
@@ -248,23 +255,23 @@ class FederatedResidentSolver:
                     for b in range(NB)]
             if name in ("coll0", "penalty", "a_host") and not any(
                     m.any() for row in mats for m in row):
-                key = (name, NB)
-                if key not in self._const_cache:
-                    self._const_cache[key] = jax.device_put(np.zeros(
+                ckey = (name, NB)
+                if ckey not in self._const_cache:
+                    self._const_cache[ckey] = jax.device_put(np.zeros(
                         (NB, self.R) + mats[0][0].shape,
                         mats[0][0].dtype))
-                stacked[name] = self._const_cache[key]
+                stacked[name] = self._const_cache[ckey]
                 continue
             if name == "host_ok" and all(
                     np.array_equal(m, self._default_host_ok[r])
                     for row in mats for r, m in enumerate(row)):
-                key = (name, NB)
-                if key not in self._const_cache:
-                    self._const_cache[key] = jax.device_put(
+                ckey = (name, NB)
+                if ckey not in self._const_cache:
+                    self._const_cache[ckey] = jax.device_put(
                         np.broadcast_to(
                             self._default_host_ok[None],
                             (NB,) + self._default_host_ok.shape).copy())
-                stacked[name] = self._const_cache[key]
+                stacked[name] = self._const_cache[ckey]
                 continue
             stacked[name] = np.stack(
                 [np.stack(row) for row in mats])
@@ -272,7 +279,7 @@ class FederatedResidentSolver:
                    else v) for k, v in stacked.items()}
         if len(cached) > 64:
             cached.clear()
-        cached[key] = (flat_pbs, dev)
+        cached[step_key] = (flat_pbs, dev)
         return dev
 
     # ---------------- usage ----------------

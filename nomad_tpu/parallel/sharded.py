@@ -17,13 +17,19 @@ Two levels:
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple
+import inspect
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..solver.kernel import solve_kernel
+from ..solver.resident import (ResidentSolver, STATUS_COMMITTED,
+                               STATUS_FAILED, STATUS_RETRY, _solve_one,
+                               model_wave_bytes, pack_out_compact)
 from ..solver.tensorize import PackedBatch
 
 # PartitionSpec per solve_kernel positional arg (node axis = "nodes").
@@ -52,6 +58,26 @@ _ARG_SPECS: List[P] = [
     P(),                     # p_ask [K]
     P(),                     # n_place (scalar)
 ]
+
+
+def _kernel_positional_count() -> int:
+    """Required positional parameters of solve_kernel (everything
+    before the defaulted `seed`)."""
+    sig = inspect.signature(inspect.unwrap(solve_kernel))
+    return sum(1 for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+               and p.default is p.empty)
+
+
+# _ARG_SPECS is maintained BY HAND parallel to solve_kernel's
+# positional signature: a kernel arg added without a spec would be
+# silently replicated (or worse, the specs would shift and misshard an
+# unrelated arg).  Fail at import time instead.
+_N_KERNEL_POSITIONAL = _kernel_positional_count()
+assert len(_ARG_SPECS) == _N_KERNEL_POSITIONAL, (
+    f"sharded._ARG_SPECS lists {len(_ARG_SPECS)} specs but solve_kernel "
+    f"takes {_N_KERNEL_POSITIONAL} positional args — update _ARG_SPECS "
+    "for the new/removed kernel argument")
 
 
 def kernel_args(pb: PackedBatch) -> Tuple:
@@ -117,3 +143,330 @@ def federated_solve(pbs: Sequence[PackedBatch], mesh: Mesh):
     stacked = tuple(np.stack([args[i] for args in per_region])
                     for i in range(len(per_region[0])))
     return _federated_kernel(*_shard_args(stacked, mesh, region_axis=True))
+
+
+# ===================================================================
+# Mesh-resident sharded solve (ISSUE 5)
+# ===================================================================
+# The GSPMD wrapper above is STATELESS: every solve re-ships the whole
+# packed batch and lets XLA guess the collectives, so each wave re-reads
+# (and re-gathers) full [G, N] planes.  The mesh-resident path below
+# keeps each shard's node planes in its own HBM under a "nodes"-axis
+# NamedSharding and runs the wave loop under shard_map with explicit
+# candidate-only ICI traffic: per-shard [G, TK_local] (score, global
+# node id) keys all-gathered and exactly lex-merged, K-sized commit/
+# counter psums — never a [G, N] plane (see solver/kernel.py mesh_axis).
+
+#: ask-side args whose TRAILING axis is the node axis
+_PLANE_ASK_ARGS = ("host_ok", "coll0", "penalty", "a_host")
+
+MESH_NODE_AXIS = "nodes"
+
+
+def make_node_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the node axis (the mesh-resident solver's
+    layout; make_mesh keeps the region x nodes grid for the stateless
+    wrapper and the federated vmap)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (MESH_NODE_AXIS,))
+
+
+def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
+                         dev_cap, used0, dev_used0, stacked, n_places,
+                         seeds, *, n_shards, has_spread,
+                         group_count_hint, max_waves, wave_mode,
+                         has_distinct, has_devices, stack_commit,
+                         compact, pallas_mode, shortlist_c):
+    """shard_map body: the resident stream scan with every solve run in
+    mesh mode.  All node args are this shard's LOCAL planes; ask
+    tensors are replicated except the [B, G, N] planes (node-sharded on
+    their last axis).  Outputs: local used/dev_used blocks, replicated
+    packed results and wave counters."""
+    def step(carry, xs):
+        used, dev_used = carry
+        batch, n_place, seed = xs
+        res = _solve_one(avail, reserved, valid, node_dc, attr_rank,
+                         dev_cap, used, dev_used, batch, n_place, seed,
+                         has_spread, group_count_hint, max_waves,
+                         wave_mode, has_distinct, has_devices,
+                         stack_commit, pallas_mode, shortlist_c,
+                         mesh_axis=MESH_NODE_AXIS, mesh_shards=n_shards)
+        status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
+                           jnp.where(res.unfinished, STATUS_RETRY,
+                                     STATUS_FAILED))
+        if compact:
+            packed = pack_out_compact(res.choice, res.score, status)
+        else:
+            packed = jnp.concatenate(
+                [res.choice.astype(jnp.float32), res.score,
+                 status.astype(jnp.float32)[:, None]], axis=-1)
+        return ((res.used_final, res.dev_used_final),
+                (packed, res.n_waves, res.n_rescore))
+
+    (used_f, dev_used_f), (out, waves, rescores) = jax.lax.scan(
+        step, (used0, dev_used0), (stacked, n_places, seeds))
+    return used_f, dev_used_f, out, waves, rescores
+
+
+def _build_sharded_stream_kernel(mesh: Mesh):
+    """jit(shard_map(stream)) closed over one mesh: node tensors stay
+    sharded in HBM across calls, results and counters come back
+    replicated."""
+    axis = MESH_NODE_AXIS
+    n_shards = int(mesh.shape[axis])
+    node2 = P(axis, None)
+    node1 = P(axis)
+    plane = P(None, None, axis)
+
+    @functools.partial(jax.jit, static_argnames=(
+        "has_spread", "group_count_hint", "max_waves", "wave_mode",
+        "has_distinct", "has_devices", "stack_commit", "compact",
+        "pallas_mode", "shortlist_c"))
+    def kern(avail, reserved, valid, node_dc, attr_rank, dev_cap,
+             used0, dev_used0, stacked, n_places, seeds, *,
+             has_spread=True, group_count_hint=0, max_waves=0,
+             wave_mode="scan", has_distinct=True, has_devices=True,
+             stack_commit=False, compact=True, pallas_mode="off",
+             shortlist_c=0):
+        stacked_specs = {k: (plane if k in _PLANE_ASK_ARGS else P())
+                         for k in stacked}
+        body = functools.partial(
+            _sharded_stream_body, n_shards=n_shards,
+            has_spread=has_spread, group_count_hint=group_count_hint,
+            max_waves=max_waves, wave_mode=wave_mode,
+            has_distinct=has_distinct, has_devices=has_devices,
+            stack_commit=stack_commit, compact=compact,
+            pallas_mode=pallas_mode, shortlist_c=shortlist_c)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(node2, node2, node1, node1, node2, node2,
+                      node2, node2, stacked_specs, P(), P()),
+            out_specs=(node2, node2, P(), P(), P()),
+            check_rep=False)(
+            avail, reserved, valid, node_dc, attr_rank, dev_cap,
+            used0, dev_used0, stacked, n_places, seeds)
+
+    return kern
+
+
+def model_ici_bytes(Gp: int, K: int, A: int, R: int, TKl: int,
+                    n_shards: int, want_tables: bool, V: int, TW: int,
+                    has_spread: bool) -> Dict:
+    """Per-wave ICI byte model for the mesh-resident solve (the third
+    tier next to resident.model_wave_bytes' two HBM tiers).
+
+    `bytes_ici_per_wave` is the candidate-KEY traffic: each shard's
+    [Gp, tk_local] (f32 score, i32 global id) window+table keys
+    all-gathered across `n_shards` — by construction it equals
+    tk_local x Gp x n_shards x key_bytes, the ISSUE-5 acceptance
+    bound; no [Gp, Np] plane term appears anywhere.
+    `bytes_ici_commit_per_wave` adds the K-sized commit-phase psums
+    (fit votes, candidate attr rows, explainability counters)."""
+    key_bytes = 8                       # f32 score + i32 node id
+    tk_local = TKl + ((V + 1) * TW if want_tables else 0)
+    window = Gp * tk_local * key_bytes * n_shards
+    commit = (2 * K * 4                          # fit / dev-fit votes
+              + (K * A * 4 if has_spread else 0)  # candidate attr rows
+              + (3 * Gp + Gp * R) * 4             # counters + grp_any
+              ) * n_shards
+    return {"key_bytes": key_bytes, "tk_local": int(tk_local),
+            "devices": int(n_shards),
+            "bytes_ici_per_wave": int(window),
+            "bytes_ici_commit_per_wave": int(commit),
+            "bytes_ici_total_per_wave": int(window + commit),
+            "bound_candidate_keys": int(
+                tk_local * Gp * n_shards * key_bytes)}
+
+
+class ShardedResidentSolver(ResidentSolver):
+    """ResidentSolver whose node planes live SHARDED across a TPU mesh.
+
+    Same surface as ResidentSolver (pack_batch / merge_asks /
+    solve_stream / apply_delta / wave_traffic), but:
+
+      * avail/reserved/valid/attr_rank/dev_cap and the carried
+        used/dev_used live in each chip's HBM under a "nodes"-axis
+        NamedSharding — packed and placed ONCE;
+      * apply_delta scatters delta rows through the same donate-buffer
+        kernels; GSPMD routes each row to its owning shard and the
+        result is re-pinned to the node sharding (no full re-put);
+      * solve_stream runs the wave loop under shard_map: full-N scoring
+        and the PR 4 shortlist contention waves are shard-local, and
+        only per-shard top-K candidate keys cross ICI (see
+        solver/kernel.py `mesh_axis`) — placements and explainability
+        counters stay bit-identical to the single-device host twin;
+      * wave_traffic grows the ICI tier (`bytes_ici_per_wave`).
+
+    Bool ask planes ship dense (not bitpacked): a uint32 lane packs 32
+    node columns and cannot be split on the node axis.
+    """
+
+    _pack_bool_planes = False
+
+    def __init__(self, nodes, probe_asks, *args,
+                 mesh: Optional[Mesh] = None,
+                 n_devices: Optional[int] = None, **kw):
+        self._mesh = mesh if mesh is not None else make_node_mesh(
+            n_devices)
+        if MESH_NODE_AXIS not in self._mesh.axis_names:
+            raise ValueError(
+                f"mesh must carry a '{MESH_NODE_AXIS}' axis, got "
+                f"{self._mesh.axis_names}")
+        self.n_shards = int(self._mesh.shape[MESH_NODE_AXIS])
+        self._kern = _build_sharded_stream_kernel(self._mesh)
+        self._scatter_kerns: Dict = {}
+        super().__init__(nodes, probe_asks, *args, **kw)
+        Np = self.template.avail.shape[0]
+        if Np % self.n_shards:
+            raise ValueError(
+                f"padded node axis {Np} does not divide over "
+                f"{self.n_shards} shards")
+
+    # ---------------- sharded placement hooks ----------------
+    def _put_node(self, name, arr):
+        spec = P(MESH_NODE_AXIS, None) if np.ndim(arr) == 2 \
+            else P(MESH_NODE_AXIS)
+        # copy before placing — see ResidentSolver._put_node (host-side
+        # in-place template updates must never alias device buffers)
+        return jax.device_put(np.array(arr),
+                              NamedSharding(self._mesh, spec))
+
+    def _put_ask(self, name, arr):
+        if name in _PLANE_ASK_ARGS:
+            spec = P(*([None] * (np.ndim(arr) - 1)), MESH_NODE_AXIS)
+        else:
+            spec = P()
+        return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+    # ---------------- delta lifecycle ----------------
+    # Incremental tensorize across the mesh: the inherited apply_delta
+    # drives these hooks, which route each pow2-padded row bundle to
+    # the shard OWNING its node slot under shard_map — every shard
+    # scatters only its own rows (non-owned indices pin to the dropped
+    # Np slot), so a delta wave moves only the scattered rows and the
+    # arrays never leave their node-axis sharding.  (A plain jit
+    # scatter on a sharded operand is NOT partition-safe: GSPMD may
+    # replicate the update and apply it once per shard.)
+    def _sharded_scatter(self, op: str, arr, idx, rows):
+        key = (op, np.ndim(arr))
+        fn = self._scatter_kerns.get(key)
+        if fn is None:
+            spec = P(MESH_NODE_AXIS, *([None] * (np.ndim(arr) - 1)))
+
+            def body(a_l, idx_, rows_, _op=op):
+                Npl = a_l.shape[0]
+                off = jax.lax.axis_index(MESH_NODE_AXIS) * Npl
+                loc = idx_.astype(jnp.int32) - off
+                # negative locals WRAP before mode="drop" bounds-checks;
+                # pin non-owned rows to the always-dropped Npl slot
+                loc = jnp.where((loc >= 0) & (loc < Npl), loc, Npl)
+                if _op == "set":
+                    return a_l.at[loc].set(rows_, mode="drop")
+                return a_l.at[loc].add(rows_, mode="drop")
+
+            fn = jax.jit(shard_map(body, mesh=self._mesh,
+                                   in_specs=(spec, P(), P()),
+                                   out_specs=spec, check_rep=False))
+            self._scatter_kerns[key] = fn
+        return fn(arr, idx, rows)
+
+    def _delta_set(self, arr, idx, rows):
+        return self._sharded_scatter("set", arr, idx, rows)
+
+    def _delta_add(self, arr, idx, rows):
+        return self._sharded_scatter("add", arr, idx, rows)
+
+    # ---------------- solving ----------------
+    def solve_stream_async(self, batches: Sequence[PackedBatch],
+                           seeds: Optional[Sequence[int]] = None):
+        self._check_stream_jobs(batches)
+        self._check_batch_axis(batches)
+        stacked = self._stack_args(batches)
+        n_places = np.asarray([pb.n_place for pb in batches], np.int32)
+        seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
+                    else np.asarray(list(seeds), np.int32))
+        (self._used, self._dev_used, out, self.last_waves,
+         self.last_rescore_waves) = self._kern(
+            self._dev_node["avail"], self._dev_node["reserved"],
+            self._dev_node["valid"], self._dev_node["node_dc"],
+            self._dev_node["attr_rank"], self._dev_node["dev_cap"],
+            self._used, self._dev_used, stacked, n_places, seed_arr,
+            has_spread=self._has_spread(batches),
+            group_count_hint=self._group_count_hint(batches),
+            max_waves=self.max_waves, wave_mode=self.wave_mode,
+            has_distinct=self._has_distinct(batches),
+            has_devices=self._has_devices(batches),
+            stack_commit=self.stack_commit, compact=self._compact,
+            pallas_mode=self.pallas, shortlist_c=self.shortlist_c)
+        return out
+
+    # ---------------- byte model ----------------
+    def measured_wave_counters(self) -> Optional[Dict]:
+        """Mesh units: rescore_waves counts per-SHARD full passes (the
+        kernel psums its per-shard escape counter), so the shortlist
+        remainder is taken against waves x shards."""
+        m = super().measured_wave_counters()
+        if m is not None:
+            m["shard_waves_total"] = m["waves_total"] * self.n_shards
+            m["shortlist_waves"] = max(
+                m["shard_waves_total"] - m["rescore_waves"], 0)
+        return m
+
+    def wave_traffic(self, batches: Sequence[PackedBatch]) -> Dict:
+        """Three-tier model: the inherited two HBM tiers plus the ICI
+        tier.  HBM tiers are restated PER SHARD (each chip walks only
+        its Np/devices slice of every plane); `measured` gains
+        `modeled_bytes_ici_total` (per-wave ICI model x measured wave
+        counters).  `rescore_waves` counts per-SHARD full passes (a
+        mixed wave where 3 of 8 shards escape costs 3 shard-plane
+        walks, not 8)."""
+        from ..solver import pallas_kernel as _pk
+        from ..solver.kernel import (TOP_K as _TOP_K, WAVE_K,
+                                     _MERGED_W_CAP, _WIDE_W_CAP,
+                                     MERGED_GP_MAX, resolve_shortlist_c)
+        out = super().wave_traffic(batches)
+        t = self.template
+        Np, R = t.avail.shape
+        Npl = Np // self.n_shards
+        Gp = max(pb.ask_res.shape[0] for pb in batches)
+        K = max(pb.p_ask.shape[0] for pb in batches)
+        A = t.attr_rank.shape[1]
+        S = t.sp_desired.shape[1]
+        V = t.sp_desired.shape[2]
+        has_spread = self._has_spread(batches)
+        hint = self._group_count_hint(batches)
+        w_cap = (_MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP)
+        TK = min(max(WAVE_K, min(2 * hint, w_cap)) + _TOP_K, Np)
+        TKl = min(TK, Npl)
+        C = (0 if self._has_distinct(batches)
+             else resolve_shortlist_c(Npl, TKl, self.shortlist_c))
+        mode = self.pallas
+        if mode == "auto":
+            mode = _pk.resolve_mode(Npl, Gp, TKl, V, has_spread)
+        want_tables = has_spread and V <= 8 and not self.stack_commit
+        TKv = -(-TK // (V + 1)) if want_tables else 0
+        TW = min(TKv, Npl) if want_tables else 0
+        out["ici"] = model_ici_bytes(Gp, K, A, R, TKl, self.n_shards,
+                                     want_tables, V, TW, has_spread)
+        out["bytes_ici_per_wave"] = out["ici"]["bytes_ici_per_wave"]
+        b1, brw, passes = model_wave_bytes(
+            Npl, Gp, K, S, R, has_spread, mode, TKl, C)
+        out["per_shard"] = {"np_local": int(Npl),
+                            "bytes_wave1": int(b1),
+                            "bytes_rewave": int(brw),
+                            "shortlist_c": int(C),
+                            "fused_pass_count": passes}
+        m = out.get("measured")
+        if m is not None:
+            # rescore_waves counts PER-SHARD full passes in mesh mode
+            shortlist_shard_waves = (m["waves_total"] * self.n_shards
+                                     - m["rescore_waves"])
+            m["modeled_bytes_total"] = int(
+                b1 * m["rescore_waves"]
+                + brw * max(shortlist_shard_waves, 0))
+            m["modeled_bytes_ici_total"] = int(
+                out["ici"]["bytes_ici_total_per_wave"]
+                * m["waves_total"])
+        return out

@@ -193,8 +193,12 @@ class _SLState(NamedTuple):
     nfeas: jnp.ndarray         # [Gp] n_feasible for the next wave
     nexh: jnp.ndarray          # [Gp] n_exhausted for the next wave
     ndim: jnp.ndarray          # [Gp, R] dim_exhausted for the next wave
-    win_s: jnp.ndarray         # [Gp, TK] next wave's window scores
-    win_i: jnp.ndarray         # [Gp, TK] next wave's window nodes
+    win_s: jnp.ndarray         # [Gp, TKl] next wave's window scores
+    win_i: jnp.ndarray         # [Gp, TKl] next wave's window nodes
+    #  (window/table node ids are GLOBAL — in mesh mode they feed the
+    #   cross-shard candidate-key merge directly)
+    tb_s: jnp.ndarray          # [Gp, V+1, TW] next wave's value tables
+    tb_i: jnp.ndarray          # ([Gp, 1, 1] dummies when tables off)
     gany: jnp.ndarray          # [Gp] next wave's grp_any
     ok: jnp.ndarray            # [] next wave may skip the full pass
 
@@ -240,7 +244,8 @@ def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
                                     "stack_commit", "pallas_mode",
-                                    "shortlist_c"))
+                                    "shortlist_c", "mesh_axis",
+                                    "mesh_shards"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -251,7 +256,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  group_count_hint=0, max_waves=0,
                  wave_mode="scan", has_distinct=True,
                  has_devices=True, stack_commit=False,
-                 pallas_mode="off", shortlist_c=0) -> SolveResult:
+                 pallas_mode="off", shortlist_c=0,
+                 mesh_axis=None, mesh_shards=0) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -263,6 +269,21 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     S = sp_col.shape[1]
     R = avail.shape[1]
     K = p_ask.shape[0]
+    # ---------- mesh-resident sharding (ISSUE 5) ----------
+    # mesh_axis names the shard_map axis the NODE dimension is split
+    # over: every [.., Np, ..] arg here is that shard's LOCAL plane.
+    # Scoring, extraction, and the shortlist stay shard-local; only
+    # per-group candidate KEYS (score, global node id) and K-sized
+    # commit/counter vectors cross ICI — never a [Gp, Np] plane.
+    in_mesh = mesh_axis is not None
+    if in_mesh:
+        assert mesh_shards >= 1, \
+            "mesh_axis requires the static mesh_shards axis size"
+    NT = Np * mesh_shards if in_mesh else Np      # global node axis
+    # shard offset: NamedSharding splits the node axis into contiguous
+    # axis-index-ordered blocks, so global id = axis_index * Np + local
+    off = (lax.axis_index(mesh_axis).astype(jnp.int32) * jnp.int32(Np)
+           if in_mesh else None)
     # wider waves for bigger batches: a group may commit up to W
     # placements per wave, so a K-placement batch converges in O(K / W)
     # fused-wave iterations. Size W to ~2x the LARGEST per-group
@@ -277,16 +298,25 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # more placements per group; with tiny Gp the top-k cost of a wider
     # window is negligible, so let W grow
     w_cap = _MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP
-    TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, Np)
+    TK = min(max(WAVE_K, min(2 * per_group, w_cap)) + TOP_K, NT)
     W = max(TK - TOP_K, 1)          # effective per-group wave width
+    # local extraction width: each shard contributes its top-TKl keys
+    # to the all-gather merge; TKl = TK off-mesh, so the single-device
+    # trace is unchanged.  Correctness of the merge only needs every
+    # shard to surface min(TK, Np_local) candidates (a shard can hold
+    # at most that many of the global top-TK).
+    TKl = min(TK, Np)
     # shortlist width C (0 = disabled): waves >= 2 re-rank the carried
     # top-C instead of re-reading the full node planes, whenever the
     # validity triggers prove the result identical to a full rescore.
     # distinct_hosts blocking mutates feasibility across groups through
     # nodes outside any shortlist — those batches always full-rescore.
-    C = 0 if has_distinct else resolve_shortlist_c(Np, TK, shortlist_c)
+    # In mesh mode the shortlist is SHARD-LOCAL (resolved against the
+    # local plane): triggers prove each shard's window contribution
+    # exact, and escapes rescore only that shard's plane.
+    C = 0 if has_distinct else resolve_shortlist_c(Np, TKl, shortlist_c)
     use_sl = C > 0
-    NE = C if use_sl else TK        # full-wave extraction width
+    NE = C if use_sl else TKl       # full-wave extraction width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)
 
@@ -306,6 +336,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # vmap, not lax.map: map would serialize Gp dispatch rounds; the
     # batched [Gp, Np, C] intermediates are small
     feas, cons_filtered = jax.vmap(per_ask_feas)(gs)
+    if in_mesh:
+        # [Gp, C] explainability sums reduce once per solve; `feas`
+        # itself stays a shard-local plane (reassembled by the caller's
+        # out_spec when fetched at all)
+        cons_filtered = lax.psum(cons_filtered, mesh_axis)
 
     # affinity matches are also placement-invariant: [Gp, Np]
     def per_ask_aff(g):
@@ -367,7 +402,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # passes distinct seeds) and sibling GROUPS within a batch, fanning
     # same-shaped asks across equal-scoring nodes instead of colliding on
     # one argmax — fewer contention waves for identical placements.
-    h = (jnp.arange(Np, dtype=jnp.uint32)[None, :] * jnp.uint32(2654435761)
+    node_gids = jnp.arange(Np, dtype=jnp.uint32)
+    if in_mesh:
+        # jitter hashes the GLOBAL node id so seeded scoring is
+        # invariant to how the node axis is split over the mesh
+        node_gids = node_gids + off.astype(jnp.uint32)
+    h = (node_gids[None, :] * jnp.uint32(2654435761)
          + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
             + jnp.uint32(seed)) * jnp.uint32(40503))
     h = (h ^ (h >> 16)) * jnp.uint32(2246822519)
@@ -393,6 +433,19 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     if pallas_mode == "auto":
         from . import pallas_kernel as _pk
         pallas_mode = _pk.resolve_mode(Np, Gp, TK, V, has_spread)
+    Vs_i = sp_desired.shape[2]
+    want_tables = has_spread and Vs_i <= 8 and not stack_commit
+    # per-value candidate-table widths: TKv is the GLOBAL interleave
+    # window per value class; TW the shard-local extraction width (the
+    # merge only needs each shard's top min(TKv, Np_local) per class).
+    TKv = -(-TK // (Vs_i + 1)) if want_tables else 0
+    TW = min(TKv, Np) if want_tables else 0
+    if in_mesh and pallas_mode == "topk" and want_tables and TW < TKv:
+        # the fused kernel derives its table width from TK, which on a
+        # shard narrower than TKv would pad tables past the local
+        # plane; the "score" pass is the same exact math unfused and
+        # lets the jnp extraction use the shard-local width
+        pallas_mode = "score"
     use_pk = pallas_mode != "off"
     if use_pk:
         from . import pallas_kernel as _pk
@@ -502,8 +555,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         return score, placeable, feas_b, fit, fit_dims, dev_fit
 
     # ---------- shortlist scoring twin ----------
-    Vs_i = sp_desired.shape[2]
-    want_tables = has_spread and Vs_i <= 8 and not stack_commit
+    def _lex_topk(score, idx, k):
+        """Descending (score, ascending node id) top-k — the exact
+        tie order lax.top_k uses over the full node axis, and the
+        order the cross-shard candidate-key merge sorts in."""
+        neg, six = lax.sort((-score, idx), num_keys=2)
+        return -neg[..., :k], six[..., :k]
+
     if use_sl:
         def _sl_eval(sl, used_x, dev_used_x, sp_used_x):
             """EXACT score/indicator recompute for the <= C shortlist
@@ -589,7 +647,10 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                      + spread_total) / n_scorers
             total = jnp.where(jnp.int32(seed) == 0, total,
                               jnp.floor(total / SCORE_BIN) * SCORE_BIN)
-            h2 = (idx.astype(jnp.uint32) * jnp.uint32(2654435761)
+            gid = idx.astype(jnp.uint32)
+            if in_mesh:
+                gid = gid + off.astype(jnp.uint32)
+            h2 = (gid * jnp.uint32(2654435761)
                   + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
                      + jnp.uint32(seed)) * jnp.uint32(40503))
             h2 = (h2 ^ (h2 >> 16)) * jnp.uint32(2246822519)
@@ -601,12 +662,6 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             exh = sl.feas & ~(fit & dev_fit)
             dim_ind = sl.feas[:, :, None] & ~fit_dims
             return score, placeable, exh, dim_ind
-
-        def _lex_topk(score, idx, k):
-            """Descending (score, ascending node id) top-k — the exact
-            tie order lax.top_k uses over the full node axis."""
-            neg, six = lax.sort((-score, idx), num_keys=2)
-            return -neg[:, :k], six[:, :k]
 
         sl0 = _SLState(
             idx=jnp.zeros((Gp, C), jnp.int32),
@@ -624,8 +679,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             nfeas=jnp.zeros(Gp, jnp.int32),
             nexh=jnp.zeros(Gp, jnp.int32),
             ndim=jnp.zeros((Gp, R), jnp.int32),
-            win_s=jnp.full((Gp, TK), NEG_INF, jnp.float32),
-            win_i=jnp.zeros((Gp, TK), jnp.int32),
+            win_s=jnp.full((Gp, TKl), NEG_INF, jnp.float32),
+            win_i=jnp.zeros((Gp, TKl), jnp.int32),
+            tb_s=jnp.full((Gp, Vs_i + 1, TW) if want_tables
+                          else (Gp, 1, 1), NEG_INF, jnp.float32),
+            tb_i=jnp.zeros((Gp, Vs_i + 1, TW) if want_tables
+                           else (Gp, 1, 1), jnp.int32),
             gany=jnp.zeros(Gp, bool),
             ok=jnp.bool_(False))
     else:
@@ -676,14 +735,24 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             the shortlist path is on, rebuild the carried shortlist
             from the same extraction."""
             committed = done & out_ok[:, 0]
+            # out_idx holds GLOBAL node ids; scatters into the local
+            # plane drop rows owned by other shards (mode="drop";
+            # negative locals are pinned to Np first — scatter WRAPS
+            # python-style negatives before the drop check)
             chosen = jnp.where(committed, out_idx[:, 0], 0)
-            coll = coll0.at[g_idx, chosen].add(
-                committed.astype(jnp.float32))
+            if in_mesh:
+                chosen_l = chosen - off
+                chosen_l = jnp.where(chosen_l >= 0, chosen_l, Np)
+            else:
+                chosen_l = chosen
+            coll = coll0.at[g_idx, chosen_l].add(
+                committed.astype(jnp.float32), mode="drop")
             if has_distinct:
                 dg_all = distinct[g_idx]
                 hit = jnp.zeros((Gp, Np), jnp.int32).at[
-                    jnp.maximum(dg_all, 0), chosen].add(
-                    (committed & (dg_all >= 0)).astype(jnp.int32)) > 0
+                    jnp.maximum(dg_all, 0), chosen_l].add(
+                    (committed & (dg_all >= 0)).astype(jnp.int32),
+                    mode="drop") > 0
                 blocked = hit[jnp.maximum(distinct, 0)] \
                     & (distinct >= 0)[:, None]
             else:
@@ -756,32 +825,18 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 ext_s, ext_i = lax.approx_max_k(score, NE)
             else:
                 ext_s, ext_i = lax.top_k(score, NE)        # [Gp, NE]
-            top_score, top_idx = ext_s[:, :TK], ext_i[:, :TK]
+            top_score, top_idx = ext_s[:, :TKl], ext_i[:, :TKl]
 
-            # spread-aware candidate interleaving (slot 0): when node
-            # classes correlate with the spread attribute (racks live
-            # in one dc, zones in one region — the common cluster
-            # layout), a group's global top-W concentrates in ONE value
-            # and the spread quota strands all but a few commits per
-            # wave. Instead, build a per-value top list and interleave
-            # (slot j -> value j mod V), so a group's candidates arrive
-            # pre-balanced across values; holes (exhausted values)
-            # compact to the tail to keep the rank-wrap contiguous.
-            # Skipped for huge vocabularies where per-value extraction
-            # would dominate.
-            # (skipped in stack_commit mode: stacking aims every
-            # placement at slot 0, and the reference picks the max
-            # TOTAL score — the spread term is already inside the
-            # score; forcing slot 0 to the spread-preferred value would
-            # override the argmax)
+            # per-value candidate tables for the spread interleave
+            # (applied to the window AFTER the cross-shard merge — see
+            # _interleave in the wave body); extraction is shard-local
+            # at width TW (= TKv off-mesh: unchanged single-device
+            # trace).  One class per value PLUS a class for nodes
+            # MISSING the spread attribute — the reference still places
+            # on those with a -1 score penalty (spread.go), so they
+            # must stay candidates or feasible nodes would livelock
+            # unplaced.
             if want_tables:
-                has0 = sp_col[:, 0] >= 0                   # [Gp]
-                # one class per value PLUS a class for nodes MISSING
-                # the spread attribute — the reference still places on
-                # those with a -1 score penalty (spread.go), so they
-                # must stay candidates or feasible nodes would livelock
-                # unplaced
-                TKv = -(-TK // (Vs + 1))
                 if use_pk and pallas_mode == "topk":
                     # per-value tables came out of the fused pass; the
                     # tile-partial merge is exact-equal to the full-row
@@ -794,30 +849,21 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                         vmask = (vnode == v) if v < Vs else (vnode < 0)
                         sv = jnp.where(vmask, score, NEG_INF)
                         if Np >= _APPROX_MIN_NP:
-                            ts, ti = lax.approx_max_k(sv, TKv)
+                            ts, ti = lax.approx_max_k(sv, TW)
                         else:
-                            ts, ti = lax.top_k(sv, TKv)
+                            ts, ti = lax.top_k(sv, TW)
                         tabs_i.append(ti)
                         tabs_s.append(ts)
-                    tab_i = jnp.stack(tabs_i, axis=1)      # [Gp, V+1, TKv]
+                    tab_i = jnp.stack(tabs_i, axis=1)      # [Gp, V+1, TW]
                     tab_s = jnp.stack(tabs_s, axis=1)
-                # visit values in each group's preference order (best
-                # head candidate first), so the first interleaved
-                # slot — where a lone remaining placement always
-                # lands — is the value the spread scoring actually
-                # favors this wave
-                vord = jnp.argsort(-tab_s[:, :, 0], axis=1)  # [Gp, V+1]
-                j = jnp.arange(TK)
-                vj = vord[:, j % (Vs + 1)]                 # [Gp, TK]
-                inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
-                inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
-                order = jnp.argsort((inter_s <= NEG_INF / 2)
-                                    .astype(jnp.int32), axis=1,
-                                    stable=True)
-                inter_i = jnp.take_along_axis(inter_i, order, axis=1)
-                inter_s = jnp.take_along_axis(inter_s, order, axis=1)
-                top_idx = jnp.where(has0[:, None], inter_i, top_idx)
-                top_score = jnp.where(has0[:, None], inter_s, top_score)
+                if in_mesh:
+                    tab_i = tab_i + off
+            else:
+                tab_s = jnp.full((Gp, 1, 1), NEG_INF, jnp.float32)
+                tab_i = jnp.zeros((Gp, 1, 1), jnp.int32)
+            if in_mesh:
+                # window keys leave the shard with GLOBAL node ids
+                top_idx = top_idx + off
 
             if use_sl:
                 # rebuild the carried shortlist from this extraction
@@ -844,24 +890,89 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                     comp=(n_feas_g - n_exh_g) <= jnp.int32(C),
                     nfeas=n_feas_g, nexh=n_exh_g, ndim=dim_exh_g,
                     win_s=top_score, win_i=top_idx,
+                    tb_s=tab_s, tb_i=tab_i,
                     gany=grp_any, ok=jnp.bool_(False))
-            return (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g,
-                    grp_any, SL, jnp.int32(1))
+            return (top_score, top_idx, tab_s, tab_i, n_feas_g,
+                    n_exh_g, dim_exh_g, grp_any, SL, jnp.int32(1))
 
         if use_sl:
             def carried_wave(SL):
                 # shortlist wave: the window and counters were
                 # pre-computed at the end of the previous wave from the
                 # carried shortlist — no [Gp, Np] plane is touched
-                return (SL.win_s, SL.win_i, SL.nfeas, SL.nexh, SL.ndim,
-                        SL.gany, SL, jnp.int32(0))
+                return (SL.win_s, SL.win_i, SL.tb_s, SL.tb_i, SL.nfeas,
+                        SL.nexh, SL.ndim, SL.gany, SL, jnp.int32(0))
 
-            (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g, grp_any,
-             SL, resc) = lax.cond(SL.ok, carried_wave, full_wave, SL)
+            (top_score, top_idx, tab_s, tab_i, n_feas_g, n_exh_g,
+             dim_exh_g, grp_any, SL, resc) = lax.cond(
+                 SL.ok, carried_wave, full_wave, SL)
         else:
-            (top_score, top_idx, n_feas_g, n_exh_g, dim_exh_g, grp_any,
-             SL, resc) = full_wave(SL)
+            (top_score, top_idx, tab_s, tab_i, n_feas_g, n_exh_g,
+             dim_exh_g, grp_any, SL, resc) = full_wave(SL)
         n_resc = n_resc + resc
+
+        # ---- cross-shard candidate-key merge (mesh mode) ----
+        # The ONLY per-wave ICI traffic: each shard's [Gp, TKl] window
+        # keys (+ [Gp, V+1, TW] value-table keys when the spread
+        # interleave is on) are all-gathered and exactly merged by the
+        # same lex order the per-shard extraction used — equal to a
+        # single device's top-TK over the whole node axis.  Counters
+        # reduce with a [Gp]-sized psum; no [Gp, Np] plane ever leaves
+        # a shard.  Either branch of the cond above is collective-free,
+        # so shards may mix carried/full waves freely — each shard's
+        # contribution is trigger-proven exact either way.
+        if in_mesh:
+            gw_s = lax.all_gather(top_score, mesh_axis, axis=1,
+                                  tiled=True)   # [Gp, TKl * shards]
+            gw_i = lax.all_gather(top_idx, mesh_axis, axis=1,
+                                  tiled=True)
+            top_score, top_idx = _lex_topk(gw_s, gw_i, TK)
+            if want_tables:
+                gt_s = lax.all_gather(tab_s, mesh_axis, axis=2,
+                                      tiled=True)  # [Gp, V+1, TW*shards]
+                gt_i = lax.all_gather(tab_i, mesh_axis, axis=2,
+                                      tiled=True)
+                tab_s, tab_i = _lex_topk(gt_s, gt_i, TKv)
+            n_feas_out = lax.psum(n_feas_g, mesh_axis)
+            n_exh_out = lax.psum(n_exh_g, mesh_axis)
+            dim_exh_out = lax.psum(dim_exh_g, mesh_axis)
+            grp_any = lax.psum(grp_any.astype(jnp.int32), mesh_axis) > 0
+        else:
+            n_feas_out, n_exh_out, dim_exh_out = (n_feas_g, n_exh_g,
+                                                  dim_exh_g)
+
+        # spread-aware candidate interleaving (slot 0): when node
+        # classes correlate with the spread attribute (racks live in
+        # one dc, zones in one region — the common cluster layout), a
+        # group's global top-W concentrates in ONE value and the spread
+        # quota strands all but a few commits per wave. Instead,
+        # interleave the per-value tables (slot j -> value j mod V), so
+        # a group's candidates arrive pre-balanced across values; holes
+        # (exhausted values) compact to the tail to keep the rank-wrap
+        # contiguous. Skipped for huge vocabularies where per-value
+        # extraction would dominate.
+        # (skipped in stack_commit mode: stacking aims every placement
+        # at slot 0, and the reference picks the max TOTAL score — the
+        # spread term is already inside the score; forcing slot 0 to
+        # the spread-preferred value would override the argmax)
+        if want_tables:
+            has0 = sp_col[:, 0] >= 0                       # [Gp]
+            # visit values in each group's preference order (best head
+            # candidate first), so the first interleaved slot — where a
+            # lone remaining placement always lands — is the value the
+            # spread scoring actually favors this wave
+            vord = jnp.argsort(-tab_s[:, :, 0], axis=1)    # [Gp, V+1]
+            j = jnp.arange(TK)
+            vj = vord[:, j % (Vs + 1)]                     # [Gp, TK]
+            inter_i = tab_i[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            inter_s = tab_s[gs[:, None], vj, (j // (Vs + 1))[None, :]]
+            order = jnp.argsort((inter_s <= NEG_INF / 2)
+                                .astype(jnp.int32), axis=1,
+                                stable=True)
+            inter_i = jnp.take_along_axis(inter_i, order, axis=1)
+            inter_s = jnp.take_along_axis(inter_s, order, axis=1)
+            top_idx = jnp.where(has0[:, None], inter_i, top_idx)
+            top_score = jnp.where(has0[:, None], inter_s, top_score)
 
         # rank each active placement within its group, then assign the
         # r-th remaining placement the group's (r mod M)-th best node,
@@ -969,15 +1080,49 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
         res_k = ask_res[g_idx] * cand_ok[:, None]
         prior = prior_sum_node(res_k)                      # [K, R]
-        fits = ((used[cand] + prior + ask_res[g_idx])
-                <= avail[cand]).all(axis=-1)
+        if in_mesh:
+            # candidate rows live on their owning shard: each shard
+            # evaluates the fit for the <= K candidates it owns and the
+            # K-sized bit vectors reduce over ICI (candidate-only
+            # traffic — the [Np, R] planes stay put)
+            loc = cand - off
+            inb = (loc >= 0) & (loc < Np)
+            # scatter-safe local index: negative locals WRAP python-
+            # style before mode="drop" checks bounds, so pin every
+            # non-owned candidate to the (always-dropped) Np slot
+            loc = jnp.where(inb, loc, Np)
+            locc = jnp.clip(loc, 0, Np - 1)
+            fits_l = ((used[locc] + prior + ask_res[g_idx])
+                      <= avail[locc]).all(axis=-1) & inb
+            fits = lax.psum(fits_l.astype(jnp.int32), mesh_axis) > 0
+        else:
+            loc = locc = cand
+            inb = None
+            fits = ((used[cand] + prior + ask_res[g_idx])
+                    <= avail[cand]).all(axis=-1)
         if has_devices:
             dev_k = dev_ask[g_idx] * cand_ok[:, None]
             prior_dev = prior_sum_node(dev_k)              # [K, D]
-            dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
-                        <= dev_cap[cand]).all(axis=-1)
+            if in_mesh:
+                dev_fits_l = ((dev_used[locc] + prior_dev
+                               + dev_ask[g_idx])
+                              <= dev_cap[locc]).all(axis=-1) & inb
+                dev_fits = lax.psum(dev_fits_l.astype(jnp.int32),
+                                    mesh_axis) > 0
+            else:
+                dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
+                            <= dev_cap[cand]).all(axis=-1)
         else:
             dev_fits = jnp.ones(K, bool)
+        if in_mesh and has_spread:
+            # one [K, A] psum-gather of the candidates' attribute-rank
+            # rows serves both the spread quota and the commit below
+            ar_cand = lax.psum(
+                jnp.where(inb[:, None],
+                          attr_rank[locc].astype(jnp.int32), 0),
+                mesh_axis)
+        else:
+            ar_cand = None
 
         # distinct_hosts: one commit per (node, distinct group) per wave;
         # cross-wave blocking keeps later waves off the node too
@@ -996,7 +1141,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         sp_ok = jnp.ones(K, bool)
         for s in (range(S) if has_spread else range(0)):
             cols = sp_col[g_idx, s]
-            vs = attr_rank[cand, jnp.maximum(cols, 0)]
+            if in_mesh:
+                vs = jnp.take_along_axis(
+                    ar_cand, jnp.maximum(cols, 0)[:, None], axis=1)[:, 0]
+            else:
+                vs = attr_rank[cand, jnp.maximum(cols, 0)]
             has_s = (cols >= 0) & (vs >= 0)
             vsc = jnp.maximum(vs, 0)
             des_s = sp_desired[:, s]                       # [Gp, V]
@@ -1039,12 +1188,21 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         cm = commit[:, None]
 
         # -- apply all of this wave's commits at once (coll/blocked are
-        # rebuilt from the outputs next wave, not carried) --
-        used = used.at[cand].add(ask_res[g_idx] * cm)
+        # rebuilt from the outputs next wave, not carried); in mesh
+        # mode each shard scatters only the rows it owns (mode="drop"
+        # discards other shards' candidates) while the replicated
+        # sp_used updates identically everywhere --
+        used = used.at[loc].add(ask_res[g_idx] * cm, mode="drop")
         if has_devices:
-            dev_used = dev_used.at[cand].add(dev_ask[g_idx] * cm)
+            dev_used = dev_used.at[loc].add(dev_ask[g_idx] * cm,
+                                            mode="drop")
         if has_spread:
-            svals = attr_rank[cand[:, None], jnp.maximum(sp_col[g_idx], 0)]
+            if in_mesh:
+                svals = jnp.take_along_axis(
+                    ar_cand, jnp.maximum(sp_col[g_idx], 0), axis=1)
+            else:
+                svals = attr_rank[cand[:, None],
+                                  jnp.maximum(sp_col[g_idx], 0)]
             okslot = (sp_col[g_idx] >= 0) & (svals >= 0) & cm
             sp_used = sp_used.at[g_idx[:, None], jnp.arange(S)[None, :],
                                  jnp.maximum(svals, 0)].add(
@@ -1061,9 +1219,10 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         out_idx = jnp.where(upd, pk_idx, out_idx)
         out_score = jnp.where(upd, pk_score, out_score)
         out_ok = jnp.where(upd, pk_ok & cm, out_ok)
-        out_nfeas = jnp.where(newly, n_feas_g[g_idx], out_nfeas)
-        out_nexh = jnp.where(newly, n_exh_g[g_idx], out_nexh)
-        out_dimexh = jnp.where(newly[:, None], dim_exh_g[g_idx], out_dimexh)
+        out_nfeas = jnp.where(newly, n_feas_out[g_idx], out_nfeas)
+        out_nexh = jnp.where(newly, n_exh_out[g_idx], out_nexh)
+        out_dimexh = jnp.where(newly[:, None], dim_exh_out[g_idx],
+                               out_dimexh)
         done = done | newly
 
         if use_sl:
@@ -1077,11 +1236,19 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 active_next.astype(jnp.int32)) > 0
             any_next = active_next.any()
             cf = commit.astype(jnp.float32)
-            tot = cf.sum()
             # TR1: every commit this wave (any group's) landed inside
             # this group's shortlist — otherwise an outsider's bin-pack
-            # score moved and the frozen cutoff bound is void
-            mark = jnp.zeros(Np, jnp.float32).at[cand].add(cf)
+            # score moved and the frozen cutoff bound is void.  In mesh
+            # mode only commits to THIS shard's nodes can move scores
+            # on this shard's plane (binpack/coll are per-node, spread
+            # is globally gated below), so the audit is shard-local:
+            # owned commits vs the local shortlist.
+            if in_mesh:
+                tot = (cf * inb.astype(jnp.float32)).sum()
+            else:
+                tot = cf.sum()
+            mark = jnp.zeros(Np, jnp.float32).at[loc].add(cf,
+                                                          mode="drop")
             tr1_g = mark[SL.idx].sum(axis=1) == tot
             g_committed = jnp.zeros(Gp, jnp.float32).at[g_idx].add(
                 cf) > 0
@@ -1110,9 +1277,10 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             # full-wave window may hold interleave entries outside the
             # shortlist — those drop here AND fail TR1, forcing the
             # rescore that rebuilds coll from the plane)
-            win_pos = jax.vmap(jnp.searchsorted)(SL.idx, top_idx)
+            tloc = top_idx - off if in_mesh else top_idx
+            win_pos = jax.vmap(jnp.searchsorted)(SL.idx, tloc)
             pos_hit = jnp.take_along_axis(
-                SL.idx, jnp.minimum(win_pos, C - 1), axis=1) == top_idx
+                SL.idx, jnp.minimum(win_pos, C - 1), axis=1) == tloc
             win_pos = jnp.where(pos_hit, win_pos, C)       # drop slot
             cand_pos = win_pos[g_idx, cr]
             SL = SL._replace(coll=SL.coll.at[g_idx, cand_pos].add(
@@ -1133,63 +1301,56 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                          - dim_pre.astype(jnp.int32)).sum(axis=1)
                 nexh_next = n_exh_g + d_exh
                 ndim_next = dim_exh_g + d_dim
-                w_s, w_i = _lex_topk(f_score, sl.idx, TK)
-                # TR3: the re-ranked TK-th key must still dominate the
-                # era cutoff — no frozen outsider can rank inside
-                ls, li = w_s[:, TK - 1], w_i[:, TK - 1]
+                w_s, w_i = _lex_topk(f_score, sl.idx, TKl)
+                # TR3: the re-ranked TKl-th key must still dominate the
+                # era cutoff — no frozen outsider can rank inside (both
+                # sides of the lex compare are shard-LOCAL node ids)
+                ls, li = w_s[:, TKl - 1], w_i[:, TKl - 1]
                 tr3_g = (ls > sl.cut_s) | ((ls == sl.cut_s)
                                            & (li <= sl.cut_i))
                 if want_tables:
-                    # spread interleave from shortlist-local per-value
-                    # tables: exact for the groups that reach here
-                    # (`comp` guarantees every placeable class member
-                    # is present; NEG_INF filler indices differ from
-                    # the full pass but are compacted to the tail and
-                    # never commit)
-                    has0 = sp_col[:, 0] >= 0
-                    TKv = -(-TK // (Vs + 1))
+                    # shortlist-local per-value tables for the post-
+                    # merge interleave: exact for the groups that reach
+                    # here (`comp` guarantees every placeable class
+                    # member is present; NEG_INF filler indices differ
+                    # from the full pass but are compacted to the tail
+                    # and never commit)
                     vnode0 = sl.vn[0]
                     tabs_s, tabs_i = [], []
                     for v in range(Vs + 1):
                         vmask = ((vnode0 == v) if v < Vs
                                  else (vnode0 < 0))
                         sv = jnp.where(vmask, f_score, NEG_INF)
-                        ts, ti = _lex_topk(sv, sl.idx, TKv)
+                        ts, ti = _lex_topk(sv, sl.idx, TW)
                         tabs_s.append(ts)
                         tabs_i.append(ti)
-                    tab_s = jnp.stack(tabs_s, axis=1)
+                    tab_s = jnp.stack(tabs_s, axis=1)   # [Gp, V+1, TW]
                     tab_i = jnp.stack(tabs_i, axis=1)
-                    vord = jnp.argsort(-tab_s[:, :, 0], axis=1)
-                    j = jnp.arange(TK)
-                    vj = vord[:, j % (Vs + 1)]
-                    inter_i = tab_i[gs[:, None], vj,
-                                    (j // (Vs + 1))[None, :]]
-                    inter_s = tab_s[gs[:, None], vj,
-                                    (j // (Vs + 1))[None, :]]
-                    order = jnp.argsort((inter_s <= NEG_INF / 2)
-                                        .astype(jnp.int32), axis=1,
-                                        stable=True)
-                    inter_i = jnp.take_along_axis(inter_i, order,
-                                                  axis=1)
-                    inter_s = jnp.take_along_axis(inter_s, order,
-                                                  axis=1)
-                    w_i = jnp.where(has0[:, None], inter_i, w_i)
-                    w_s = jnp.where(has0[:, None], inter_s, w_s)
+                    if in_mesh:
+                        tab_i = tab_i + off
+                else:
+                    tab_s = jnp.full((Gp, 1, 1), NEG_INF, jnp.float32)
+                    tab_i = jnp.zeros((Gp, 1, 1), jnp.int32)
                 gany_next = jnp.where(sl.comp, f_place.any(axis=1),
                                       jnp.bool_(True))
                 ok_next = ((tr3_g | sl.comp) | ~act_next_g).all()
-                return (w_s, w_i, nexh_next, ndim_next, gany_next,
-                        ok_next)
+                if in_mesh:
+                    w_i = w_i + off
+                return (w_s, w_i, tab_s, tab_i, nexh_next, ndim_next,
+                        gany_next, ok_next)
 
             def skip(sl):
-                return (jnp.full((Gp, TK), NEG_INF, jnp.float32),
-                        jnp.zeros((Gp, TK), jnp.int32),
+                return (jnp.full((Gp, TKl), NEG_INF, jnp.float32),
+                        jnp.zeros((Gp, TKl), jnp.int32),
+                        jnp.full(sl.tb_s.shape, NEG_INF, jnp.float32),
+                        jnp.zeros(sl.tb_i.shape, jnp.int32),
                         sl.nexh, sl.ndim, jnp.zeros(Gp, bool),
                         jnp.bool_(False))
 
-            nw_s, nw_i, n_nexh, n_ndim, n_gany, sl_ok = lax.cond(
-                pre_ok, rerank, skip, SL)
-            SL = SL._replace(win_s=nw_s, win_i=nw_i, nfeas=n_feas_g,
+            (nw_s, nw_i, ntb_s, ntb_i, n_nexh, n_ndim, n_gany,
+             sl_ok) = lax.cond(pre_ok, rerank, skip, SL)
+            SL = SL._replace(win_s=nw_s, win_i=nw_i, tb_s=ntb_s,
+                             tb_i=ntb_i, nfeas=n_feas_g,
                              nexh=n_nexh, ndim=n_ndim, gany=n_gany,
                              ok=pre_ok & sl_ok)
 
@@ -1240,6 +1401,11 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
      out_nfeas, out_nexh, out_dimexh, waves, n_resc, _) = st_final
     unfinished = ~done & (ks < n_place)
+    if in_mesh:
+        # per-shard full-pass count summed over the mesh: the HBM byte
+        # model multiplies bytes_wave1 (a PER-SHARD plane walk) by this
+        n_resc = (lax.psum(n_resc, mesh_axis) if use_sl
+                  else waves * jnp.int32(mesh_shards))
 
     return SolveResult(choice=out_idx, choice_ok=out_ok, score=out_score,
                        n_feasible=out_nfeas, n_exhausted=out_nexh,
@@ -1247,4 +1413,5 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                        cons_filtered=cons_filtered, used_final=used_final,
                        dev_used_final=dev_used_final, n_waves=waves,
                        unfinished=unfinished,
-                       n_rescore=(n_resc if use_sl else waves))
+                       n_rescore=(n_resc if (use_sl or in_mesh)
+                                  else waves))

@@ -197,7 +197,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                used, dev_used, batch, n_place, seed=0, has_spread=True,
                group_count_hint=0, max_waves=0, wave_mode="scan",
                has_distinct=True, has_devices=True, stack_commit=False,
-               pallas_mode="off", shortlist_c=0):
+               pallas_mode="off", shortlist_c=0, mesh_axis=None,
+               mesh_shards=0):
     # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
     # lanes, 1/8th the transport bytes of the dense bool planes);
     # unpack on device — dtype is static, so either form compiles once
@@ -223,7 +224,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         max_waves=max_waves, wave_mode=wave_mode,
         has_distinct=has_distinct, has_devices=has_devices,
         stack_commit=stack_commit, pallas_mode=pallas_mode,
-        shortlist_c=shortlist_c)
+        shortlist_c=shortlist_c, mesh_axis=mesh_axis,
+        mesh_shards=mesh_shards)
 
 
 @functools.partial(jax.jit,
@@ -426,21 +428,44 @@ class ResidentSolver:
         self._const_cache: Dict[Tuple[str, int], object] = {}
         self._put_node_side()
 
+    #: subclass hook (parallel.sharded): bitpacking bool ask planes
+    #: would split 32 node columns per uint32 lane, which a node-axis
+    #: NamedSharding cannot partition cleanly — the mesh solver ships
+    #: them dense instead
+    _pack_bool_planes = True
+
+    def _put_node(self, name: str, arr):
+        """Device placement for one node-side tensor (subclass hook:
+        the mesh-resident solver pins a node-axis NamedSharding).
+
+        Always COPIES first: CPU device_put can alias the numpy buffer
+        zero-copy, and apply_delta later mutates the template arrays IN
+        PLACE host-side (apply_node_delta_host) — through an alias the
+        device carry would see both the host `+=` and the device
+        scatter-add, double-charging usage depending on nothing more
+        than heap alignment."""
+        return jax.device_put(np.array(arr))
+
+    def _put_ask(self, name: str, arr):
+        """Device placement for one stacked [B, ...] ask tensor
+        (subclass hook, as _put_node)."""
+        return jax.device_put(arr)
+
     def _put_node_side(self) -> None:
         """Ship the full node-side tensors to device (initial build and
         the repack-fallback path) and rebuild everything derived from
         the node axis."""
         t = self.template
         self._dev_node = {
-            "avail": jax.device_put(t.avail),
-            "reserved": jax.device_put(t.reserved),
-            "valid": jax.device_put(t.valid),
-            "node_dc": jax.device_put(t.node_dc),
-            "attr_rank": jax.device_put(t.attr_rank),
-            "dev_cap": jax.device_put(t.dev_cap),
+            "avail": self._put_node("avail", t.avail),
+            "reserved": self._put_node("reserved", t.reserved),
+            "valid": self._put_node("valid", t.valid),
+            "node_dc": self._put_node("node_dc", t.node_dc),
+            "attr_rank": self._put_node("attr_rank", t.attr_rank),
+            "dev_cap": self._put_node("dev_cap", t.dev_cap),
         }
-        self._used = jax.device_put(t.used0)
-        self._dev_used = jax.device_put(t.dev_used0)
+        self._used = self._put_node("used", t.used0)
+        self._dev_used = self._put_node("dev_used", t.dev_used0)
         # compact int16 result payload needs int16-expressible node ids
         self._compact = t.avail.shape[0] < 32768
         self._default_host_ok = np.zeros((self.gp, t.avail.shape[0]),
@@ -450,6 +475,19 @@ class ResidentSolver:
             t.avail.nbytes + t.reserved.nbytes + t.valid.nbytes
             + t.node_dc.nbytes + t.attr_rank.nbytes + t.dev_cap.nbytes
             + t.used0.nbytes + t.dev_used0.nbytes)
+
+    def _delta_set(self, arr, idx, rows):
+        """Row-scatter 'set' into resident node state (subclass hook:
+        the mesh solver routes rows to the owning shard — the plain
+        jit scatter is only partition-safe on one device)."""
+        from .kernel import delta_scatter_set
+        return delta_scatter_set(arr, idx, rows)
+
+    def _delta_add(self, arr, idx, rows):
+        """Row-scatter 'add' into carried usage (subclass hook, as
+        _delta_set)."""
+        from .kernel import delta_scatter_add
+        return delta_scatter_add(arr, idx, rows)
 
     # ------------------------------------------------- delta lifecycle
     def apply_delta(self, delta) -> str:
@@ -464,7 +502,6 @@ class ResidentSolver:
         interning-table invalidation), overflows the padded node axis,
         or touches more than `delta_threshold` of the real node slots.
         """
-        from .kernel import delta_scatter_add, delta_scatter_set
         from .tensorize import apply_node_delta_host
         if delta.empty():
             return "delta"
@@ -508,14 +545,14 @@ class ResidentSolver:
                          nd.node_dc.astype(np.asarray(
                              dn["node_dc"]).dtype), nd.attr_rank,
                          nd.dev_cap], repeat_first=True)
-            dn["avail"] = delta_scatter_set(dn["avail"], idx, r_avail)
-            dn["reserved"] = delta_scatter_set(dn["reserved"], idx,
-                                               r_res)
-            dn["valid"] = delta_scatter_set(dn["valid"], idx, r_valid)
-            dn["node_dc"] = delta_scatter_set(dn["node_dc"], idx, r_dc)
-            dn["attr_rank"] = delta_scatter_set(dn["attr_rank"], idx,
-                                                r_attr)
-            dn["dev_cap"] = delta_scatter_set(dn["dev_cap"], idx, r_dev)
+            dn["avail"] = self._delta_set(dn["avail"], idx, r_avail)
+            dn["reserved"] = self._delta_set(dn["reserved"], idx,
+                                             r_res)
+            dn["valid"] = self._delta_set(dn["valid"], idx, r_valid)
+            dn["node_dc"] = self._delta_set(dn["node_dc"], idx, r_dc)
+            dn["attr_rank"] = self._delta_set(dn["attr_rank"], idx,
+                                              r_attr)
+            dn["dev_cap"] = self._delta_set(dn["dev_cap"], idx, r_dev)
             # node-shape changes invalidate every cached host mask and
             # packed batch (driver/volume feasibility, host_ok widths)
             self._node_epoch += 1
@@ -532,9 +569,9 @@ class ResidentSolver:
         if nd.u_idx.size:
             u_idx, (u_res, u_dev) = _pad(nd.u_idx, [nd.u_res, nd.u_dev],
                                          repeat_first=False)
-            self._used = delta_scatter_add(self._used, u_idx, u_res)
-            self._dev_used = delta_scatter_add(self._dev_used, u_idx,
-                                               u_dev)
+            self._used = self._delta_add(self._used, u_idx, u_res)
+            self._dev_used = self._delta_add(self._dev_used, u_idx,
+                                             u_dev)
         self.delta_counters["delta_applies"] += 1
         self.delta_counters["bytes_dispatched_delta"] += nd.nbytes()
         return "delta"
@@ -952,7 +989,8 @@ class ResidentSolver:
                     or not any(m.any() for m in mats)):
                 key = (name, B)
                 if key not in self._const_cache:
-                    self._const_cache[key] = jax.device_put(
+                    self._const_cache[key] = self._put_ask(
+                        name,
                         np.zeros((B,) + mats[0].shape, mats[0].dtype))
                 stacked[name] = self._const_cache[key]
                 continue
@@ -962,13 +1000,14 @@ class ResidentSolver:
                            for m in mats)):
                 key = (name, B)
                 if key not in self._const_cache:
-                    self._const_cache[key] = jax.device_put(np.broadcast_to(
-                        self._default_host_ok,
-                        (B,) + self._default_host_ok.shape).copy())
+                    self._const_cache[key] = self._put_ask(
+                        name, np.broadcast_to(
+                            self._default_host_ok,
+                            (B,) + self._default_host_ok.shape).copy())
                 stacked[name] = self._const_cache[key]
                 continue
             arr = np.stack(mats)
-            if name in ("host_ok", "penalty"):
+            if name in ("host_ok", "penalty") and self._pack_bool_planes:
                 # ship the bool planes bitpacked (uint32 lanes, 8x
                 # fewer transport bytes); _solve_one unpacks on device
                 from .masks import np_pack_bool_u32
@@ -977,7 +1016,7 @@ class ResidentSolver:
             stacked[name] = arr
         self.last_dispatch_bytes = shipped
         if B == 1:
-            dev = {k: (jax.device_put(v) if isinstance(v, np.ndarray)
+            dev = {k: (self._put_ask(k, v) if isinstance(v, np.ndarray)
                        else v) for k, v in stacked.items()}
             batches[0].__dict__["_dev_stacked"] = (self._node_epoch, dev)
             return dev
@@ -1065,7 +1104,7 @@ class ResidentSolver:
     def reset_usage(self, used0: Optional[np.ndarray] = None,
                     dev_used0: Optional[np.ndarray] = None) -> None:
         t = self.template
-        self._used = jax.device_put(
-            t.used0 if used0 is None else used0)
-        self._dev_used = jax.device_put(
-            t.dev_used0 if dev_used0 is None else dev_used0)
+        self._used = self._put_node(
+            "used", t.used0 if used0 is None else used0)
+        self._dev_used = self._put_node(
+            "dev_used", t.dev_used0 if dev_used0 is None else dev_used0)

@@ -158,6 +158,35 @@ FIX_JIT = """
     def good_carry_reader(carry, x):
         carry = donating_carry(carry, x)
         return carry[0]               # rebound carry: fine
+
+
+    @jax.jit
+    def meshless_kernel(x):
+        total = jax.lax.psum(x, "nodes")                   # JIT205
+        return total + jax.lax.axis_index("nodes")         # JIT205
+
+
+    def meshy_body(x):
+        g = jax.lax.all_gather(x, "nodes", axis=0, tiled=True)
+        return g + jax.lax.psum(x, "nodes")   # mesh root: fine
+
+
+    def meshy_helper(x):
+        # reachable FROM the shard_map body: fine
+        return jax.lax.psum(x, "nodes")
+
+
+    def meshy_partial_body(x, scale):
+        return meshy_helper(x) * scale
+
+
+    def run_meshy(mesh, x):
+        from jax.experimental.shard_map import shard_map
+        f = shard_map(meshy_body, mesh=mesh, in_specs=None,
+                      out_specs=None)
+        body = functools.partial(meshy_partial_body, scale=2)
+        g = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
+        return f(x) + g(x)
 """
 
 FIX_LOCKS = """
@@ -319,6 +348,17 @@ def test_jit_donated_read_detected_rebind_twin_quiet(fixture_report):
     keys = _keys(fixture_report, "JIT204")
     assert keys == {"JIT204:fixpkg.jitmod:bad_caller:arr",
                     "JIT204:fixpkg.jitmod:bad_carry_reader:carry"}
+
+
+def test_jit_collective_outside_mesh_detected(fixture_report):
+    """JIT205: collectives in a plain jit root are flagged; the
+    shard_map body, a helper reachable from it, and a
+    functools.partial-wrapped body are all exempt (ISSUE 5)."""
+    keys = _keys(fixture_report, "JIT205")
+    assert any(k.startswith("JIT205:fixpkg.jitmod:meshless_kernel:")
+               for k in keys)
+    assert all(":meshy_body:" not in k and ":meshy_helper:" not in k
+               and ":meshy_partial_body:" not in k for k in keys)
 
 
 def test_jit_donated_carry_subscript_detected(fixture_report):

@@ -170,6 +170,8 @@ def test_http_rejects_certless_client(pki, https_agent):
 
 
 def test_cli_tls_ca_and_cert_create(tmp_path, capsys):
+    pytest.importorskip("cryptography",
+                        reason="PKI minting needs cryptography")
     from nomad_tpu.cli.main import main as cli_main
     assert cli_main(["tls", "ca", "create", "-d", str(tmp_path)]) == 0
     assert cli_main(["tls", "cert", "create", "-role",
