@@ -74,6 +74,21 @@ STOCK_SRC = os.path.join(REPO, "bench", "stock_engine.cc")
 R_VEC = [200.0, 256.0, 300.0, 0.0]       # resident alloc usage vector
 
 
+def pct(sorted_ms, p):
+    """Nearest-rank percentile over an ASCENDING ms list (the shared
+    helper every phase uses — previously copied per phase)."""
+    return sorted_ms[int(p * (len(sorted_ms) - 1))] if sorted_ms else 0.0
+
+
+def latency_summary(latencies_s):
+    """p50/p99 (ms) of a latency sample in seconds — the one latency
+    summary used by the closed-loop, latency-mode, and open-loop
+    phases."""
+    lat_ms = sorted(1000.0 * x for x in latencies_s)
+    return {"p50_ms": round(pct(lat_ms, 0.5), 3),
+            "p99_ms": round(pct(lat_ms, 0.99), 3)}
+
+
 # ---------------- scenario (mirrors stock_engine.cc) ----------------
 
 def make_nodes(n_nodes, devices=False, gen_seed=0):
@@ -503,10 +518,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
     # every eval in a fused call completes when the call completes
     latencies = [elapsed_all] * n_evals
     elapsed = elapsed_all
-    lat_ms = sorted(1000.0 * x for x in latencies)
-
-    def pct(p):
-        return lat_ms[int(p * (len(lat_ms) - 1))] if lat_ms else 0.0
+    lat = latency_summary(latencies)
 
     return {
         "engine": "nomad-tpu resident stream",
@@ -525,7 +537,7 @@ def run_ours(config, n_nodes, n_evals, count, resident,
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
         "placements_per_sec": round(placed / elapsed, 1),
-        "p50_ms": round(pct(0.5), 3), "p99_ms": round(pct(0.99), 3),
+        "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
         "nodes_scored_per_placement": n_nodes,
     }
 
@@ -790,6 +802,406 @@ def run_multichip(n_devices=8, sizes=None, n_evals=16, count=64,
     return out
 
 
+# ---------------- open-loop serving phase (ISSUE 6) -----------------
+
+def poisson_arrivals(rate, duration_s, rng):
+    """Memoryless open-loop arrivals: [(t_offset, namespace), ...]."""
+    t, out = 0.0, []
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            return out
+        out.append((t, "default"))
+
+
+def trace_arrivals(rate, duration_s, rng, n_tenants=6,
+                   mean_burst=8.0):
+    """Tesserae-shaped trace family (arxiv 2508.04953): DL-cluster
+    scheduler workloads are bursty and multi-tenant.  Per tenant, an
+    ON/OFF burst train — bursts arrive Poisson, each carrying a
+    lognormal-sized run of back-to-back evals — with one hot tenant
+    holding ~3x the share of the rest (the flapping tenant the
+    admission fairness buckets exist for).  Mean rate ~= `rate`."""
+    shares = [3.0] + [1.0] * (n_tenants - 1)
+    total = sum(shares)
+    out = []
+    for ti, share in enumerate(shares):
+        tenant_rate = rate * share / total
+        burst_rate = tenant_rate / mean_burst
+        t = 0.0
+        while True:
+            t += rng.expovariate(burst_rate)
+            if t >= duration_s:
+                break
+            n = max(1, int(rng.lognormvariate(1.7, 0.8)))
+            for k in range(n):
+                out.append((min(duration_s - 1e-6, t + k * 1e-4),
+                            f"tenant-{ti}"))
+    out.sort()
+    return out
+
+
+class _ServingHarness:
+    """The serving tier wired end to end for the bench: a real
+    EvalBroker + BlockedEvals + AdmissionController feeding the real
+    ResidentSolver — the production worker loop's shape (adaptive
+    dequeue sizing, bypass lane, pause-nack, shed/readmit) without the
+    scheduler/raft plane around it, so the measured number is the
+    broker -> solver serving path itself."""
+
+    def __init__(self, rs, template_ask, count, policy, slo_s,
+                 max_batch, fixed_batch, max_pending):
+        import threading
+
+        from nomad_tpu.server.blocked_evals import BlockedEvals
+        from nomad_tpu.server.eval_broker import EvalBroker
+        from nomad_tpu.server.serving import (AdmissionController,
+                                              BatchController,
+                                              EwmaSolveModel)
+        self.rs = rs
+        self.template_ask = template_ask
+        self.count = count
+        self.policy = policy            # "adaptive" | "fixed"
+        self.fixed_batch = fixed_batch
+        self.max_batch = max_batch
+        self.broker = EvalBroker(nack_delay_s=60.0)
+        self.broker.set_enabled(True)
+        self.blocked = BlockedEvals(self.broker)
+        self.blocked.set_enabled(True)
+        self.model = EwmaSolveModel()
+        self.controller = BatchController(self.model, slo_budget_s=slo_s,
+                                          max_batch=max_batch)
+        self.admission = AdmissionController(
+            max_pending=max_pending, protect_priority=80,
+            ns_rate=max(64.0, max_pending / 2.0),
+            ns_burst=max(128.0, float(max_pending)),
+            brownout_after_s=0.25)
+        self.arrival_t = {}             # eval id -> arrival perf_counter
+        self.readmitted = set()
+        self.warmup_ids = set()         # excluded from the percentiles
+        self.lat_s = []                 # direct-admitted completions
+        self.lat_express_s = []         # bypass-lane completions
+        self.completed = 0
+        self.offered = 0
+        self.batch_sizes = []
+        self.stop = threading.Event()
+        self._seq = 0
+
+    # ---- ingress (arrival thread)
+    def ingress(self, ev):
+        self.offered += 1
+        self.arrival_t[ev.id] = time.perf_counter()
+        if self.admission.offer(ev, self.broker.ready_count()):
+            self.broker.enqueue(ev)
+        else:
+            self.blocked.shed(ev)
+
+    # ---- the serving loop (worker analog)
+    def serve_loop(self):
+        broker = self.broker
+        while not self.stop.is_set():
+            if self.policy == "adaptive":
+                target = self.controller.target_batch(
+                    broker.ready_count(), broker.oldest_ready_age())
+            else:
+                target = self.fixed_batch
+            batch = broker.dequeue_batch(["service"], target, 0.002)
+            if not batch:
+                self._readmit()
+                continue
+            t0 = time.perf_counter()
+            for ev, tok in batch:
+                broker.pause_nack_timeout(ev.id, tok)
+            express = [(e, t) for e, t in batch if e.priority >= 80]
+            bulk = [(e, t) for e, t in batch if e.priority < 80]
+            for group in (express, bulk):
+                if group:
+                    self._solve([e for e, _ in group])
+            now = time.perf_counter()
+            for ev, tok in batch:
+                broker.ack(ev.id, tok)
+                t_arr = self.arrival_t.pop(ev.id, None)
+                if (t_arr is None or ev.id in self.readmitted
+                        or ev.id in self.warmup_ids):
+                    continue
+                if ev.priority >= 80:
+                    self.lat_express_s.append(now - t_arr)
+                else:
+                    self.lat_s.append(now - t_arr)
+            self.completed += len(batch)
+            self.batch_sizes.append(len(batch))
+            self.model.observe(len(batch), now - t0)
+            self._readmit()
+
+    def _solve(self, evs):
+        # every eval is one config-2-shaped placement ask; identical
+        # signatures merge to a single packed row with summed count
+        # (the columnar coalescing payoff), solved in ONE device call
+        asks = [self.template_ask] * len(evs)
+        masks, keys = self.rs.merge_asks(asks)
+        pb = self.rs.pack_batch(masks)
+        self._seq += 1
+        self.rs.solve_stream([pb], seeds=[self._seq])
+
+    def _readmit(self):
+        quota = self.admission.readmit_quota(
+            self.broker.ready_count(), batch=self.max_batch)
+        if quota > 0:
+            for ev in self.blocked.pop_shed(quota):
+                self.readmitted.add(ev.id)
+                self.broker.enqueue(ev)
+
+    # ---- accounting
+    def leftovers(self):
+        st = self.broker.stats()
+        return (st["total_ready"] + st["total_unacked"]
+                + st["total_waiting"] + st["total_blocked"]
+                + self.blocked.shed_count())
+
+
+def _run_open_loop_leg(rs, template_ask, count, policy, arrivals,
+                       duration_s, slo_s, max_batch, fixed_batch,
+                       max_pending, used0, warmup_s=0.5,
+                       express_every_s=0.0):
+    """Drive one (policy, arrival process) leg and return its record."""
+    import gc
+    import threading
+
+    from nomad_tpu.structs import Evaluation
+
+    gc.collect()          # a mid-leg GC hiccup lands straight in p99
+    rs.reset_usage(used0=used0)
+    h = _ServingHarness(rs, template_ask, count, policy, slo_s,
+                        max_batch, fixed_batch, max_pending)
+    loop = threading.Thread(target=h.serve_loop, daemon=True)
+    loop.start()
+    # bypass-lane probes (the config-1 interactive class) ride along at
+    # a fixed low rate when requested
+    if express_every_s:
+        express = [(t, "_express") for t in
+                   _frange(express_every_s, duration_s, express_every_s)]
+        arrivals = sorted(arrivals + express)
+    t_start = time.perf_counter()
+    i, n = 0, len(arrivals)
+    while i < n:
+        now = time.perf_counter() - t_start
+        while i < n and arrivals[i][0] <= now:
+            t_off, ns = arrivals[i]
+            i += 1
+            if ns == "_express":
+                ev = Evaluation(job_id=f"ol-x-{i}", priority=90)
+            else:
+                ev = Evaluation(job_id=f"ol-{i}", namespace=ns,
+                                priority=50)
+            if t_off < warmup_s:
+                # warmup window: served and counted for throughput, but
+                # excluded from the percentiles (the EWMA model trains
+                # during it)
+                h.warmup_ids.add(ev.id)
+            h.ingress(ev)
+        if i < n:
+            time.sleep(min(0.001, max(0.0, arrivals[i][0]
+                                      - (time.perf_counter() - t_start))))
+    # grace drain: overload legs stay bounded by admission, so this
+    # terminates fast either way
+    t_grace = time.perf_counter()
+    while (time.perf_counter() - t_grace < 2.0
+           and h.broker.stats()["total_ready"] > 0):
+        time.sleep(0.01)
+    h.stop.set()
+    loop.join(timeout=5.0)
+    elapsed = time.perf_counter() - t_start
+    admitted = h.admission.stats()
+    shed_left = h.blocked.shed_count()
+    lost = h.offered - h.completed - h.leftovers()
+    lat = latency_summary(h.lat_s)
+    bs = sorted(h.batch_sizes)
+    return {
+        "policy": policy,
+        "offered": h.offered,
+        "completed": h.completed,
+        "elapsed_s": round(elapsed, 3),
+        "completed_per_sec": round(h.completed / max(elapsed, 1e-9), 1),
+        "offered_rate_per_sec": round(h.offered / max(duration_s, 1e-9),
+                                      1),
+        "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
+        "interactive": (latency_summary(h.lat_express_s)
+                        if h.lat_express_s else None),
+        "shed": admitted["shed"],
+        "shed_remaining": shed_left,
+        "readmitted": len(h.readmitted),
+        "brownouts_entered": admitted["brownouts_entered"],
+        "lost": lost,
+        "batch_size_p50": pct([float(x) for x in bs], 0.5),
+        "batch_size_p99": pct([float(x) for x in bs], 0.99),
+    }
+
+
+def _frange(start, stop, step):
+    out = []
+    t = start
+    while t < stop:
+        out.append(t)
+        t += step
+    return out
+
+
+def run_open_loop(n_nodes=2048, count=4, max_batch=128, fixed_batch=8,
+                  slo_ms=50.0, duration_s=4.0, resident=5000,
+                  loads=(0.5, 0.75, 1.0, 1.5, 2.0), seed=7,
+                  write_detail=True):
+    """Open-loop serving-tier phase (ISSUE 6 acceptance).
+
+    Measures the broker -> resident-solver serving path under
+    Poisson/trace-driven arrivals at load multiples of each policy's
+    MEASURED capacity (saturation probe), reporting sustained evals/sec
+    at p99 < slo_ms plus the saturation/brownout curve:
+
+      * adaptive: BatchController-sized dequeues (SLO-budget close
+        rule, EWMA solve model, drain mode) + admission control
+      * fixed:    the pre-serving-tier baseline — fixed-size dequeue
+        (`server.batch_size` analog) with the same admission bound
+
+    The acceptance figure `adaptive_vs_fixed_sustained` compares the
+    highest sustained throughput each policy achieves while holding
+    p99 < slo_ms across its own load sweep.  CPU-backend numbers are
+    acceptable per the issue; the per-dispatch overhead the adaptive
+    batcher amortizes exists on every backend (and grows with the
+    tunneled-transport round trip)."""
+    import random
+
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.tensorize import Tensorizer
+
+    rng = random.Random(seed)
+    slo_s = slo_ms / 1000.0
+    nodes = make_nodes(n_nodes)
+    probe_job = make_job(2, 0, count)
+    template_ask = asks_for(probe_job)[0]
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    t0 = time.perf_counter()
+    rs = ResidentSolver(nodes, asks_for(probe_job),
+                        gp=1 << max(0, (gp_need - 1).bit_length()),
+                        kp=1 << max(0, (count * max_batch - 1)
+                                    .bit_length()),
+                        max_waves=18)
+    used0 = resident_used0(rs.template, n_nodes, resident)
+    rs.reset_usage(used0=used0)
+    # warm every pow2 group_count_hint bucket the sweep can hit: batch
+    # sizes vary, padded shapes do not — no compiles in the timed legs
+    import dataclasses
+    k = 1
+    while k <= max_batch:
+        asks = [dataclasses.replace(template_ask, count=count)] * k
+        masks, keys = rs.merge_asks(asks)
+        rs.solve_stream([rs.pack_batch(masks)], seeds=[1])
+        k <<= 1
+    rs.reset_usage(used0=used0)
+    startup_s = time.perf_counter() - t0
+
+    # ---- capacity probe per policy: saturating arrivals, completed/s.
+    # Peak drain throughput is a rho=1 operating point — open-loop
+    # arrivals AT it queue without bound by Little's law — so the
+    # sweep's "1.0x capacity" is 0.9x the measured peak, the classic
+    # sustainable-utilization derating.
+    def capacity(policy):
+        import gc
+        rate = 60000.0
+        peaks = []
+        for trial in range(3):
+            gc.collect()
+            probe = poisson_arrivals(rate, 1.5,
+                                     random.Random(seed + 1 + trial))
+            rec = _run_open_loop_leg(
+                rs, template_ask, count, policy, probe, 1.5, slo_s,
+                max_batch, fixed_batch, max_pending=1 << 30,
+                used0=used0, warmup_s=0.25)
+            peaks.append(rec["completed_per_sec"])
+        return round(0.9 * statistics.median(peaks), 1)
+
+    cap = {p: capacity(p) for p in ("adaptive", "fixed")}
+    sys.stderr.write(f"open-loop capacity: adaptive={cap['adaptive']}"
+                     f" fixed={cap['fixed']} evals/s\n")
+
+    out = {"phase": "open_loop", "n_nodes": n_nodes, "count": count,
+           "slo_ms": slo_ms, "max_batch": max_batch,
+           "fixed_batch": fixed_batch, "duration_s": duration_s,
+           "startup_s": round(startup_s, 2),
+           "capacity_evals_per_sec": cap, "sweep": [], "trace": None}
+
+    sustained = {}
+    for policy in ("adaptive", "fixed"):
+        # bounded ingress worth ~2 SLO budgets of service at capacity:
+        # the queue the admission controller allows is the p99 the
+        # admitted traffic pays at saturation
+        max_pending = max(64, int(cap[policy] * slo_s * 2))
+        best = 0.0
+        for load in loads:
+            rate = cap[policy] * load
+            arrivals = poisson_arrivals(rate, duration_s,
+                                        random.Random(seed + 10))
+            rec = _run_open_loop_leg(
+                rs, template_ask, count, policy, arrivals, duration_s,
+                slo_s, max_batch, fixed_batch, max_pending, used0,
+                express_every_s=0.05)
+            rec.update({"load": load, "arrival": "poisson",
+                        "rate_per_sec": round(rate, 1),
+                        "max_pending": max_pending})
+            out["sweep"].append(rec)
+            if rec["p99_ms"] < slo_ms and rec["lost"] == 0:
+                best = max(best, rec["completed_per_sec"])
+            sys.stderr.write(
+                f"open-loop {policy} load={load}: "
+                f"{rec['completed_per_sec']}/s p99={rec['p99_ms']}ms "
+                f"shed={rec['shed']} lost={rec['lost']}\n")
+        sustained[policy] = best
+
+    # ---- Tesserae-shaped trace leg at 1.0x (adaptive): bursty
+    # multi-tenant arrivals exercising the fairness buckets
+    trace = trace_arrivals(cap["adaptive"], duration_s,
+                           random.Random(seed + 20))
+    max_pending = max(64, int(cap["adaptive"] * slo_s * 2))
+    rec = _run_open_loop_leg(
+        rs, template_ask, count, "adaptive", trace, duration_s, slo_s,
+        max_batch, fixed_batch, max_pending, used0,
+        express_every_s=0.05)
+    rec.update({"load": 1.0, "arrival": "tesserae-trace",
+                "max_pending": max_pending})
+    out["trace"] = rec
+
+    ratio = (sustained["adaptive"] / sustained["fixed"]
+             if sustained["fixed"] else float("inf"))
+    two_x = [r for r in out["sweep"]
+             if r["policy"] == "adaptive" and r["load"] == 2.0]
+    out["sustained_at_slo_evals_per_sec"] = sustained
+    out["adaptive_vs_fixed_sustained"] = round(ratio, 2)
+    out["acceptance"] = {
+        "adaptive_ge_1_3x_fixed_at_slo": ratio >= 1.3,
+        "overload_2x_bounded_p99_ms": (two_x[0]["p99_ms"]
+                                       if two_x else None),
+        "overload_2x_shed": two_x[0]["shed"] if two_x else None,
+        "overload_2x_zero_lost": (two_x[0]["lost"] == 0
+                                  if two_x else None),
+        "overload_2x_brownouts": (two_x[0]["brownouts_entered"]
+                                  if two_x else None),
+    }
+    out["ok"] = bool(out["acceptance"]["adaptive_ge_1_3x_fixed_at_slo"]
+                     and out["acceptance"]["overload_2x_zero_lost"])
+    if write_detail:
+        # merge into BENCH_DETAIL.json preserving the other phases
+        path = os.path.join(REPO, "BENCH_DETAIL.json")
+        try:
+            with open(path) as f:
+                detail = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            detail = {}
+        detail["open_loop"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+    return out
+
+
 def measure_transport_rtt():
     """Median fixed round-trip of a trivial device call + result fetch:
     the per-call floor this transport imposes regardless of work."""
@@ -859,10 +1271,7 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
         unresolved += int((status[0, :pb.n_place] == STATUS_RETRY).sum())
         latencies.append(time.perf_counter() - t_call)
     elapsed = time.perf_counter() - t_start
-    lat_ms = sorted(1000.0 * x for x in latencies)
-
-    def pct(p):
-        return lat_ms[int(p * (len(lat_ms) - 1))] if lat_ms else 0.0
+    lat = latency_summary(latencies)
 
     return {
         "engine": ("nomad-tpu host-solver per-eval (latency mode)"
@@ -876,7 +1285,7 @@ def run_ours_latency(config, n_nodes, n_evals, count, resident):
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(n_evals / elapsed, 1),
         "placements_per_sec": round(placed / elapsed, 1),
-        "p50_ms": round(pct(0.5), 3), "p99_ms": round(pct(0.99), 3),
+        "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
         "nodes_scored_per_placement": n_nodes,
     }
 
@@ -1007,8 +1416,8 @@ def run_ours_federated(n_regions, n_nodes, n_evals, count, resident,
         "startup_s": round(startup_s, 2),
         "evals_per_sec": round(total_evals / elapsed, 1),
         "placements_per_sec": round(placed / elapsed, 1),
-        "p50_ms": round(1000 * elapsed, 3),
-        "p99_ms": round(1000 * elapsed, 3),
+        # single fused call: every eval completes with the one fetch
+        **latency_summary([elapsed]),
         "nodes_scored_per_placement": n_nodes,
     }
 
@@ -1170,6 +1579,12 @@ def main():
         out = run_multichip()
         print("\x1e" + json.dumps(out))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--open-loop":
+        # subprocess mode: the open-loop serving phase (ISSUE 6) —
+        # merges its record into BENCH_DETAIL.json under "open_loop"
+        out = run_open_loop()
+        print("\x1e" + json.dumps(out))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--quality-sweep":
         out = run_quality_sweep()
         with open(os.path.join(REPO, "QUALITY_SWEEP.json"), "w") as f:
@@ -1258,9 +1673,31 @@ def main():
         sys.stderr.write(
             f"multichip phase failed rc={mp.returncode}:\n"
             f"{(mp.stderr or '')[-1500:]}\n")
+    # open-loop serving phase (ISSUE 6) in its own subprocess: it
+    # drives threads + a large broker population and must not perturb
+    # the configs' device state; the record is also self-merged into
+    # BENCH_DETAIL.json, but carrying it in `detail` keeps one write
+    open_loop = None
+    ol = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--open-loop"],
+        capture_output=True, text=True)
+    for line in ol.stdout.splitlines():
+        if line.startswith("\x1e"):
+            try:
+                open_loop = json.loads(line[1:])
+            except json.JSONDecodeError:
+                open_loop = None
+    if open_loop is None:
+        open_loop = {"phase": "open_loop", "skipped": True,
+                     "rc": ol.returncode,
+                     "tail": (ol.stderr or ol.stdout)[-1500:]}
+        sys.stderr.write(
+            f"open-loop phase failed rc={ol.returncode}:\n"
+            f"{(ol.stderr or '')[-1500:]}\n")
     detail = {"configs": results,
               "transport_rtt_ms": round(1000 * rtt, 1),
               "multichip": multichip,
+              "open_loop": open_loop,
               "lint": lint}
     if only is None:
         # multi-seed / multi-shape / both-load sweep (30 duels): the

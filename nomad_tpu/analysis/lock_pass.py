@@ -9,13 +9,20 @@ globals mutated at runtime are guarded by a module-level lock.
 
 Rules
   LOCK301  self-attribute write outside the class lock in a
-           lock-owning multithreaded class
+           lock-owning thread-shared class
   LOCK302  racy getter: a lockless method whose body just returns a
            lock-guarded attribute
   LOCK303  module-global mutated from function scope without a
            module-level lock held
   LOCK304  lock-ordering cycle (nested acquisitions in inconsistent
            order)
+
+"Thread-shared" is a fixpoint over composition (ISSUE 6): a class that
+starts threads/timers is shared, and so is every class reachable from a
+shared class through constructor attribute types — controller state
+objects (EWMA solve models, token buckets, admission counters) held by
+the broker/worker/server are mutated from many threads even though they
+never start one themselves, so they carry the same write discipline.
 """
 from __future__ import annotations
 
@@ -75,6 +82,27 @@ def _is_multithreaded(index: PackageIndex, ci: ClassInfo) -> bool:
     return False
 
 
+def _thread_shared_classes(index: PackageIndex) -> Set[str]:
+    """Thread-starting classes plus the fixpoint of everything they
+    hold by composition (constructor attr types): an instance hung off
+    a threaded class is reached from its threads, so its state carries
+    the same lock discipline whether or not it starts threads itself."""
+    shared: Set[str] = {ck for ck, ci in index.classes.items()
+                        if _is_multithreaded(index, ci)}
+    changed = True
+    while changed:
+        changed = False
+        for ck in sorted(shared):
+            ci = index.classes.get(ck)
+            if ci is None:
+                continue
+            for tkey in ci.attr_types.values():
+                if tkey in index.classes and tkey not in shared:
+                    shared.add(tkey)
+                    changed = True
+    return shared
+
+
 def _locked_regions(fi, lock_attrs: Set[str]):
     """Line spans covered by `with self.<lock>:` in this function."""
     spans = []
@@ -109,12 +137,14 @@ def run_lock_pass(index: PackageIndex, cfg: AnalysisConfig
         if attrs:
             lock_owners[ck] = attrs
 
-    # ---- LOCK301: unlocked self-attr writes in threaded lock owners
+    # ---- LOCK301: unlocked self-attr writes in thread-shared lock
+    # owners (started threads OR reached by composition from one)
+    thread_shared = _thread_shared_classes(index)
     for ck, locks in sorted(lock_owners.items()):
         ci = index.classes[ck]
         if not _in_scope(ci.module, cfg):
             continue
-        if not _is_multithreaded(index, ci):
+        if ck not in thread_shared:
             continue
         guarded = _guarded_attrs(index, ci, locks)
         for mname, fkey in sorted(ci.methods.items()):

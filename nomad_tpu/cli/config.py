@@ -11,6 +11,10 @@ the stanzas the dev agent honors: top-level knobs, `server`, `client`,
     server {
       enabled          = true
       num_schedulers   = 2
+      serving {                 # serving tier (ISSUE 6) knobs
+        slo_budget_s = 0.05
+        max_batch    = 64
+      }
     }
     client {
       enabled    = true
@@ -38,6 +42,10 @@ class AgentConfig:
     #: persistent XLA compile cache dir (utils/compile_cache) — warm
     #: restarts skip the multi-second solver recompiles; "" = off
     compile_cache_dir: str = ""
+    #: serving-tier overrides (server/serving.py ServingTier.KNOBS:
+    #: slo_budget_s, max_batch, max_pending, bypass_priority, brownout
+    #: thresholds, adaptive) — config wins over env wins over defaults
+    serving: Dict[str, object] = field(default_factory=dict)
     client_enabled: bool = True
     datacenter: str = "dc1"
     meta: Dict[str, str] = field(default_factory=dict)
@@ -90,6 +98,8 @@ def _hcl_to_dict(body) -> dict:
             sub.update(blk.attrs)
             for _ml, meta in blk.blocks_named("meta"):
                 sub.setdefault("meta", {}).update(meta.attrs)
+            for _sl, srv in blk.blocks_named("serving"):
+                sub.setdefault("serving", {}).update(srv.attrs)
     return d
 
 
@@ -105,6 +115,10 @@ def _from_dict(d: dict) -> AgentConfig:
                                      cfg.num_schedulers))
     cfg.compile_cache_dir = srv.get("compile_cache_dir",
                                     cfg.compile_cache_dir)
+    serving = srv.get("serving") or {}
+    if not isinstance(serving, dict):
+        raise AgentConfigError("server.serving must be a block/object")
+    cfg.serving.update(serving)
     cl = d.get("client") or {}
     cfg.client_enabled = bool(cl.get("enabled", cfg.client_enabled))
     cfg.datacenter = cl.get("datacenter", cfg.datacenter)

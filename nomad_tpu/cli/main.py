@@ -78,7 +78,8 @@ def cmd_agent(args) -> int:
         print("WARNING: tls { rpc = true } has no effect in -dev mode "
               "(single process, no RPC sockets); serve_cluster wires "
               "RPC TLS for multi-server deployments", file=sys.stderr)
-    server = Server(num_workers=workers)
+    server = Server(num_workers=workers,
+                    serving_config=cfg.serving or None)
     server.start()
     client = None
     if not args.server_only and cfg.client_enabled:

@@ -82,7 +82,7 @@ class RaftLog:
             if keep >= len(self.entries):
                 return
             del self.entries[keep:]
-            self._rewrite()
+            self._rewrite_locked()
 
     def compact_to(self, index: int) -> None:
         """Drop everything up to and including `index` (it is captured in
@@ -93,10 +93,11 @@ class RaftLog:
                 return
             del self.entries[:drop]
             self.offset = index
-            self._rewrite()
+            self._rewrite_locked()
 
     # ------------------------------------------------------------- disk
-    def _rewrite(self) -> None:
+    def _rewrite_locked(self) -> None:
+        # caller holds self._lock (truncate_from / compact_to)
         if not self._dir:
             return
         if self._fh:
@@ -114,7 +115,9 @@ class RaftLog:
     def _load(self) -> None:
         if not os.path.exists(self._path):
             return
-        with open(self._path, encoding="utf-8") as f:
+        # ctor-time only, but the lock is uncontended there and makes
+        # the write discipline uniform
+        with self._lock, open(self._path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line:

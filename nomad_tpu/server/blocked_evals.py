@@ -3,9 +3,19 @@
 Reference: nomad/blocked_evals.go — Block :166, class/quota-keyed Unblock
 :418, UnblockNode :501, missed-unblock index check :316, per-job dedup
 with duplicate surfacing :642.
+
+Extension (ISSUE 6, serving tier): a `shed` lane for evals the
+admission controller refused at ingress under overload.  Shed evals are
+never dropped — they share the per-job dedup/duplicate machinery with
+capacity-blocked evals and are popped back into the broker in priority
+order by `pop_shed` once the queue drains (the worker's readmit tick).
+Unlike capacity-blocked evals they do NOT unblock on capacity change:
+they wait on queue drain, not on node state.
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 from typing import Dict, List, Tuple
 
@@ -27,6 +37,12 @@ class BlockedEvals:
         # class -> latest state index at which capacity changed; an eval
         # blocked with an older snapshot may have missed that unblock
         self._unblock_indexes: Dict[str, int] = {}
+        # admission-shed evals (ISSUE 6): id -> eval plus a max-priority
+        # pop order; total_shed counts lifetime sheds for the stats line
+        self._shed: Dict[str, Evaluation] = {}
+        self._shed_heap: List[tuple] = []
+        self._shed_count = itertools.count()
+        self._sheds_total = 0
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -38,6 +54,8 @@ class BlockedEvals:
                 self._by_node.clear()
                 self._duplicates.clear()
                 self._unblock_indexes.clear()
+                self._shed.clear()
+                self._shed_heap.clear()
 
     @property
     def enabled(self) -> bool:
@@ -49,7 +67,8 @@ class BlockedEvals:
         with self._lock:
             if not self._enabled:
                 return
-            if ev.id in self._captured or ev.id in self._escaped:
+            if (ev.id in self._captured or ev.id in self._escaped
+                    or ev.id in self._shed):
                 return
             namespaced = (ev.namespace, ev.job_id)
             existing_id = self._by_job.get(namespaced)
@@ -57,7 +76,8 @@ class BlockedEvals:
                 # one blocked eval per job: newer wins, older surfaces as a
                 # duplicate for cancellation
                 old = self._captured.pop(existing_id, None) \
-                    or self._escaped.pop(existing_id, None)
+                    or self._escaped.pop(existing_id, None) \
+                    or self._shed.pop(existing_id, None)
                 if old is not None:
                     self._scrub_node_locked(existing_id)
                     self._duplicates.append(old)
@@ -92,6 +112,55 @@ class BlockedEvals:
             if ev.escaped_computed_class:
                 return True
         return False
+
+    # ---------------------------------------------------------------- shed
+    def shed(self, ev: Evaluation) -> None:
+        """Park an admission-shed eval (serving tier backpressure).
+        Same per-job dedup as block(): newer wins, the displaced eval
+        surfaces as a duplicate for cancellation — shedding never
+        silently drops work."""
+        with self._lock:
+            if not self._enabled:
+                return
+            if (ev.id in self._shed or ev.id in self._captured
+                    or ev.id in self._escaped):
+                return
+            namespaced = (ev.namespace, ev.job_id)
+            existing_id = self._by_job.get(namespaced)
+            if existing_id is not None and existing_id != ev.id:
+                old = self._captured.pop(existing_id, None) \
+                    or self._escaped.pop(existing_id, None) \
+                    or self._shed.pop(existing_id, None)
+                if old is not None:
+                    self._scrub_node_locked(existing_id)
+                    self._duplicates.append(old)
+                    self._dup_event.set()
+            if ev.job_id:
+                self._by_job[namespaced] = ev.id
+            self._shed[ev.id] = ev
+            heapq.heappush(self._shed_heap,
+                           (-ev.priority, next(self._shed_count), ev.id))
+            self._sheds_total += 1
+
+    def pop_shed(self, max_n: int) -> List[Evaluation]:
+        """Pop up to max_n shed evals in (priority desc, shed order)
+        for readmission; the caller re-enqueues them on the broker.
+        Stale heap entries (displaced by a newer eval for the job) are
+        skipped — the newer eval owns the job slot."""
+        out: List[Evaluation] = []
+        with self._lock:
+            while self._shed_heap and len(out) < max_n:
+                _, _, eid = heapq.heappop(self._shed_heap)
+                ev = self._shed.pop(eid, None)
+                if ev is None:
+                    continue
+                self._by_job.pop((ev.namespace, ev.job_id), None)
+                out.append(ev)
+        return [_reset(ev) for ev in out]
+
+    def shed_count(self) -> int:
+        with self._lock:
+            return len(self._shed)
 
     # ------------------------------------------------------------- unblock
     def unblock(self, computed_class: str, index: int) -> None:
@@ -152,6 +221,7 @@ class BlockedEvals:
             if eid:
                 self._captured.pop(eid, None)
                 self._escaped.pop(eid, None)
+                self._shed.pop(eid, None)
                 self._scrub_node_locked(eid)
 
     def _scrub_node_locked(self, eval_id: str) -> None:
@@ -180,6 +250,8 @@ class BlockedEvals:
             return {
                 "total_blocked": len(self._captured),
                 "total_escaped": len(self._escaped),
+                "total_shed": len(self._shed),
+                "sheds_lifetime": self._sheds_total,
             }
 
 

@@ -9,7 +9,9 @@ and a delivery limit that shunts flapping evals to a `_failed` queue
 `dequeue_batch` drains up to K ready evals — each for a different job, by
 construction of the per-job serialization — and is the coalescing point
 for the fused multi-eval device solve (SURVEY §2.5); the stock worker
-loop dequeues singly, matching the reference.
+loop dequeues singly, matching the reference.  K is sized per dequeue by
+the serving tier's BatchController (server/serving.py) from the queue
+depth and the oldest ready eval's age, which the broker tracks here.
 """
 from __future__ import annotations
 
@@ -74,6 +76,11 @@ class EvalBroker:
         self._delay_heap: List[tuple] = []
         self._dequeues = 0
         self._nacks = 0
+        # eval id -> monotonic enqueue time while sitting in a ready
+        # heap: feeds oldest_ready_age(), the BatchController's
+        # SLO-budget close rule input (insertion order ~ enqueue order,
+        # so the first live entry is the oldest)
+        self._ready_since: Dict[str, float] = {}
         self.nack_delay_s = nack_delay_s
         self.initial_nack_delay_s = initial_nack_delay_s
         self.delivery_limit = delivery_limit
@@ -109,6 +116,39 @@ class EvalBroker:
         with self._lock:
             return sum(len(h) for h in self._ready.values())
 
+    def oldest_ready_age(self) -> float:
+        """Seconds the oldest currently-ready eval has been waiting.
+        Dict insertion order tracks enqueue order, so the first live
+        entry is the oldest — O(1), called per dequeue by the
+        BatchController."""
+        with self._lock:
+            for t0 in self._ready_since.values():
+                return _time.monotonic() - t0
+            return 0.0
+
+    def export_metrics(self) -> None:
+        """Publish queue-shape gauges through the global metrics path
+        (surfaced at /v1/metrics next to the worker.dequeue_eval
+        counters).  Called by the worker loop each iteration — cheap:
+        one lock hold, no allocation beyond the per-queue dict walk."""
+        from ..utils.metrics import global_metrics as _m
+        with self._lock:
+            ready = {q: len(h) for q, h in self._ready.items()}
+            unacked = len(self._unack)
+            waiting = len(self._waiting)
+            blocked = sum(len(h) for h in self._blocked.values())
+            oldest = 0.0
+            for t0 in self._ready_since.values():
+                oldest = _time.monotonic() - t0
+                break
+        _m.set_gauge("broker.ready_count", float(sum(ready.values())))
+        _m.set_gauge("broker.oldest_ready_age_s", oldest)
+        _m.set_gauge("broker.unacked", float(unacked))
+        _m.set_gauge("broker.waiting", float(waiting))
+        _m.set_gauge("broker.job_blocked", float(blocked))
+        for q, n in ready.items():
+            _m.set_gauge(f"broker.ready.{q}", float(n))
+
     def flush(self) -> None:
         with self._lock:
             for u in self._unack.values():
@@ -122,6 +162,7 @@ class EvalBroker:
             self._waiting.clear()
             self._delay_heap.clear()
             self._deliveries.clear()
+            self._ready_since.clear()
             self._lock.notify_all()
 
     # ------------------------------------------------------------- enqueue
@@ -165,6 +206,7 @@ class EvalBroker:
                 return
             self._job_evals[namespaced] = ev.id
         self._ready.setdefault(queue, _Heap()).push(ev)
+        self._ready_since[ev.id] = _time.monotonic()
         self._lock.notify_all()
 
     # ------------------------------------------------------------- dequeue
@@ -202,6 +244,10 @@ class EvalBroker:
             if ev is None:
                 break
             out.append((ev, tok))
+        # dequeue-batch size histogram (p50/p99 via the metrics
+        # reservoir) — the observability face of the BatchController
+        from ..utils.metrics import global_metrics as _m
+        _m.add_sample("broker.dequeue_batch_size", float(len(out)))
         return out
 
     def _dequeue_locked(self, sched_types: Sequence[str]
@@ -216,7 +262,10 @@ class EvalBroker:
                 best_q, best_pri = q, pri
         if best_q is None:
             return None
-        return self._ready[best_q].pop()
+        ev = self._ready[best_q].pop()
+        if ev is not None:
+            self._ready_since.pop(ev.id, None)
+        return ev
 
     def _start_nack_timer(self, u: _Unack) -> None:
         t = threading.Timer(self.nack_delay_s,
@@ -285,6 +334,7 @@ class EvalBroker:
                 del self._blocked[namespaced]
             self._job_evals[namespaced] = nxt.id
             self._ready.setdefault(nxt.type, _Heap()).push(nxt)
+            self._ready_since[nxt.id] = _time.monotonic()
             self._lock.notify_all()
 
     def nack(self, eval_id: str, token: str) -> Optional[str]:
@@ -297,6 +347,8 @@ class EvalBroker:
             del self._unack[eval_id]
             self._requeue.pop(eval_id, None)
             self._nacks += 1
+            from ..utils.metrics import global_metrics as _m
+            _m.incr_counter("broker.nack")
             ev = u.eval
             # keep the per-job serialization slot held by the nacked eval
             # until it is acked (reference Nack semantics) so a newer eval
@@ -306,6 +358,7 @@ class EvalBroker:
                 self._release_job_slot_locked(ev, eval_id)
                 # too many failed deliveries: park it for the leader reaper
                 self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
+                self._ready_since[ev.id] = _time.monotonic()
                 self._lock.notify_all()
                 return None
             # redeliver after a compounding delay
@@ -342,6 +395,10 @@ class EvalBroker:
     # --------------------------------------------------------------- stats
     def stats(self) -> dict:
         with self._lock:
+            oldest = 0.0
+            for t0 in self._ready_since.values():
+                oldest = _time.monotonic() - t0
+                break
             return {
                 "total_ready": sum(len(h) for h in self._ready.values()),
                 "total_unacked": len(self._unack),
@@ -350,6 +407,7 @@ class EvalBroker:
                 "by_scheduler": {q: len(h) for q, h in self._ready.items()},
                 "dequeues": self._dequeues,
                 "nacks": self._nacks,
+                "oldest_ready_age_s": round(oldest, 6),
             }
 
     def outstanding(self, eval_id: str) -> Optional[str]:

@@ -60,7 +60,8 @@ class Server:
                  node_gc_threshold_s: float = 24 * 3600.0,
                  deployment_gc_threshold_s: float = 3600.0,
                  raft_config: Optional[RaftConfig] = None,
-                 raft_transport=None):
+                 raft_transport=None,
+                 serving_config: Optional[dict] = None):
         self.store = StateStore()
         self.fsm = StateFSM(self.store)
         if raft_config is None:
@@ -76,6 +77,14 @@ class Server:
         self.blocked_evals = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.batch_size = batch_size
+        # serving tier (ISSUE 6): adaptive micro-batching + admission
+        # control shared by every worker and the eval-ingress path;
+        # `serving_config` (agent `server { serving { ... } }` stanza)
+        # overrides env overrides defaults.  {"adaptive": False} pins
+        # the fixed batch_size dequeue (the pre-serving behavior) while
+        # keeping admission bounded.
+        from .serving import ServingTier
+        self.serving = ServingTier(overrides=serving_config)
         self.planner = PlanApplier(self.plan_queue, self.store,
                                    self._apply_plan, self._create_evals,
                                    apply_async_fn=self._apply_plan_async)
@@ -528,7 +537,19 @@ class Server:
         for ev in evals:
             stored = self.store.eval_by_id(ev.id) or ev
             if stored.should_enqueue():
-                self.broker.enqueue(stored)
+                # serving-tier admission gate (ISSUE 6): bounded broker
+                # ingress with priority-aware shedding.  Shed evals park
+                # in blocked_evals' shed lane — still persisted PENDING
+                # in state, never dropped — and readmit on drain (the
+                # worker's readmit tick).  Broker-internal re-enqueues
+                # (nack redelivery, blocked promotion, delayed evals)
+                # are not ingress and bypass this gate.
+                if (self.serving is not None
+                        and not self.serving.admission.offer(
+                            stored, self.broker.ready_count())):
+                    self.blocked_evals.shed(stored)
+                else:
+                    self.broker.enqueue(stored)
             elif stored.should_block():
                 self.blocked_evals.block(stored)
 

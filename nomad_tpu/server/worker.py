@@ -40,9 +40,14 @@ class Worker(threading.Thread):
         self._shutdown.set()
 
     def run(self) -> None:
+        import time as _t
+
+        from ..utils.metrics import global_metrics as _m
         while not self._shutdown.is_set():
+            broker = self.server.broker
+            serving = getattr(self.server, "serving", None)
             if self.paused.is_set() and \
-                    self.server.broker.ready_count() <= self.server.batch_size:
+                    broker.ready_count() <= self._max_batch():
                 # Soft pause (leader CPU hygiene, reference:
                 # leader.go:206-212): unlike the reference there are no
                 # follower workers to absorb load in this architecture,
@@ -50,21 +55,96 @@ class Worker(threading.Thread):
                 # up beyond one batch and returns to idle once drained.
                 self._shutdown.wait(0.05)
                 continue
-            batch = self.server.broker.dequeue_batch(
-                self.sched_types, self.server.batch_size, DEQUEUE_TIMEOUT_S)
+            target = self._target_batch(serving, broker)
+            batch = broker.dequeue_batch(
+                self.sched_types, target, DEQUEUE_TIMEOUT_S)
+            broker.export_metrics()
             if not batch:
+                # idle tick: readmit shed work once the queue drains
+                self._readmit_tick(serving)
                 continue
+            if len(batch) > 1:
+                # hold every member's redelivery timer for the duration
+                # of the fused work (see process_fleet, which re-pauses
+                # idempotently): an express-lane solve or a slow fused
+                # batch must not trigger spurious nack redelivery for
+                # the members still waiting their turn
+                for ev, token in batch:
+                    broker.pause_nack_timeout(ev.id, token)
+            if serving is not None:
+                # brownout: degrade the solve wave budget while the
+                # queue is saturated (leftovers retry via the normal
+                # blocked/requeue path); restore costs one cached
+                # compile variant
+                self.fleet_solver().set_degraded(
+                    serving.admission.brownout_active())
+            t0 = _t.monotonic()
             try:
-                if len(batch) == 1:
-                    self._process(*batch[0])
-                else:
-                    from ..scheduler.fleet import process_fleet
-                    process_fleet(self.server, self, batch)
+                self._run_batch(serving, batch)
             except Exception:
                 # a poisoned eval must not kill the worker; the nack path
                 # redelivers it until the delivery limit parks it
                 for ev, token in batch:
                     self.server.broker.nack(ev.id, token)
+            if serving is not None:
+                serving.solve_model.observe(len(batch),
+                                            _t.monotonic() - t0)
+                _m.set_gauge("serving.last_target_batch", float(target))
+                _m.set_gauge(
+                    "serving.brownout",
+                    1.0 if serving.admission.brownout_active() else 0.0)
+                self._readmit_tick(serving)
+
+    def _max_batch(self) -> int:
+        serving = getattr(self.server, "serving", None)
+        if serving is not None and serving.adaptive:
+            return serving.max_batch
+        return self.server.batch_size
+
+    def _target_batch(self, serving, broker) -> int:
+        """Adaptive micro-batch sizing (serving tier): queue depth +
+        oldest ready age + the EWMA solve-time model pick the largest
+        batch that keeps age + predicted solve inside the SLO budget.
+        Falls back to the fixed batch_size when the tier is disabled."""
+        if serving is None or not serving.adaptive:
+            return self.server.batch_size
+        return serving.batch_controller.target_batch(
+            broker.ready_count(), broker.oldest_ready_age())
+
+    def _run_batch(self, serving, batch) -> None:
+        if len(batch) == 1:
+            self._process(*batch[0])
+            return
+        express, bulk = [], []
+        bypass = serving.bypass_priority if serving is not None else None
+        for ev, token in batch:
+            if bypass is not None and ev.priority >= bypass:
+                express.append((ev, token))
+            else:
+                bulk.append((ev, token))
+        # bypass lane: interactive/high-priority evals solve singly
+        # FIRST (the in-process host path for small clusters — one
+        # tunnel round trip), ahead of the fused bulk solve
+        for ev, token in express:
+            self._process(ev, token)
+        if len(bulk) == 1:
+            self._process(*bulk[0])
+        elif bulk:
+            from ..scheduler.fleet import process_fleet
+            process_fleet(self.server, self, bulk)
+
+    def _readmit_tick(self, serving) -> None:
+        """Pop admission-shed evals back into the broker once the queue
+        has drained below the low watermark (restore-on-drain)."""
+        if serving is None:
+            return
+        quota = serving.admission.readmit_quota(
+            self.server.broker.ready_count(),
+            batch=serving.max_batch)
+        if quota <= 0:
+            return
+        for ev in self.server.blocked_evals.pop_shed(quota):
+            self.server.broker.enqueue(ev)
 
     def _process(self, ev: Evaluation, token: str) -> None:
         import time as _t

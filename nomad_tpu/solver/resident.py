@@ -412,6 +412,11 @@ class ResidentSolver:
         #: host bytes the LAST dispatch actually shipped (0 on a
         #: device-cached re-dispatch)
         self.last_dispatch_bytes = 0
+        #: wall-clock of the last SYNCHRONOUS stream solve (solve_stream
+        #: / solve_stream_pipelined, dispatch through fetch) keyed by
+        #: batch count — the serving tier's EWMA solve-time model feeds
+        #: from this (server/serving.py EwmaSolveModel.observe)
+        self.last_solve_stats = None
         self._probe_asks = list(probe_asks)
         self._tz = Tensorizer()
         self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
@@ -722,7 +727,12 @@ class ResidentSolver:
         distinct seeds fans identical asks across equal-scoring nodes,
         which converges contended batches in fewer waves.
         """
-        return self._unpack(self.solve_stream_async(batches, seeds))
+        import time as _t
+        t0 = _t.perf_counter()
+        out = self._unpack(self.solve_stream_async(batches, seeds))
+        self.last_solve_stats = {"n_batches": len(batches),
+                                 "wall_s": _t.perf_counter() - t0}
+        return out
 
     def solve_stream_async(self, batches: Sequence[PackedBatch],
                            seeds: Optional[Sequence[int]] = None):
@@ -834,6 +844,9 @@ class ResidentSolver:
             "delta_apply_s": delta_s,
             "fetch_s": fetch_s, "n_dispatches": len(outs),
             "bytes_dispatched": bytes_shipped}
+        self.last_solve_stats = {
+            "n_batches": len(chunks),
+            "wall_s": pack_s + dispatch_s + delta_s + fetch_s}
         return self._unpack(packed)
 
     @functools.cached_property

@@ -30,6 +30,15 @@ _DIM_NAMES = {R_CPU: "cpu", R_MEM: "memory", R_DISK: "disk", R_NET: "network"}
 RESIDENT_MIN_NODES = int(os.environ.get("NOMAD_TPU_RESIDENT_MIN_NODES",
                                         "512"))
 
+#: brownout wave budget (serving tier, ISSUE 6): under sustained
+#: overload the admission controller flips workers into degraded mode
+#: and solves run with this reduced budget — undecided placements come
+#: back retryable and follow the normal blocked/requeue path, trading
+#: per-eval completeness for queue drain.  One extra cached compile
+#: variant per shape (max_waves is a static kernel arg).
+BROWNOUT_MAX_WAVES = int(os.environ.get("NOMAD_TPU_BROWNOUT_MAX_WAVES",
+                                        "6"))
+
 
 class LazyAllocsView(dict):
     """Proposed live allocs by node, filled lazily from the snapshot
@@ -294,6 +303,18 @@ class Solver:
                                     else resident_min_nodes)
         self._delta_threshold = delta_threshold
         self._world: Optional[_ResidentWorld] = None
+        self._degraded = False
+
+    # ---------------------------------------------------------- brownout
+    def set_degraded(self, degraded: bool) -> None:
+        """Serving-tier brownout: solve with the reduced
+        BROWNOUT_MAX_WAVES budget while set (leftovers stay
+        retryable)."""
+        self._degraded = bool(degraded)
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
 
     # ------------------------------------------------- resident world
     def resident_active(self, snapshot=None) -> bool:
@@ -411,7 +432,9 @@ class Solver:
                 sol_nodes = self._world.nodes
         if pb is None:
             pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
-        res = _run_kernel(pb, host_mode=self._host)
+        res = _run_kernel(pb, host_mode=self._host,
+                          max_waves=BROWNOUT_MAX_WAVES
+                          if self._degraded else 0)
 
         choice = np.asarray(res.choice)
         choice_ok = np.asarray(res.choice_ok)
@@ -625,7 +648,7 @@ class Solver:
 
 
 def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
-                pallas: str = "auto"):
+                pallas: str = "auto", max_waves: int = 0):
     import numpy as _np
     has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
     if host_mode != "never":
@@ -633,12 +656,13 @@ def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
         if host_mode == "always" or prefer_host(
                 pb.avail.shape[0], pb.n_asks, pb.n_place):
             return host_solve_kernel(*_kernel_args(pb),
-                                     has_spread=has_spread)
+                                     has_spread=has_spread,
+                                     max_waves=max_waves)
     # "auto" resolves to the pallas fused wave on TPU backends (or when
     # NOMAD_TPU_PALLAS forces it) and to the unfused kernel otherwise —
     # placement-identical either way (tests/test_pallas_kernel.py)
     return solve_kernel(*_kernel_args(pb), has_spread=has_spread,
-                        pallas_mode=pallas)
+                        pallas_mode=pallas, max_waves=max_waves)
 
 
 def _kernel_args(pb: PackedBatch):

@@ -9,6 +9,11 @@ ports { http = 5646 }
 server {
   enabled        = true
   num_schedulers = 4
+  serving {
+    slo_budget_s = 0.04
+    max_batch    = 32
+    adaptive     = true
+  }
 }
 client {
   enabled    = true
@@ -28,6 +33,19 @@ def test_hcl_agent_config():
     assert cfg.datacenter == "us-west"
     assert cfg.meta == {"rack": "r9"}
     assert cfg.acl_enabled
+    assert cfg.serving == {"slo_budget_s": 0.04, "max_batch": 32,
+                           "adaptive": True}
+
+
+def test_serving_overrides_reach_the_tier():
+    from nomad_tpu.server.serving import ServingTier
+    cfg = parse_agent_config(
+        '{"server": {"serving": {"slo_budget_s": 0.08,'
+        ' "max_batch": 16, "max_pending": 99}}}')
+    tier = ServingTier(overrides=cfg.serving)
+    assert tier.slo_budget_s == 0.08
+    assert tier.max_batch == 16
+    assert tier.admission.max_pending == 99
 
 
 def test_json_agent_config():

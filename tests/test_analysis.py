@@ -265,6 +265,49 @@ FIX_LOCKS = """
             with self._b:
                 with self._a:                              # LOCK304
                     pass
+
+
+    class SharedModel:
+        # never starts a thread itself: reached ONLY by composition
+        # from the threaded Owner below (ISSUE 6 controller-state rule)
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ewma = {}
+
+        def observe(self, k, v):
+            self._ewma[k] = v                      # LOCK301 (composition)
+
+
+    class SharedModelClean:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._ewma = {}
+
+        def observe(self, k, v):
+            with self._lock:
+                self._ewma[k] = v
+
+
+    class Standalone:
+        # lock owner NOT reachable from any threaded class: single-
+        # threaded use, the composition rule must stay quiet on it
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cache = {}
+
+        def fill(self, k, v):
+            self._cache[k] = v
+
+
+    class Owner:
+        def __init__(self):
+            self.model = SharedModel()
+            self.clean = SharedModelClean()
+            self._t = threading.Thread(target=self.tick)
+
+        def tick(self):
+            self.model.observe("a", 1)
+            self.clean.observe("a", 1)
 """
 
 
@@ -372,7 +415,20 @@ def test_jit_donated_carry_subscript_detected(fixture_report):
 # --------------------------------------------------------- lock pass
 def test_lock_unguarded_write_detected_clean_twin_quiet(fixture_report):
     keys = _keys(fixture_report, "LOCK301")
-    assert keys == {"LOCK301:fixpkg.locks:Chatty.start:_worker"}
+    assert keys == {
+        "LOCK301:fixpkg.locks:Chatty.start:_worker",
+        "LOCK301:fixpkg.locks:SharedModel.observe:_ewma",
+    }
+
+
+def test_lock_composition_reaches_controller_state(fixture_report):
+    """ISSUE 6: a lock-owning helper held by a threaded class carries
+    LOCK301 even though it never starts a thread itself; the locked
+    twin and the unreachable standalone owner stay quiet."""
+    keys = _keys(fixture_report, "LOCK301")
+    assert "LOCK301:fixpkg.locks:SharedModel.observe:_ewma" in keys
+    assert not any(":SharedModelClean." in k for k in keys)
+    assert not any(":Standalone." in k for k in keys)
 
 
 def test_lock_racy_getter_detected(fixture_report):

@@ -1,0 +1,495 @@
+"""Serving tier (ISSUE 6): adaptive micro-batching, admission control,
+shed/readmit at-least-once semantics, nack-pause under batch dequeue,
+and broker observability."""
+import random
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, structs
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.server.serving import (AdmissionController, BatchController,
+                                      EwmaSolveModel, ServingTier,
+                                      TokenBucket)
+from nomad_tpu.server.server import Server
+from nomad_tpu.server.worker import Worker
+
+
+def make_broker(**kw):
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+# ------------------------------------------------------- EWMA solve model
+def test_ewma_model_observe_predict():
+    m = EwmaSolveModel()
+    for _ in range(8):
+        m.observe(1, 0.002)
+        m.observe(64, 0.020)
+    assert m.predict(1) == pytest.approx(0.002, rel=0.2)
+    assert m.predict(64) == pytest.approx(0.020, rel=0.2)
+    # interpolation between observed buckets is monotone
+    p8 = m.predict(8)
+    assert 0.002 < p8 < 0.020
+    assert m.predict(4) < p8 < m.predict(16)
+
+
+def test_ewma_model_defaults_without_observations():
+    m = EwmaSolveModel(default_fixed_s=0.004, default_per_eval_s=0.0005)
+    assert m.predict(1) == pytest.approx(0.0045)
+    assert m.predict(8) == pytest.approx(0.008)
+    assert m.observations() == 0
+
+
+def test_ewma_model_tracks_drift():
+    m = EwmaSolveModel(alpha=0.5)
+    m.observe(8, 0.010)
+    for _ in range(12):
+        m.observe(8, 0.030)     # load regime changed
+    assert m.predict(8) == pytest.approx(0.030, rel=0.05)
+
+
+# ------------------------------------------------- batch controller (SLO)
+def _trained_controller(slo_budget_s=0.05, margin=0.6, max_batch=64):
+    m = EwmaSolveModel()
+    # 2ms fixed + ~0.3ms/eval marginal, observed at every pow2 bucket
+    n = 1
+    while n <= max_batch:
+        for _ in range(6):
+            m.observe(n, 0.002 + 0.0003 * n)
+        n <<= 1
+    return BatchController(m, slo_budget_s=slo_budget_s,
+                           max_batch=max_batch, margin=margin)
+
+
+def test_controller_grows_with_deep_backlog():
+    c = _trained_controller()
+    # fresh queue, deep backlog: the 30ms effective budget fits 64
+    # (2 + 0.3*64 = 21.2ms)
+    assert c.target_batch(ready=1000, oldest_age_s=0.0) == 64
+
+
+def test_controller_closes_early_near_slo_budget():
+    c = _trained_controller()
+    # oldest eval already 25ms old: 5ms left -> only small batches fit
+    small = c.target_batch(ready=1000, oldest_age_s=0.025)
+    assert small < 16
+    # monotone within the feasible region: more age, smaller batch
+    prev = 10 ** 9
+    for age in (0.0, 0.01, 0.02, 0.025):
+        t = c.target_batch(ready=1000, oldest_age_s=age)
+        assert t <= prev
+        prev = t
+
+
+def test_controller_drain_mode_past_budget():
+    c = _trained_controller()
+    # the oldest eval already blew the budget: drain mode maximizes
+    # evals/s to clear the backlog (and restore the SLO) soonest
+    assert c.target_batch(ready=1000, oldest_age_s=0.2) == 64
+    assert c.target_batch(ready=5, oldest_age_s=0.2) == 5
+
+
+def test_controller_caps_at_backlog():
+    c = _trained_controller()
+    assert c.target_batch(ready=3, oldest_age_s=0.0) == 3
+    assert c.target_batch(ready=0, oldest_age_s=0.0) == 1
+
+
+def test_controller_untrained_model_is_conservative():
+    m = EwmaSolveModel()      # defaults: 4ms fixed + 0.5ms/eval
+    c = BatchController(m, slo_budget_s=0.05, max_batch=128, margin=0.6)
+    t = c.target_batch(ready=1000, oldest_age_s=0.0)
+    # 4 + 0.5n <= 30 -> n <= 52 -> best pow2 = 32
+    assert t == 32
+
+
+# ----------------------------------------------------------- token bucket
+def test_token_bucket_burst_and_refill():
+    b = TokenBucket(rate=1000.0, burst=3.0)
+    assert b.take() and b.take() and b.take()
+    assert not b.take()
+    time.sleep(0.01)            # ~10 tokens refill at rate 1000/s
+    assert b.take()
+
+
+# ----------------------------------------------------- admission control
+def test_admission_admits_under_bound():
+    a = AdmissionController(max_pending=100)
+    ev = mock.eval_()
+    assert a.offer(ev, ready_count=0)
+    assert a.stats()["admitted"] == 1
+
+
+def test_admission_sheds_over_bound_protects_priority():
+    a = AdmissionController(max_pending=10, protect_priority=80)
+    lo = mock.eval_(priority=50)
+    hi = mock.eval_(priority=90)
+    assert not a.offer(lo, ready_count=10)
+    assert a.offer(hi, ready_count=10)       # bypass lane never sheds
+    s = a.stats()
+    assert s["shed"] == 1 and s["admitted"] == 1
+    assert s["shed_by_namespace"] == {"default": 1}
+
+
+def test_admission_core_evals_always_admitted():
+    a = AdmissionController(max_pending=1)
+    core = mock.eval_(type=structs.JOB_TYPE_CORE, priority=1)
+    assert a.offer(core, ready_count=999)
+
+
+def test_admission_namespace_fairness_above_watermark():
+    a = AdmissionController(max_pending=100, fairness_watermark=0.5,
+                            ns_rate=0.0, ns_burst=2.0)
+    flappy = [mock.eval_() for _ in range(4)]
+    for ev in flappy:
+        ev.namespace = "flappy"
+    other = mock.eval_()
+    other.namespace = "quiet"
+    # above the watermark the flapping tenant exhausts its burst of 2
+    got = [a.offer(ev, ready_count=60) for ev in flappy]
+    assert got == [True, True, False, False]
+    # a quiet tenant still gets through
+    assert a.offer(other, ready_count=60)
+    # below the watermark fairness is off (work-conserving)
+    assert a.offer(mock.eval_(), ready_count=10)
+
+
+def test_admission_brownout_trips_and_restores_on_drain():
+    a = AdmissionController(max_pending=100, brownout_high=0.75,
+                            brownout_low=0.25, brownout_after_s=0.05)
+    assert not a.brownout_active()
+    a.offer(mock.eval_(), ready_count=90)      # overload begins
+    time.sleep(0.08)
+    a.offer(mock.eval_(), ready_count=90)      # sustained -> trips
+    assert a.brownout_active()
+    # while browned out, non-protected ingress sheds even under bound
+    assert not a.offer(mock.eval_(priority=50), ready_count=50)
+    assert a.offer(mock.eval_(priority=90), ready_count=50)
+    # no quota while still above the low watermark
+    assert a.readmit_quota(ready_count=60) == 0
+    assert a.brownout_active()
+    # drain below low watermark: brownout clears, quota opens
+    q = a.readmit_quota(ready_count=10, batch=16)
+    assert q > 0
+    assert not a.brownout_active()
+    assert a.stats()["brownouts_entered"] == 1
+
+
+# ------------------------------------------------------------- shed lane
+def test_blocked_evals_shed_and_pop_priority_order():
+    broker = make_broker()
+    be = BlockedEvals(broker)
+    be.set_enabled(True)
+    lo = mock.eval_(priority=10, job_id="job-lo")
+    hi = mock.eval_(priority=90, job_id="job-hi")
+    mid = mock.eval_(priority=50, job_id="job-mid")
+    for ev in (lo, hi, mid):
+        be.shed(ev)
+    assert be.stats()["total_shed"] == 3
+    out = be.pop_shed(2)
+    assert [e.id for e in out] == [hi.id, mid.id]
+    assert all(e.status == structs.EVAL_STATUS_PENDING for e in out)
+    assert be.pop_shed(10) == [lo] or be.pop_shed(0) == []
+    assert be.shed_count() == 0
+
+
+def test_blocked_evals_shed_dedups_per_job_surfaces_duplicate():
+    broker = make_broker()
+    be = BlockedEvals(broker)
+    be.set_enabled(True)
+    old = mock.eval_(job_id="job-1")
+    new = mock.eval_(job_id="job-1")
+    be.shed(old)
+    be.shed(new)
+    dups = be.get_duplicates()
+    assert [d.id for d in dups] == [old.id]     # never silently dropped
+    out = be.pop_shed(10)
+    assert [e.id for e in out] == [new.id]
+
+
+def test_blocked_evals_block_displaces_shed():
+    broker = make_broker()
+    be = BlockedEvals(broker)
+    be.set_enabled(True)
+    shed = mock.eval_(job_id="job-1")
+    blocked = mock.eval_(job_id="job-1")
+    blocked.class_eligibility = {"c1": True}
+    be.shed(shed)
+    be.block(blocked)
+    assert [d.id for d in be.get_duplicates()] == [shed.id]
+    assert be.stats()["total_shed"] == 0
+    assert be.stats()["total_blocked"] == 1
+
+
+# ----------------------------------------- server-level admission gating
+def test_server_ingress_sheds_into_blocked_evals_and_readmits():
+    server = Server(num_workers=0,
+                    serving_config={"max_pending": 3,
+                                    "bypass_priority": 200})
+    server.start()
+    try:
+        for _ in range(4):
+            server.register_node(mock.node())
+        jobs = [mock.job() for _ in range(6)]
+        for j in jobs:
+            j.task_groups[0].count = 1
+            server.register_job(j)
+        ready = server.broker.ready_count()
+        shed = server.blocked_evals.stats()["total_shed"]
+        assert ready + shed == 6            # zero lost at ingress
+        assert shed >= 2                    # bound enforced
+        # evals are still persisted PENDING in state either way
+        pending = [e for e in server.store.evals()
+                   if e.status == structs.EVAL_STATUS_PENDING]
+        assert len(pending) == 6
+        # drain the admitted work, then the worker readmit tick pops
+        # shed evals back into the broker
+        w = Worker(server, ["service"])
+        while True:
+            batch = server.broker.dequeue_batch(["service"], 8, 0.2)
+            if not batch:
+                break
+            for ev, tok in batch:
+                server.broker.ack(ev.id, tok)
+        w._readmit_tick(server.serving)
+        assert server.blocked_evals.stats()["total_shed"] == 0
+        assert server.broker.ready_count() == shed
+    finally:
+        server.stop()
+
+
+# ------------------------------------------- nack pause under batch work
+def test_batch_pause_prevents_spurious_redelivery():
+    b = make_broker(nack_delay_s=0.05)
+    evs = [mock.eval_(job_id=f"j{i}") for i in range(3)]
+    for ev in evs:
+        b.enqueue(ev)
+    batch = b.dequeue_batch(["service"], 3, 1.0)
+    assert len(batch) == 3
+    for ev, tok in batch:
+        assert b.pause_nack_timeout(ev.id, tok) is None
+    time.sleep(0.15)            # 3x the nack delay
+    st = b.stats()
+    assert st["nacks"] == 0 and st["total_ready"] == 0
+    assert st["total_unacked"] == 3
+    for ev, tok in batch:
+        assert b.ack(ev.id, tok) is None
+
+
+def test_fleet_slow_solve_no_spurious_redelivery(monkeypatch):
+    """Regression (ISSUE 6 satellite): a fused batch whose solve
+    outlives the nack timeout must not get its members redelivered
+    mid-solve — process_fleet pauses every member's timer up front."""
+    from nomad_tpu.scheduler import fleet as fleet_mod
+
+    server = Server(num_workers=0)
+    server.broker.nack_delay_s = 0.05
+    server.start()
+    try:
+        server.register_node(mock.node())
+        jobs = [mock.job() for _ in range(2)]
+        for j in jobs:
+            server.register_job(j)
+        batch = server.broker.dequeue_batch(["service"], 4, 1.0)
+        assert len(batch) == 2
+
+        class SlowSched:
+            def __init__(self, *a, **kw):
+                self._sticky_probes = []
+
+            def _begin(self, ev, snapshot):
+                time.sleep(0.12)        # > 2x the nack delay
+                return [], None         # nothing missing
+
+            def _finalize(self, state):
+                return True, None
+
+            def _set_status(self, status, desc):
+                pass
+
+        monkeypatch.setattr(fleet_mod, "GenericScheduler", SlowSched)
+        fleet_mod.process_fleet(server, Worker(server, ["service"]),
+                                batch)
+        st = server.broker.stats()
+        assert st["nacks"] == 0, "slow fused solve was redelivered"
+        assert st["total_unacked"] == 0     # every member acked
+        assert st["total_waiting"] == 0
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- worker bypass lane
+def test_worker_express_lane_processes_high_priority_first(monkeypatch):
+    server = Server(num_workers=0)
+    server.start()
+    try:
+        w = Worker(server, ["service"])
+        order = []
+        monkeypatch.setattr(
+            w, "_process", lambda ev, tok: order.append(ev.id))
+        monkeypatch.setattr(
+            "nomad_tpu.scheduler.fleet.process_fleet",
+            lambda srv, wk, bulk: order.extend(e.id for e, _ in bulk))
+        hi = mock.eval_(priority=90)
+        bulk = [mock.eval_(priority=50) for _ in range(3)]
+        batch = [(bulk[0], "t0"), (hi, "t1"),
+                 (bulk[1], "t2"), (bulk[2], "t3")]
+        w._run_batch(server.serving, batch)
+        assert order[0] == hi.id
+        assert set(order[1:]) == {e.id for e in bulk}
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------- broker observability
+def test_broker_oldest_ready_age_and_gauges():
+    from nomad_tpu.utils.metrics import global_metrics
+    b = make_broker()
+    assert b.oldest_ready_age() == 0.0
+    b.enqueue(mock.eval_(job_id="j1"))
+    time.sleep(0.03)
+    b.enqueue(mock.eval_(job_id="j2"))
+    age = b.oldest_ready_age()
+    assert 0.02 < age < 1.0
+    b.export_metrics()
+    dump = global_metrics.dump()
+    assert dump["gauges"]["broker.ready_count"] == 2.0
+    assert dump["gauges"]["broker.ready.service"] == 2.0
+    assert dump["gauges"]["broker.oldest_ready_age_s"] >= 0.02
+    batch = b.dequeue_batch(["service"], 2, 1.0)
+    assert len(batch) == 2
+    assert b.oldest_ready_age() == 0.0
+    # dequeue-batch size histogram flows through the samples reservoir
+    assert dump["samples"].get("broker.dequeue_batch_size") is not None \
+        or global_metrics.dump()["samples"][
+            "broker.dequeue_batch_size"]["count"] >= 1
+    for ev, tok in batch:
+        b.ack(ev.id, tok)
+    assert b.stats()["oldest_ready_age_s"] == 0.0
+
+
+def test_stats_surface_shed_and_oldest_age():
+    server = Server(num_workers=0)
+    server.start()
+    try:
+        assert "total_shed" in server.blocked_evals.stats()
+        assert "oldest_ready_age_s" in server.broker.stats()
+        assert "admission" in server.serving.stats()
+    finally:
+        server.stop()
+
+
+# ------------------------------------- at-least-once property (random)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_admission_shed_requeue_at_least_once_property(seed):
+    """Random enqueue/shed/dequeue/ack/nack/readmit interleavings:
+    (1) never two in-flight evals for one job, and (2) zero lost —
+    every ingress eval is eventually acked, parked in the failed
+    queue, or explicitly surfaced as a displaced duplicate."""
+    rng = random.Random(seed)
+    broker = EvalBroker(nack_delay_s=30.0, initial_nack_delay_s=0.01,
+                        delivery_limit=3)
+    broker.set_enabled(True)
+    be = BlockedEvals(broker)
+    be.set_enabled(True)
+    adm = AdmissionController(max_pending=6, protect_priority=101,
+                              brownout_high=0.9, brownout_low=0.5,
+                              brownout_after_s=0.001,
+                              ns_rate=500.0, ns_burst=50.0)
+    jobs = [f"job-{i}" for i in range(5)]
+    ingress = {}                  # id -> eval
+    in_flight = {}                # id -> (eval, token)
+    acked = set()
+
+    def job_of(eid):
+        return ingress[eid].job_id
+
+    for step in range(400):
+        op = rng.random()
+        if op < 0.45:
+            ev = mock.eval_(job_id=rng.choice(jobs),
+                            priority=rng.choice([30, 50, 70, 100]))
+            ingress[ev.id] = ev
+            if adm.offer(ev, broker.ready_count()):
+                broker.enqueue(ev)
+            else:
+                be.shed(ev)
+        elif op < 0.70:
+            batch = broker.dequeue_batch(["service"],
+                                         rng.randint(1, 4), 0.0)
+            jobs_in_flight = {job_of(i) for i in in_flight}
+            for ev, tok in batch:
+                # per-job serialization invariant
+                assert ev.job_id not in jobs_in_flight, \
+                    "two in-flight evals for one job"
+                jobs_in_flight.add(ev.job_id)
+                in_flight[ev.id] = (ev, tok)
+        elif op < 0.85 and in_flight:
+            eid = rng.choice(sorted(in_flight))
+            ev, tok = in_flight.pop(eid)
+            if rng.random() < 0.7:
+                assert broker.ack(eid, tok) is None
+                acked.add(eid)
+            else:
+                assert broker.nack(eid, tok) is None
+        else:
+            q = adm.readmit_quota(broker.ready_count(), batch=4)
+            for ev in be.pop_shed(q):
+                broker.enqueue(ev)
+
+    # ---- drain to quiescence: readmit everything, ack everything
+    deadline = time.monotonic() + 20.0
+    failed_parked = set()
+    while time.monotonic() < deadline:
+        for ev in be.pop_shed(1000):
+            broker.enqueue(ev)
+        batch = broker.dequeue_batch(["service"], 8, 0.05)
+        for ev, tok in batch:
+            assert broker.ack(ev.id, tok) is None
+            acked.add(ev.id)
+        fb = broker.dequeue_batch(["_failed"], 8, 0.0)
+        for ev, tok in fb:
+            failed_parked.add(ev.id)
+            assert broker.ack(ev.id, tok) is None
+        for ev, tok in list(in_flight.values()):
+            assert broker.ack(ev.id, tok) is None
+            acked.add(ev.id)
+        in_flight.clear()
+        st = broker.stats()
+        if (not batch and not fb and be.shed_count() == 0
+                and st["total_ready"] == 0 and st["total_unacked"] == 0
+                and st["total_waiting"] == 0
+                and st["total_blocked"] == 0):
+            break
+    duplicates = {d.id for d in be.get_duplicates()}
+    accounted = acked | failed_parked | duplicates
+    lost = set(ingress) - accounted
+    assert not lost, f"lost evals: {sorted(lost)[:5]} (of {len(lost)})"
+
+
+# ----------------------------------------------------- brownout degrade
+def test_solver_degraded_flag_reduces_wave_budget():
+    from nomad_tpu.solver.solve import BROWNOUT_MAX_WAVES, Solver
+    from nomad_tpu.solver.tensorize import PlacementAsk
+
+    s = Solver()
+    assert not s.degraded
+    s.set_degraded(True)
+    assert s.degraded
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        n.compute_class()
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    asks = [PlacementAsk(job=job, tg=tg, count=2)]
+    out = s.solve(nodes, asks, {}, {})
+    # a tiny uncontended ask still places inside the degraded budget
+    assert sum(1 for p in out.placements if p.node is not None) == 2
+    assert BROWNOUT_MAX_WAVES < 12
+    s.set_degraded(False)
+    assert not s.degraded
