@@ -428,6 +428,34 @@ def _check_donated_reads(index: PackageIndex, fi,
     findings: List[Finding] = []
     la = index._local_imports(fi)
     lt = index._local_var_types(fi)
+    # single-assignment local aliases of attribute chains
+    # (`dn = self._dev_node`): a buffer donated through the alias is
+    # dead through the attribute path too — the ISSUE-7 eviction-plane
+    # carry pattern (`dn["ev_prio"] = scatter(dn["ev_prio"], ...)` vs
+    # a later `self._dev_node["ev_prio"]` read).  Every key below is
+    # canonicalized onto the aliased expression, so rebinds through
+    # either spelling suppress correctly.
+    alias_counts: Dict[str, int] = {}
+    aliases: Dict[str, str] = {}
+    for node in index._own_nodes(fi):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            alias_counts[name] = alias_counts.get(name, 0) + 1
+            if isinstance(node.value, ast.Attribute):
+                tgt = _dotted(node.value)
+                if tgt:
+                    aliases[name] = tgt
+    aliases = {a: t for a, t in aliases.items()
+               if alias_counts.get(a) == 1}
+
+    def _canon(key: str) -> str:
+        for a, full in aliases.items():
+            if key == a or key.startswith(a + "[") \
+                    or key.startswith(a + "."):
+                return full + key[len(a):]
+        return key
+
     # collect (donated_expr_repr, call_lineno)
     events: List[Tuple[str, int]] = []
     rebinds: List[Tuple[str, int]] = []
@@ -440,17 +468,17 @@ def _check_donated_reads(index: PackageIndex, fi,
                     if pos < len(node.args):
                         key = _expr_key(node.args[pos])
                         if key:
-                            events.append((key, node.lineno))
+                            events.append((_canon(key), node.lineno))
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 key = _expr_key(t)
                 if key:
-                    rebinds.append((key, node.lineno))
+                    rebinds.append((_canon(key), node.lineno))
         if isinstance(node, (ast.Name, ast.Subscript, ast.Attribute)) \
                 and isinstance(getattr(node, "ctx", None), ast.Load):
             key = _expr_key(node)
             if key:
-                loads.append((key, node.lineno))
+                loads.append((_canon(key), node.lineno))
     for key, cline in events:
         rebind_line = min((ln for k, ln in rebinds
                            if k == key and ln >= cline),

@@ -545,7 +545,16 @@ class HTTPAgentServer:
         job.version = (current.version + 1) if current else 0
         snap._t["jobs"] = dict(snap._t["jobs"])
         snap._t["jobs"][(job.namespace, job.id)] = job
-        sched = new_scheduler(job.type, snap, planner)
+        # what-if overlay solve (ISSUE 7): ride the first worker's
+        # resident solver through its read-only plan view — the dry run
+        # answers from the delta-maintained template at steady-state
+        # speed, against a copy-on-read usage overlay, and never
+        # touches the carried world state
+        solver = None
+        workers = getattr(self.server, "workers", None)
+        if workers:
+            solver = workers[0].fleet_solver().plan_view()
+        sched = new_scheduler(job.type, snap, planner, solver=solver)
         planner_err = sched.process(ev)
         ann = None
         if planner.plans and planner.plans[-1].annotations is not None:

@@ -175,15 +175,20 @@ def make_node_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used0, dev_used0, stacked, n_places,
-                         seeds, *, n_shards, has_spread,
-                         group_count_hint, max_waves, wave_mode,
-                         has_distinct, has_devices, stack_commit,
-                         compact, pallas_mode, shortlist_c):
+                         seeds, ev_res, ev_prio, *, n_shards,
+                         has_spread, group_count_hint, max_waves,
+                         wave_mode, has_distinct, has_devices,
+                         stack_commit, compact, pallas_mode,
+                         shortlist_c, has_preempt):
     """shard_map body: the resident stream scan with every solve run in
     mesh mode.  All node args are this shard's LOCAL planes; ask
     tensors are replicated except the [B, G, N] planes (node-sharded on
-    their last axis).  Outputs: local used/dev_used blocks, replicated
-    packed results and wave counters."""
+    their last axis).  The eviction planes (ISSUE 7) are node-sharded
+    like every other node plane — the kernel's preemption pass is
+    shard-local and only per-group eviction KEYS (score, global node
+    id) ride the candidate-key ICI exchange.  Outputs: local
+    used/dev_used blocks, replicated packed results, psum-replicated
+    evict masks, wave counters."""
     def step(carry, xs):
         used, dev_used = carry
         batch, n_place, seed = xs
@@ -192,7 +197,9 @@ def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
                          has_spread, group_count_hint, max_waves,
                          wave_mode, has_distinct, has_devices,
                          stack_commit, pallas_mode, shortlist_c,
-                         mesh_axis=MESH_NODE_AXIS, mesh_shards=n_shards)
+                         mesh_axis=MESH_NODE_AXIS, mesh_shards=n_shards,
+                         has_preempt=has_preempt, ev_res=ev_res,
+                         ev_prio=ev_prio)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -202,12 +209,14 @@ def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
             packed = jnp.concatenate(
                 [res.choice.astype(jnp.float32), res.score,
                  status.astype(jnp.float32)[:, None]], axis=-1)
+        evict = (res.evict if has_preempt
+                 else jnp.zeros((res.choice.shape[0], 1), bool))
         return ((res.used_final, res.dev_used_final),
-                (packed, res.n_waves, res.n_rescore))
+                (packed, evict, res.n_waves, res.n_rescore))
 
-    (used_f, dev_used_f), (out, waves, rescores) = jax.lax.scan(
+    (used_f, dev_used_f), (out, evict, waves, rescores) = jax.lax.scan(
         step, (used0, dev_used0), (stacked, n_places, seeds))
-    return used_f, dev_used_f, out, waves, rescores
+    return used_f, dev_used_f, out, evict, waves, rescores
 
 
 def _build_sharded_stream_kernel(mesh: Mesh):
@@ -223,30 +232,39 @@ def _build_sharded_stream_kernel(mesh: Mesh):
     @functools.partial(jax.jit, static_argnames=(
         "has_spread", "group_count_hint", "max_waves", "wave_mode",
         "has_distinct", "has_devices", "stack_commit", "compact",
-        "pallas_mode", "shortlist_c"))
+        "pallas_mode", "shortlist_c", "has_preempt"))
     def kern(avail, reserved, valid, node_dc, attr_rank, dev_cap,
-             used0, dev_used0, stacked, n_places, seeds, *,
+             used0, dev_used0, stacked, n_places, seeds,
+             ev_res=None, ev_prio=None, *,
              has_spread=True, group_count_hint=0, max_waves=0,
              wave_mode="scan", has_distinct=True, has_devices=True,
              stack_commit=False, compact=True, pallas_mode="off",
-             shortlist_c=0):
+             shortlist_c=0, has_preempt=False):
         stacked_specs = {k: (plane if k in _PLANE_ASK_ARGS else P())
                          for k in stacked}
+        # eviction planes shard on the node axis with the rest of the
+        # node-side state; without preemption the (None) placeholders
+        # are replicated empties
+        ev3 = P(axis, None, None) if has_preempt else P()
+        ev2 = P(axis, None) if has_preempt else P()
         body = functools.partial(
             _sharded_stream_body, n_shards=n_shards,
             has_spread=has_spread, group_count_hint=group_count_hint,
             max_waves=max_waves, wave_mode=wave_mode,
             has_distinct=has_distinct, has_devices=has_devices,
             stack_commit=stack_commit, compact=compact,
-            pallas_mode=pallas_mode, shortlist_c=shortlist_c)
+            pallas_mode=pallas_mode, shortlist_c=shortlist_c,
+            has_preempt=has_preempt)
         return shard_map(
             body, mesh=mesh,
             in_specs=(node2, node2, node1, node1, node2, node2,
-                      node2, node2, stacked_specs, P(), P()),
-            out_specs=(node2, node2, P(), P(), P()),
+                      node2, node2, stacked_specs, P(), P(),
+                      ev3, ev2),
+            out_specs=(node2, node2, P(), P(), P(), P()),
             check_rep=False)(
             avail, reserved, valid, node_dc, attr_rank, dev_cap,
-            used0, dev_used0, stacked, n_places, seeds)
+            used0, dev_used0, stacked, n_places, seeds,
+            ev_res, ev_prio)
 
     return kern
 
@@ -326,8 +344,9 @@ class ShardedResidentSolver(ResidentSolver):
 
     # ---------------- sharded placement hooks ----------------
     def _put_node(self, name, arr):
-        spec = P(MESH_NODE_AXIS, None) if np.ndim(arr) == 2 \
-            else P(MESH_NODE_AXIS)
+        # leading node axis sharded, trailing axes replicated (covers
+        # the 3-D ev_res eviction plane alongside the 1/2-D planes)
+        spec = P(MESH_NODE_AXIS, *([None] * (np.ndim(arr) - 1)))
         # copy before placing — see ResidentSolver._put_node (host-side
         # in-place template updates must never alias device buffers)
         return jax.device_put(np.array(arr),
@@ -387,19 +406,23 @@ class ShardedResidentSolver(ResidentSolver):
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
                     else np.asarray(list(seeds), np.int32))
-        (self._used, self._dev_used, out, self.last_waves,
-         self.last_rescore_waves) = self._kern(
+        has_distinct = self._has_distinct(batches)
+        preempt = self._preempt_on(has_distinct)
+        (self._used, self._dev_used, out, self.last_evict,
+         self.last_waves, self.last_rescore_waves) = self._kern(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
             self._used, self._dev_used, stacked, n_places, seed_arr,
+            self._dev_node.get("ev_res"), self._dev_node.get("ev_prio"),
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
             max_waves=self.max_waves, wave_mode=self.wave_mode,
-            has_distinct=self._has_distinct(batches),
+            has_distinct=has_distinct,
             has_devices=self._has_devices(batches),
             stack_commit=self.stack_commit, compact=self._compact,
-            pallas_mode=self.pallas, shortlist_c=self.shortlist_c)
+            pallas_mode=self.pallas, shortlist_c=self.shortlist_c,
+            has_preempt=preempt)
         return out
 
     # ---------------- byte model ----------------

@@ -114,9 +114,19 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
         # after its plan commits — see module note); sticky probes from
         # every fused eval overlay the resident world's usage
         probes = [p for e in solvable for p in e.sched._sticky_probes]
+        # in-kernel preemption only when EVERY fused eval's scheduler
+        # type has it enabled (the pass can't gate per ask beyond the
+        # priority delta); mixed configs keep the host-side fallback
+        from .preemption import preemption_enabled
+        cfg = snapshot.scheduler_config()
+        preempt_ok = all(
+            preemption_enabled(cfg, "batch" if e.sched.batch
+                               else "service")
+            for e in solvable)
         out = worker.fleet_solver().solve(nodes, all_asks, allocs_by_node,
                                           by_dc, snapshot=snapshot,
-                                          proposed_delta=([], probes))
+                                          proposed_delta=([], probes),
+                                          preempt=preempt_ok)
 
     for e in solvable:
         missing, ask_missing = e.prep

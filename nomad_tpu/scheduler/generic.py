@@ -277,9 +277,14 @@ class GenericScheduler:
         # places the proposed usage differs from the store-tracked one
         stops = [a for lst in self.plan.node_update.values()
                  for a in lst]
+        from .preemption import preemption_enabled
+        preempt_ok = preemption_enabled(
+            snapshot.scheduler_config(),
+            "batch" if self.batch else "service")
         out = self.solver.solve(
             nodes, asks, allocs_by_node, by_dc, snapshot=snapshot,
-            proposed_delta=(stops, list(self._sticky_probes)))
+            proposed_delta=(stops, list(self._sticky_probes)),
+            preempt=preempt_ok)
         self._consume_solve(snapshot, out, nodes, allocs_by_node, missing,
                             ask_missing)
         return None
@@ -453,6 +458,14 @@ class GenericScheduler:
                     self._record_failure(m, placement)
                     failed.add(id(m))
                 continue
+            if placement.evicted:
+                # the in-kernel preemption pass already selected this
+                # placement's victim set (solver/kernel.py eviction
+                # waves) — commit the (place, evict) pair without the
+                # host-side walk
+                self._commit_kernel_eviction(placement, m,
+                                             allocs_by_node)
+                continue
             self._emit_alloc(m, placement.node, placement.resources,
                              placement.score, placement.metrics)
 
@@ -476,6 +489,29 @@ class GenericScheduler:
                 if not self.plan.node_update[m.previous.node_id]:
                     del self.plan.node_update[m.previous.node_id]
 
+    def _commit_kernel_eviction(self, placement, m: _Missing,
+                                allocs_by_node) -> None:
+        """Commit a (place, evict) pair the device eviction pass
+        selected: victims leave via plan.node_preemptions, the alloc
+        lands with preempted_allocations set, and the shared
+        allocs_by_node view advances so later placements (and the
+        host-side fallback walk) see both sides."""
+        from ..utils.metrics import global_metrics as _m
+        _m.incr_counter("scheduler.preempt.kernel")
+        node = placement.node
+        vset = set(placement.evicted)
+        proposed = list(allocs_by_node.get(node.id, ())) \
+            if allocs_by_node is not None else []
+        victims = [a for a in proposed if a.id in vset]
+        alloc = self._emit_alloc(m, node, placement.resources,
+                                 placement.score, placement.metrics)
+        alloc.preempted_allocations = sorted(vset)
+        if allocs_by_node is not None:
+            allocs_by_node[node.id] = [a for a in proposed
+                                       if a.id not in vset] + [alloc]
+        for v in victims:
+            self.plan.append_preempted_alloc(v, alloc.id)
+
     def _try_preemption(self, nodes, m: _Missing, allocs_by_node) -> bool:
         """Second pass for an exhausted placement: across ALL feasible
         nodes, find victim sets (task-group resources, then network and
@@ -483,9 +519,13 @@ class GenericScheduler:
         BEST node — highest bin-pack score after eviction, matching the
         reference where preemption options feed the regular rank/max
         pipeline (preemption.go wired via rank.go BinPackIterator) —
-        not the first node that works."""
+        not the first node that works.  Counted as the host-side
+        FALLBACK — ISSUE 7 steady state should select evictions
+        in-kernel instead (scheduler.preempt.kernel)."""
         from ..structs.funcs import score_fit, allocs_fit
+        from ..utils.metrics import global_metrics as _m
         from .preemption import find_preemption
+        _m.incr_counter("scheduler.preempt.host_fallback")
 
         best = None                # (score, node, victims, resources)
         for node in nodes:

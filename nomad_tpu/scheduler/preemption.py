@@ -26,6 +26,61 @@ def resource_distance(delta_cpu: float, delta_mem: float, delta_disk: float,
             + delta_net ** 2) ** 0.5
 
 
+def victim_distance(shortfall: Tuple[float, float, float, float],
+                    usage: Tuple[float, float, float, float]) -> float:
+    """Distance between a victim's usage and the remaining shortfall,
+    each dimension normalized by the shortfall (floored at 1).
+
+    This is THE single victim-cost contract (ISSUE 7): every host pass
+    scores candidates through it, and the device eviction pass
+    (solver/kernel.py preemption waves) mirrors it float-op-for-float-op
+    — a down-payment on ROADMAP item 5's one-scoring-spec refactor.
+    Term order inside resource_distance is part of the contract."""
+    sc, sm, sd, sn = shortfall
+    c, m, d, nw = usage
+    return resource_distance((sc - c) / max(sc, 1.0),
+                             (sm - m) / max(sm, 1.0),
+                             (sd - d) / max(sd, 1.0),
+                             (sn - nw) / max(sn, 1.0))
+
+
+def take_from_groups(job_priority: int, allocs: Sequence[Allocation],
+                     met, charge, order_key=None
+                     ) -> Tuple[List[Allocation], bool]:
+    """Shared victim-accumulation walk: priority groups lowest first
+    (group_preemptible), victims inside a group consumed in `order_key`
+    order (stable sort; None keeps candidate order), `charge`-ing each
+    pick until `met()` — the one loop behind preempt_for_network and
+    preempt_for_device (pick_victims re-sorts against a MOVING shortfall
+    every pick, so it keeps its own loop over the same cost helper)."""
+    victims: List[Allocation] = []
+    for grp in group_preemptible(job_priority, allocs):
+        if order_key is not None:
+            grp.sort(key=order_key)
+        for a in grp:
+            charge(a)
+            victims.append(a)
+            if met():
+                return victims, True
+    return victims, False
+
+
+def prune_superset(victims: List[Allocation], covers_without, order_key,
+                   protected: frozenset = frozenset()
+                   ) -> List[Allocation]:
+    """Shared redundancy filter (reference :702): walk victims in
+    `order_key` order and drop any whose eviction is redundant once the
+    rest are out (`covers_without(trial)`), keeping `protected` ids."""
+    pruned = list(victims)
+    for a in sorted(victims, key=order_key):
+        if a.id in protected:
+            continue
+        trial = [v for v in pruned if v.id != a.id]
+        if covers_without(trial):
+            pruned = trial
+    return pruned
+
+
 def _usage(alloc: Allocation) -> Tuple[float, float, float, float]:
     c = alloc.comparable_resources()
     return (float(c.cpu), float(c.memory_mb), float(c.disk_mb),
@@ -92,14 +147,8 @@ def pick_victims(node: Node, proposed: Sequence[Allocation],
     while any(s > 0 for s in shortfall(freed)):
         if not remaining:
             return None
-        sc, sm, sd, sn = shortfall(freed)
-        norm = (max(sc, 1.0), max(sm, 1.0), max(sd, 1.0), max(sn, 1.0))
-
-        def dist(a: Allocation) -> float:
-            c, m, d, nw = _usage(a)
-            return resource_distance((sc - c) / norm[0], (sm - m) / norm[1],
-                                     (sd - d) / norm[2], (sn - nw) / norm[3])
-        remaining.sort(key=dist)
+        short = shortfall(freed)
+        remaining.sort(key=lambda a: victim_distance(short, _usage(a)))
         pick = remaining.pop(0)
         victims.append(pick)
         c, m, d, nw = _usage(pick)
@@ -108,16 +157,16 @@ def pick_victims(node: Node, proposed: Sequence[Allocation],
     # redundancy filter: drop any victim whose resources are not needed
     # once the rest are evicted (check highest-priority victims first so
     # the cheapest evictions survive)
-    pruned = list(victims)
-    for a in sorted(victims,
-                    key=lambda v: -(v.job.priority if v.job else 50)):
-        trial = [v for v in pruned if v.id != a.id]
+    def covers_without(trial):
         fc = sum(_usage(v)[0] for v in trial)
         fm = sum(_usage(v)[1] for v in trial)
         fd = sum(_usage(v)[2] for v in trial)
         fn = sum(_usage(v)[3] for v in trial)
-        if not any(s > 0 for s in shortfall((fc, fm, fd, fn))):
-            pruned = trial
+        return not any(s > 0 for s in shortfall((fc, fm, fd, fn)))
+
+    pruned = prune_superset(
+        victims, covers_without,
+        order_key=lambda v: -(v.job.priority if v.job else 50))
     return pruned or None
 
 
@@ -213,18 +262,20 @@ def preempt_for_network(job_priority: int, proposed: Sequence[Allocation],
 
         met = preempted_bw + free_bw >= mbits_needed
         if not met:
-            for grp in group_preemptible(job_priority, current):
-                grp.sort(key=lambda a: net_distance(
-                    (_first_network(a).mbits if _first_network(a) else 0)))
-                for a in grp:
-                    net = _first_network(a)
-                    preempted_bw += int(net.mbits) if net else 0
-                    victims.append(a)
-                    if preempted_bw + free_bw >= mbits_needed:
-                        met = True
-                        break
-                if met:
-                    break
+            bw = {"freed": preempted_bw}
+
+            def charge(a):
+                net = _first_network(a)
+                bw["freed"] += int(net.mbits) if net else 0
+
+            taken, met = take_from_groups(
+                job_priority, current,
+                met=lambda: bw["freed"] + free_bw >= mbits_needed,
+                charge=charge,
+                order_key=lambda a: net_distance(
+                    _first_network(a).mbits if _first_network(a) else 0))
+            victims.extend(taken)
+            preempted_bw = bw["freed"]
         if not met:
             continue
         # superset filter: drop victims (largest distance first) whose
@@ -236,16 +287,17 @@ def preempt_for_network(job_priority: int, proposed: Sequence[Allocation],
             if net and any(p.value in ports_needed
                            for p in net.reserved_ports):
                 port_holders.add(a.id)
-        pruned = list(victims)
-        for a in sorted(victims, key=lambda v: -net_distance(
-                _first_network(v).mbits if _first_network(v) else 0)):
-            if a.id in port_holders:
-                continue
-            trial = [v for v in pruned if v.id != a.id]
+
+        def covers_without(trial):
             freed = sum(int(_first_network(v).mbits)
                         for v in trial if _first_network(v))
-            if freed + free_bw >= mbits_needed:
-                pruned = trial
+            return freed + free_bw >= mbits_needed
+
+        pruned = prune_superset(
+            victims, covers_without,
+            order_key=lambda v: -net_distance(
+                _first_network(v).mbits if _first_network(v) else 0),
+            protected=frozenset(port_holders))
         return pruned or None
     return None
 
@@ -291,17 +343,12 @@ def preempt_for_device(job_priority: int, proposed: Sequence[Allocation],
     options: List[Tuple[List[Allocation], Dict[str, int]]] = []
     for key, (allocs, counts) in group_use.items():
         free = len(acct.free_instances(*key))
-        preempted = 0
-        picked: List[Allocation] = []
-        for grp in group_preemptible(job_priority, allocs):
-            for a in grp:
-                preempted += counts[a.id]
-                picked.append(a)
-                if preempted + free >= needed:
-                    break
-            if preempted + free >= needed:
-                break
-        if preempted + free >= needed:
+        got = {"n": 0}
+        picked, enough = take_from_groups(
+            job_priority, allocs,
+            met=lambda: got["n"] + free >= needed,
+            charge=lambda a: got.__setitem__("n", got["n"] + counts[a.id]))
+        if enough:
             options.append((picked, counts))
     if not options:
         return None

@@ -24,17 +24,20 @@ class Worker(threading.Thread):
         self._shutdown = threading.Event()
         self.paused = threading.Event()
         self._solver = None
+        self._solver_lock = threading.Lock()
 
     def fleet_solver(self):
         """One Solver per worker, store-attached: its tensorizer's
         computed-class memo is shared across the fused batch, and its
         resident cluster world advances by changesets (plan-apply feed
         below + the store change log) instead of re-packing the world
-        per eval."""
-        if self._solver is None:
-            from ..solver.solve import Solver
-            self._solver = Solver(store=self.server.store)
-        return self._solver
+        per eval.  Locked: the HTTP plan endpoint reaches in from its
+        own thread for the what-if plan_view (ISSUE 7)."""
+        with self._solver_lock:
+            if self._solver is None:
+                from ..solver.solve import Solver
+                self._solver = Solver(store=self.server.store)
+            return self._solver
 
     def shutdown(self) -> None:
         self._shutdown.set()

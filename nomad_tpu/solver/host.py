@@ -27,9 +27,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from .kernel import (MAX_WAVES, MERGED_GP_MAX, NEG_INF, TOP_K, WAVE_K,
-                     _APPROX_MIN_NP, _MERGED_W_CAP, _SELECT_SUM_MAX_V,
-                     _WIDE_W_CAP, SolveResult)
+from .kernel import (EV_PRIORITY_DELTA, MAX_WAVES, MERGED_GP_MAX, NEG_INF,
+                     TOP_K, WAVE_K, _APPROX_MIN_NP, _MERGED_W_CAP,
+                     _SELECT_SUM_MAX_V, _WIDE_W_CAP, SolveResult)
 from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT,
                         OP_NE, OP_NOT_SET, R_CPU, R_MEM)
 
@@ -160,7 +160,9 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                       p_ask, n_place, seed=0, *, has_spread=True,
                       group_count_hint=0, max_waves=0,
                       stack_commit=False,
-                      static_cache=None) -> SolveResult:
+                      static_cache=None, has_preempt=False,
+                      ev_res=None, ev_prio=None,
+                      ask_prio=None) -> SolveResult:
     """Numpy port of kernel.solve_kernel — see that docstring for the
     wave semantics.  Every formula, window size, and tie-break matches;
     tests/test_host_solver.py asserts bitwise-equal placements."""
@@ -290,6 +292,20 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         score = np.where(placeable, total, f32(NEG_INF))
         return score, placeable, feas_b, fit, fit_dims, dev_fit
 
+    # ---------- in-kernel preemption planes (kernel.py twin) ----------
+    if has_preempt:
+        EVW = ev_prio.shape[1]
+        ev_prio_i = np.asarray(ev_prio, np.int32)
+        ev_res_f = np.asarray(ev_res, f32)
+        ask_prio_i = np.asarray(ask_prio, np.int32)
+        ev_slot_ok = ((ev_prio_i[None, :, :] >= 0)
+                      & (ask_prio_i[:, None, None] - ev_prio_i[None, :, :]
+                         >= EV_PRIORITY_DELTA))       # [Gp, Np, E]
+        EVT = np.zeros((Np, EVW), bool)
+        out_evict = np.zeros((K, EVW), bool)
+    else:
+        out_evict = None
+
     # ---------- wave loop state ----------
     done = np.zeros(K, bool)
     out_idx = np.zeros((K, TOP_K), np.int32)
@@ -298,6 +314,7 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     out_nfeas = np.zeros(K, np.int32)
     out_nexh = np.zeros(K, np.int32)
     out_dimexh = np.zeros((K, R), np.int32)
+    out_wave = np.full(K, -1, np.int32)
     wave = 0
     Vs = sp_desired.shape[2]
 
@@ -473,15 +490,139 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                        np.clip(svals, 0, V - 1)),
                       okslot.astype(f32))
 
+        # ---------- preemption wave pass (kernel.py twin) ----------
+        ev_commit = np.zeros(K, bool)
+        if has_preempt:
+            want = active & ~commit & ~grp_any[g_idx]
+            want_g = np.zeros(Gp, bool)
+            np.logical_or.at(want_g, g_idx, want)
+            win_s = np.full(Gp, NEG_INF, f32)
+            win_i = np.zeros(Gp, np.int32)
+            sel_freed = np.zeros((Gp, R), f32)
+            sel_mask = np.zeros((Gp, EVW), bool)
+            if want.any():
+                es = np.arange(EVW)
+                base_short = (used[None, :, :] + ask_res[:, None, :]
+                              - avail[None, :, :])     # [Gp, Np, R]
+                slot_free = ev_slot_ok & ~EVT[None, :, :]
+                freed = np.zeros((Gp, Np, R), f32)
+                picked = np.zeros((Gp, Np, EVW), bool)
+                prank = np.full((Gp, Np, EVW), EVW, np.int32)
+                for t in range(EVW):
+                    s = np.maximum(base_short - freed, f32(0.0))
+                    covered = (s <= 0.0).all(axis=-1)
+                    norm = np.maximum(s, f32(1.0))
+                    diff = ((s[:, :, None, :] - ev_res_f[None, :, :, :])
+                            / norm[:, :, None, :])
+                    d2 = diff * diff
+                    dist = np.sqrt(((d2[..., 0] + d2[..., 1])
+                                    + d2[..., 2]) + d2[..., 3])
+                    cand_e = slot_free & ~picked
+                    dist = np.where(cand_e, dist, f32(1e30))
+                    e_star = np.argmin(dist, axis=-1)  # first min wins
+                    take = cand_e.any(axis=-1) & ~covered
+                    oh = ((es[None, None, :] == e_star[..., None])
+                          & take[..., None])
+                    picked = picked | oh
+                    prank = np.where(oh, np.int32(t), prank)
+                    freed = freed + (ev_res_f[None, :, :, :]
+                                     * oh[..., None]).sum(axis=2,
+                                                          dtype=f32)
+                key = np.where(
+                    picked,
+                    (np.int32(32768) - ev_prio_i[None, :, :])
+                    * np.int32(EVW + 1) + prank,
+                    np.int32(2 ** 30))
+                seq = np.argsort(key, axis=-1, kind="stable")
+                for t in range(EVW):
+                    e_t = seq[..., t]
+                    oh = es[None, None, :] == e_t[..., None]
+                    is_p = (picked & oh).any(axis=-1)
+                    vec = (ev_res_f[None, :, :, :]
+                           * oh[..., None]).sum(axis=2, dtype=f32)
+                    trial = freed - vec
+                    still = ((base_short - trial) <= 0.0).all(axis=-1)
+                    drop = is_p & still
+                    picked = picked & ~(oh & drop[..., None])
+                    freed = np.where(drop[..., None], trial, freed)
+
+                covered_f = ((base_short - freed) <= 0.0).all(axis=-1)
+                dev_fit_ev = (dev_used[None, :, :] + dev_ask[:, None, :]
+                              <= dev_cap[None, :, :]).all(axis=-1)
+                ok_node = (covered_f & picked.any(axis=-1) & feas
+                           & dev_fit_ev & want_g[:, None])
+                after = (used[None, :, :] + ask_res[:, None, :]
+                         - freed)
+                denom_cpu = avail[None, :, R_CPU]
+                denom_mem = avail[None, :, R_MEM]
+                util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
+                util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
+                ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+                free_cpu = f32(1.0) - util_cpu / np.maximum(denom_cpu,
+                                                            f32(1.0))
+                free_mem = f32(1.0) - util_mem / np.maximum(denom_mem,
+                                                            f32(1.0))
+                raw = f32(20.0) - (f32(10.0) ** free_cpu
+                                   + f32(10.0) ** free_mem)
+                binpack = np.where(ok_denoms,
+                                   np.clip(raw, f32(0.0), f32(18.0))
+                                   / f32(18.0), f32(0.0))
+                ev_score = np.where(ok_node, binpack, f32(NEG_INF))
+                wv_s, wv_i = _top_k(ev_score, 1)
+                win_s, win_i = wv_s[:, 0], wv_i[:, 0].astype(np.int32)
+                sel_freed = freed[gs, win_i]
+                sel_mask = picked[gs, win_i]
+            ev_any_g = win_s > NEG_INF / 2
+
+            e_cand = win_i[g_idx].astype(np.int64)
+            p_ok = want & ev_any_g[g_idx]
+            # first member per node wins (prior_rank_any == 0 twin)
+            seen_nodes: set = set()
+            for p in range(K):
+                if not p_ok[p]:
+                    continue
+                n = int(e_cand[p])
+                if n not in seen_nodes:
+                    ev_commit[p] = True
+                    seen_nodes.add(n)
+            ecm = ev_commit[:, None]
+            np.add.at(used, e_cand,
+                      (ask_res[g_idx] - sel_freed[g_idx]) * ecm)
+            np.add.at(dev_used, e_cand, dev_ask[g_idx] * ecm)
+            em = sel_mask[g_idx] & ecm
+            np.logical_or.at(EVT, e_cand, em)
+            if has_spread:
+                evals_ = attr_rank[e_cand[:, None],
+                                   np.maximum(sp_col[g_idx], 0)]
+                ok_es = ((sp_col[g_idx] >= 0) & (evals_ >= 0)
+                         & (evals_ < V) & ecm)
+                np.add.at(sp_used,
+                          (g_idx[:, None], np.arange(S)[None, :],
+                           np.clip(evals_, 0, V - 1)),
+                          ok_es.astype(f32))
+            fail_now = fail_now & ~ev_any_g[g_idx]
+
         offs = cr[:, None] + np.arange(TOP_K)[None, :]
         pk_idx = top_idx[g_idx[:, None], offs]
         pk_score = top_score[g_idx[:, None], offs]
         pk_ok = pk_score > NEG_INF / 2
-        newly = commit | fail_now
+        ok_row = pk_ok & cm
+        if has_preempt:
+            ecol = np.arange(TOP_K)[None, :] == 0
+            pk_idx = np.where(ecm, np.where(ecol, e_cand[:, None], 0),
+                              pk_idx).astype(np.int32)
+            pk_score = np.where(
+                ecm, np.where(ecol, win_s[g_idx][:, None], f32(NEG_INF)),
+                pk_score)
+            ok_row = np.where(ecm, ecol, ok_row)
+        newly = commit | ev_commit | fail_now
         upd = newly[:, None]
         out_idx = np.where(upd, pk_idx, out_idx)
         out_score = np.where(upd, pk_score, out_score)
-        out_ok = np.where(upd, pk_ok & cm, out_ok)
+        out_ok = np.where(upd, ok_row, out_ok)
+        if has_preempt:
+            out_evict = np.where(upd, em & ecm, out_evict)
+        out_wave = np.where(commit | ev_commit, wave, out_wave)
         out_nfeas = np.where(newly, n_feas_g[g_idx], out_nfeas)
         out_nexh = np.where(newly, n_exh_g[g_idx], out_nexh)
         out_dimexh = np.where(newly[:, None], dim_exh_g[g_idx],
@@ -496,7 +637,9 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         dim_exhausted=out_dimexh, feas=feas,
         cons_filtered=cons_filtered, used_final=used,
         dev_used_final=dev_used, n_waves=np.int32(wave),
-        unfinished=unfinished, n_rescore=np.int32(wave))
+        unfinished=unfinished, n_rescore=np.int32(wave),
+        evict=out_evict,
+        commit_wave=(out_wave if has_preempt else None))
 
 
 class HostResidentSolver:

@@ -53,6 +53,10 @@ TOP_K = 4
 WAVE_K = 32       # min per-group wave width; scales up with batch size
 MAX_WAVES = 12    # static wave budget per solve (see scan note below)
 NEG_INF = -1e30
+# victim eligibility gate: ask priority must exceed the victim's by at
+# least this (scheduler/preemption.PRIORITY_DELTA — duplicated here so
+# the device module stays import-light; pinned equal by a test)
+EV_PRIORITY_DELTA = 10
 # test hook: force the sort-based conflict path at small K (read at
 # trace time; tests clear jit caches after flipping it)
 _FORCE_SORT_CONFLICTS = False
@@ -155,6 +159,15 @@ class SolveResult(NamedTuple):
     n_rescore: jnp.ndarray = None  # [] waves that ran the full-N pass
     #  (shortlist-resident waves make up n_waves - n_rescore; None when
     #   a kernel predates / sidesteps the shortlist path)
+    evict: jnp.ndarray = None  # [K, E] bool victim-slot mask for
+    #  placements committed by the in-kernel preemption pass (ISSUE 7);
+    #  slots index the node's ev planes. None when has_preempt is off.
+    commit_wave: jnp.ndarray = None  # [K] i32 wave each placement
+    #  committed on (-1 = failed/unfinished). Only populated with
+    #  has_preempt: evictions make usage non-monotone, so the host
+    #  fixup must replay commits in WAVE order — an ask-order replay
+    #  can transiently exceed avail on a node whose eviction (by a
+    #  later-p placement) the kernel sequenced earlier.
 
 
 # ------------------------------------------------------- shortlist
@@ -245,7 +258,7 @@ def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
                                     "has_distinct", "has_devices",
                                     "stack_commit", "pallas_mode",
                                     "shortlist_c", "mesh_axis",
-                                    "mesh_shards"))
+                                    "mesh_shards", "has_preempt"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -257,7 +270,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  wave_mode="scan", has_distinct=True,
                  has_devices=True, stack_commit=False,
                  pallas_mode="off", shortlist_c=0,
-                 mesh_axis=None, mesh_shards=0) -> SolveResult:
+                 mesh_axis=None, mesh_shards=0,
+                 has_preempt=False, ev_res=None, ev_prio=None,
+                 ask_prio=None) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -319,6 +334,34 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     NE = C if use_sl else TKl       # full-wave extraction width
     ks = jnp.arange(K)
     gs = jnp.arange(Gp)
+
+    # ---------- in-kernel preemption planes (ISSUE 7) ----------
+    # Extra wave passes score the top-E evictable allocs per node as
+    # negative-capacity deltas: a group with NOTHING placeable selects,
+    # per feasible node, the min-cost victim set (a float-order-exact
+    # twin of scheduler/preemption.victim_distance), ranks nodes by the
+    # post-eviction bin-pack score, and commits (place, evict) pairs
+    # through the same conflict/commit machinery as normal placements.
+    if has_preempt:
+        if has_distinct:
+            raise ValueError(
+                "has_preempt does not compose with distinct_hosts "
+                "batches (cross-group blocking is invisible to the "
+                "eviction pass); callers fall back to host preemption")
+        assert ev_res is not None and ev_prio is not None \
+            and ask_prio is not None, \
+            "has_preempt needs ev_res/ev_prio/ask_prio planes"
+        EV = ev_prio.shape[1]
+        ev_prio_i = ev_prio.astype(jnp.int32)
+        ev_res_f = ev_res.astype(jnp.float32)
+        ask_prio_i = ask_prio.astype(jnp.int32)
+        # wave-invariant slot eligibility: real slot, priority at least
+        # EV_PRIORITY_DELTA below the ask's (preemptible_allocs gate)
+        ev_slot_ok = ((ev_prio_i[None, :, :] >= 0)
+                      & (ask_prio_i[:, None, None] - ev_prio_i[None, :, :]
+                         >= EV_PRIORITY_DELTA))       # [Gp, Np, E]
+    else:
+        EV = 1
 
     # ---------- static feasibility [Gp, Np] ----------
     def per_ask_feas(g):
@@ -720,7 +763,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     def body(st):
         (used, dev_used, sp_used, done,
          out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
-         wave, n_resc, SL) = st
+         wave, n_resc, SL, EVT, out_evict, out_wave) = st
         active = ~done & (ks < n_place)
         g_idx = p_ask
         used_pre, dev_used_pre = used, dev_used
@@ -1043,16 +1086,22 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             def prior_sum_node(vals):
                 return same_node.astype(jnp.float32) @ vals
 
-            def prior_rank(key, member):
-                m = member & cand_ok
+            def prior_rank_any(key, m):
+                # exclusive count of earlier members with equal key,
+                # under an arbitrary membership mask (the preemption
+                # pass ranks candidates whose cand_ok is False)
                 same = ((key[None, :] == key[:, None])
                         & m[None, :] & m[:, None] & earlier)
                 return same.sum(axis=1)
+
+            def prior_rank(key, member):
+                return prior_rank_any(key, member & cand_ok)
         else:
-            def _seg(key):
-                """Sort (key, idx); return per-element exclusive segment
-                rank and a segmented exclusive-prefix summer."""
-                keyc = jnp.where(cand_ok, key, jnp.int32(0x7FFFFFF0))
+            def _seg(key, ok):
+                """Sort (key, idx) over `ok` members; return per-element
+                exclusive segment rank and a segmented exclusive-prefix
+                summer."""
+                keyc = jnp.where(ok, key, jnp.int32(0x7FFFFFF0))
                 s_key, s_ix = lax.sort((keyc, ks), num_keys=2)
                 pos = ks
                 is_start = jnp.concatenate(
@@ -1069,13 +1118,17 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                     (pos - start_pos).astype(jnp.int32))
                 return rank, summer
 
-            _, prior_sum_node = _seg(cand)
+            _, prior_sum_node = _seg(cand, cand_ok)
+
+            def prior_rank_any(key, m):
+                rank, _ = _seg(key, m)
+                return jnp.where(m, rank, 0)
 
             def prior_rank(key, member):
                 # exclusive count of earlier ok members with equal key;
                 # non-members get a key outside every real segment
                 keyc = jnp.where(member, key, jnp.int32(0x3FFFFFF0))
-                rank, _ = _seg(keyc)
+                rank, _ = _seg(keyc, cand_ok)
                 return jnp.where(member, rank, 0)
 
         res_k = ask_res[g_idx] * cand_ok[:, None]
@@ -1208,17 +1261,218 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                                  jnp.maximum(svals, 0)].add(
                 okslot.astype(jnp.float32))
 
+        # ---------------- preemption wave pass (ISSUE 7) ----------------
+        # Runs AFTER the normal commits against post-commit usage, only
+        # for groups with nothing placeable this wave.  Greedy min-cost
+        # victim selection per (group, node) over the top-E evictable
+        # planes — the float-order-exact twin of
+        # scheduler/preemption.victim_distance — then node choice by
+        # post-eviction bin-pack score (the reference feeds preemption
+        # options through the regular rank/max pipeline).  In mesh mode
+        # the heavy work is shard-local; only per-group best eviction
+        # KEYS (score, global node id) ride the candidate-key ICI
+        # exchange, exactly like the placement windows.
+        if has_preempt:
+            want = active & ~commit & ~grp_any[g_idx]
+            want_g = (jnp.zeros(Gp, jnp.int32).at[g_idx]
+                      .add(want.astype(jnp.int32)) > 0)
+
+            def do_evict(args):
+                used_x, dev_used_x, evt = args
+                f32 = jnp.float32
+                es = jnp.arange(EV)
+                # shortfall base: usage + ask - capacity, per (g, n)
+                base_short = (used_x[None, :, :] + ask_res[:, None, :]
+                              - avail[None, :, :])     # [Gp, Np, R]
+                slot_free = ev_slot_ok & ~evt[None, :, :]
+                freed = jnp.zeros((Gp, Np, R), f32)
+                picked = jnp.zeros((Gp, Np, EV), bool)
+                prank = jnp.full((Gp, Np, EV), EV, jnp.int32)
+                for t in range(EV):
+                    s = jnp.maximum(base_short - freed, 0.0)
+                    covered = (s <= 0.0).all(axis=-1)
+                    norm = jnp.maximum(s, 1.0)
+                    diff = ((s[:, :, None, :] - ev_res_f[None, :, :, :])
+                            / norm[:, :, None, :])     # [Gp, Np, E, R]
+                    d2 = diff * diff
+                    # explicit association — part of the host-twin
+                    # float-order contract (victim_distance)
+                    dist = jnp.sqrt(((d2[..., 0] + d2[..., 1])
+                                     + d2[..., 2]) + d2[..., 3])
+                    cand_e = slot_free & ~picked
+                    dist = jnp.where(cand_e, dist, f32(1e30))
+                    e_star = jnp.argmin(dist, axis=-1)  # first min wins
+                    take = cand_e.any(axis=-1) & ~covered
+                    oh = ((es[None, None, :] == e_star[..., None])
+                          & take[..., None])
+                    picked = picked | oh
+                    prank = jnp.where(oh, jnp.int32(t), prank)
+                    freed = freed + (ev_res_f[None, :, :, :]
+                                     * oh[..., None]).sum(axis=2)
+                # redundancy prune (preemption.prune_superset order:
+                # highest-priority victims first, pick order on ties)
+                key = jnp.where(
+                    picked,
+                    (jnp.int32(32768) - ev_prio_i[None, :, :])
+                    * jnp.int32(EV + 1) + prank,
+                    jnp.int32(2 ** 30))
+                seq = jnp.argsort(key, axis=-1)
+                for t in range(EV):
+                    e_t = seq[..., t]
+                    oh = es[None, None, :] == e_t[..., None]
+                    is_p = (picked & oh).any(axis=-1)
+                    vec = (ev_res_f[None, :, :, :]
+                           * oh[..., None]).sum(axis=2)
+                    trial = freed - vec
+                    still = ((base_short - trial) <= 0.0).all(axis=-1)
+                    drop = is_p & still
+                    picked = picked & ~(oh & drop[..., None])
+                    freed = jnp.where(drop[..., None], trial, freed)
+
+                covered_f = ((base_short - freed) <= 0.0).all(axis=-1)
+                if has_devices:
+                    # device instances are never evicted in-kernel: the
+                    # node must fit the device ask as-is (device-dim
+                    # shortfalls keep the host preemption fallback)
+                    dev_fit_ev = (dev_used_x[None, :, :]
+                                  + dev_ask[:, None, :]
+                                  <= dev_cap[None, :, :]).all(axis=-1)
+                else:
+                    dev_fit_ev = jnp.ones((Gp, Np), bool)
+                ok_node = (covered_f & picked.any(axis=-1) & feas
+                           & dev_fit_ev & want_g[:, None])
+                after = (used_x[None, :, :] + ask_res[:, None, :]
+                         - freed)
+                denom_cpu = avail[None, :, R_CPU]
+                denom_mem = avail[None, :, R_MEM]
+                util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
+                util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
+                ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+                free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
+                free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
+                raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
+                binpack = jnp.where(ok_denoms,
+                                    jnp.clip(raw, 0.0, 18.0) / 18.0,
+                                    0.0)
+                ev_score = jnp.where(ok_node, binpack, f32(NEG_INF))
+                ids = jnp.arange(Np, dtype=jnp.int32)
+                if in_mesh:
+                    ids = ids + off
+                ids2 = jnp.broadcast_to(ids[None, :], (Gp, Np))
+                nv_s2, nv_i2 = _lex_topk(ev_score, ids2, 1)
+                nv_s_l, nv_i_l = nv_s2[:, 0], nv_i2[:, 0]
+                # freed/picked at the LOCAL best node: the cross-shard
+                # winner is always some shard's local best, so the
+                # owner already holds its victim set
+                loc_best = (jnp.clip(nv_i_l - off, 0, Np - 1) if in_mesh
+                            else nv_i_l)
+                sel_freed = freed[gs, loc_best]             # [Gp, R]
+                sel_mask = picked[gs, loc_best]             # [Gp, EV]
+                return nv_s_l, nv_i_l, sel_freed, sel_mask
+
+            def skip_evict(args):
+                return (jnp.full(Gp, NEG_INF, jnp.float32),
+                        jnp.zeros(Gp, jnp.int32),
+                        jnp.zeros((Gp, R), jnp.float32),
+                        jnp.zeros((Gp, EV), bool))
+
+            # `want` derives from replicated values, so the predicate
+            # is mesh-uniform and both branches stay collective-free —
+            # the key exchange below runs unconditionally
+            nv_s, nv_i, sel_freed, sel_mask = lax.cond(
+                want.any(), do_evict, skip_evict,
+                (used, dev_used, EVT))
+
+            if in_mesh:
+                g_s = lax.all_gather(nv_s[:, None], mesh_axis, axis=1,
+                                     tiled=True)   # [Gp, shards]
+                g_i = lax.all_gather(nv_i[:, None], mesh_axis, axis=1,
+                                     tiled=True)
+                wv_s2, wv_i2 = _lex_topk(g_s, g_i, 1)
+                win_s, win_i = wv_s2[:, 0], wv_i2[:, 0]
+            else:
+                win_s, win_i = nv_s, nv_i
+            ev_any_g = win_s > NEG_INF / 2                  # [Gp]
+
+            e_cand = win_i[g_idx]                           # [K] global
+            p_ok = want & ev_any_g[g_idx]
+            # one preemption commit per node per wave (across groups):
+            # two victim sets computed independently must never both
+            # apply to one node
+            ev_commit = p_ok & (prior_rank_any(e_cand, p_ok) == 0)
+            ecm = ev_commit[:, None]
+            if in_mesh:
+                e_loc = e_cand - off
+                e_inb = (e_loc >= 0) & (e_loc < Np)
+                e_loc = jnp.where(e_inb, e_loc, Np)
+                e_locc = jnp.clip(e_loc, 0, Np - 1)
+            else:
+                e_loc = e_locc = e_cand
+                e_inb = jnp.ones(K, bool)
+            own = (e_inb & ev_commit)[:, None]
+            # victims leave, the new placement lands — one scatter
+            used = used.at[e_loc].add(
+                (ask_res[g_idx] - sel_freed[g_idx]) * ecm, mode="drop")
+            if has_devices:
+                dev_used = dev_used.at[e_loc].add(
+                    dev_ask[g_idx] * ecm, mode="drop")
+            em_local = sel_mask[g_idx] & own                # [K, EV]
+            EVT = EVT | (jnp.zeros((Np, EV), jnp.int32).at[e_loc].add(
+                em_local.astype(jnp.int32), mode="drop") > 0)
+            if in_mesh:
+                em_rep = lax.psum(em_local.astype(jnp.int32),
+                                  mesh_axis) > 0
+            else:
+                em_rep = em_local
+            if has_spread:
+                if in_mesh:
+                    ar_ev = lax.psum(
+                        jnp.where(own,
+                                  attr_rank[e_locc].astype(jnp.int32),
+                                  0), mesh_axis)
+                    evals_ = jnp.take_along_axis(
+                        ar_ev, jnp.maximum(sp_col[g_idx], 0), axis=1)
+                else:
+                    evals_ = attr_rank[e_cand[:, None],
+                                       jnp.maximum(sp_col[g_idx], 0)]
+                ok_es = (sp_col[g_idx] >= 0) & (evals_ >= 0) & ecm
+                sp_used = sp_used.at[g_idx[:, None],
+                                     jnp.arange(S)[None, :],
+                                     jnp.maximum(evals_, 0)].add(
+                    ok_es.astype(jnp.float32))
+            # a group with no placeable node AND no eviction option
+            # fails; one with an eviction option keeps retrying
+            fail_now = fail_now & ~ev_any_g[g_idx]
+        else:
+            ev_commit = jnp.zeros(K, bool)
+
         # -- record results: a committed placement's fall-through top-K is
         # its group's candidate list starting at its own rank --
         offs = cr[:, None] + jnp.arange(TOP_K)[None, :]    # < TK by constr.
         pk_idx = top_idx[g_idx[:, None], offs]
         pk_score = top_score[g_idx[:, None], offs]
         pk_ok = pk_score > NEG_INF / 2
-        newly = commit | fail_now
+        ok_row = pk_ok & cm
+        if has_preempt:
+            # an eviction-committed placement records its single chosen
+            # node in slot 0 (no fall-through candidates — the victim
+            # set is node-specific) with the post-eviction bin-pack
+            # score; the evict mask rides in out_evict
+            ecol = jnp.arange(TOP_K)[None, :] == 0
+            pk_idx = jnp.where(ecm, jnp.where(ecol, e_cand[:, None], 0),
+                               pk_idx)
+            pk_score = jnp.where(
+                ecm, jnp.where(ecol, win_s[g_idx][:, None], NEG_INF),
+                pk_score)
+            ok_row = jnp.where(ecm, ecol, ok_row)
+        newly = commit | ev_commit | fail_now
         upd = newly[:, None]
         out_idx = jnp.where(upd, pk_idx, out_idx)
         out_score = jnp.where(upd, pk_score, out_score)
-        out_ok = jnp.where(upd, pk_ok & cm, out_ok)
+        out_ok = jnp.where(upd, ok_row, out_ok)
+        if has_preempt:
+            out_evict = jnp.where(upd, em_rep & ecm, out_evict)
+        out_wave = jnp.where(commit | ev_commit, wave, out_wave)
         out_nfeas = jnp.where(newly, n_feas_out[g_idx], out_nfeas)
         out_nexh = jnp.where(newly, n_exh_out[g_idx], out_nexh)
         out_dimexh = jnp.where(newly[:, None], dim_exh_out[g_idx],
@@ -1271,6 +1525,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 sp_gate = sp_gate | (sp_col[:, 0] >= 0)
             ok_pre_g = SL.comp | (tr1_g & ~sp_gate)
             pre_ok = any_next & (ok_pre_g | ~act_next_g).all()
+            if has_preempt:
+                # an eviction REDUCES usage, breaking the monotone-
+                # usage argument behind the `comp` bypass and freezing
+                # guarantees wholesale: any evict commit this wave
+                # forces the next wave back to a full-N rescore (which
+                # rebuilds the shortlist and its era state)
+                pre_ok = pre_ok & ~ev_commit.any()
 
             # own-group commit counts fold into the carried coll (the
             # window's shortlist positions resolve by bisection; a
@@ -1356,7 +1617,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
         return (used, dev_used, sp_used, done,
                 out_idx, out_ok, out_score, out_nfeas, out_nexh, out_dimexh,
-                wave + jnp.int32(1), n_resc, SL)
+                wave + jnp.int32(1), n_resc, SL, EVT, out_evict,
+                out_wave)
 
     # Two loop shapes, chosen statically by the caller:
     #
@@ -1385,7 +1647,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
            jnp.zeros(K, jnp.int32),
            jnp.zeros(K, jnp.int32),
            jnp.zeros((K, R), jnp.int32),
-           jnp.int32(0), jnp.int32(0), sl0)
+           jnp.int32(0), jnp.int32(0), sl0,
+           (jnp.zeros((Np, EV), bool) if has_preempt
+            else jnp.zeros((1, 1), bool)),
+           (jnp.zeros((K, EV), bool) if has_preempt
+            else jnp.zeros((K, 1), bool)),
+           jnp.full(K, -1, jnp.int32))
     if wave_mode == "while":
         def w_cond(st):
             return ((~st[3] & (ks < n_place)).any()
@@ -1399,7 +1666,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
         (st_final, _) = lax.scan(body_scan, st0, None, length=max_waves)
     (used_final, dev_used_final, _, done, out_idx, out_ok, out_score,
-     out_nfeas, out_nexh, out_dimexh, waves, n_resc, _) = st_final
+     out_nfeas, out_nexh, out_dimexh, waves, n_resc, _,
+     _, out_evict_f, out_wave_f) = st_final
     unfinished = ~done & (ks < n_place)
     if in_mesh:
         # per-shard full-pass count summed over the mesh: the HBM byte
@@ -1414,4 +1682,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                        dev_used_final=dev_used_final, n_waves=waves,
                        unfinished=unfinished,
                        n_rescore=(n_resc if (use_sl or in_mesh)
-                                  else waves))
+                                  else waves),
+                       evict=(out_evict_f if has_preempt else None),
+                       commit_wave=(out_wave_f if has_preempt
+                                    else None))

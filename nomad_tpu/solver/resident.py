@@ -190,7 +190,7 @@ _ASK_ARGS = ("ask_res", "ask_desired", "distinct", "dc_ok", "host_ok",
              "coll0", "penalty", "c_op", "c_col", "c_rank", "a_op", "a_col",
              "a_rank", "a_weight", "a_host", "sp_col", "sp_weight",
              "sp_targeted", "sp_desired", "sp_implicit", "sp_used0",
-             "dev_ask", "p_ask")
+             "dev_ask", "p_ask", "ask_prio")
 
 
 def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
@@ -198,7 +198,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                group_count_hint=0, max_waves=0, wave_mode="scan",
                has_distinct=True, has_devices=True, stack_commit=False,
                pallas_mode="off", shortlist_c=0, mesh_axis=None,
-               mesh_shards=0):
+               mesh_shards=0, has_preempt=False, ev_res=None,
+               ev_prio=None):
     # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
     # lanes, 1/8th the transport bytes of the dense bool planes);
     # unpack on device — dtype is static, so either form compiles once
@@ -210,6 +211,13 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     penalty = batch["penalty"]
     if penalty.dtype == jnp.uint32:
         penalty = unpack_bool_u32(penalty, Np)
+    ev_kw = {}
+    if has_preempt:
+        # the stream caller gated distinct batches off already (the
+        # eviction pass statically refuses distinct_hosts batches)
+        has_distinct = False
+        ev_kw = dict(has_preempt=True, ev_res=ev_res, ev_prio=ev_prio,
+                     ask_prio=batch["ask_prio"])
     return solve_kernel(
         avail, reserved, used, valid, node_dc, attr_rank,
         batch["ask_res"], batch["ask_desired"], batch["distinct"],
@@ -225,7 +233,7 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         has_distinct=has_distinct, has_devices=has_devices,
         stack_commit=stack_commit, pallas_mode=pallas_mode,
         shortlist_c=shortlist_c, mesh_axis=mesh_axis,
-        mesh_shards=mesh_shards)
+        mesh_shards=mesh_shards, **ev_kw)
 
 
 @functools.partial(jax.jit,
@@ -311,18 +319,23 @@ def _parallel_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                                     "max_waves", "wave_mode",
                                     "has_distinct", "has_devices",
                                     "stack_commit", "compact",
-                                    "pallas_mode", "shortlist_c"))
+                                    "pallas_mode", "shortlist_c",
+                                    "has_preempt"))
 def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                    used0, dev_used0, stacked, n_places, seeds,
+                   ev_res=None, ev_prio=None,
                    has_spread=True, group_count_hint=0, max_waves=0,
                    wave_mode="scan", has_distinct=True,
                    has_devices=True, stack_commit=False, compact=True,
-                   pallas_mode="off", shortlist_c=0):
+                   pallas_mode="off", shortlist_c=0,
+                   has_preempt=False):
     """lax.scan solve_kernel over a leading batch axis of ask tensors,
     threading resource usage from batch to batch on device.  Also
     returns the per-batch wave and full-rescore counts [B] — the
     instrumentation the two-tier HBM byte model multiplies against
-    (bytes_wave1 x rescore + bytes_rewave x shortlist waves)."""
+    (bytes_wave1 x rescore + bytes_rewave x shortlist waves) — and the
+    per-batch [K, E] eviction-slot masks of the in-kernel preemption
+    pass (zeros [K, 1] when has_preempt is off)."""
 
     def step(carry, xs):
         used, dev_used = carry
@@ -331,7 +344,9 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                          dev_cap, used, dev_used, batch, n_place, seed,
                          has_spread, group_count_hint, max_waves,
                          wave_mode, has_distinct, has_devices,
-                         stack_commit, pallas_mode, shortlist_c)
+                         stack_commit, pallas_mode, shortlist_c,
+                         has_preempt=has_preempt, ev_res=ev_res,
+                         ev_prio=ev_prio)
         status = jnp.where(res.choice_ok[:, 0], STATUS_COMMITTED,
                            jnp.where(res.unfinished, STATUS_RETRY,
                                      STATUS_FAILED))
@@ -341,12 +356,14 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
             packed = jnp.concatenate(
                 [res.choice.astype(jnp.float32), res.score,
                  status.astype(jnp.float32)[:, None]], axis=-1)
+        evict = (res.evict if has_preempt
+                 else jnp.zeros((res.choice.shape[0], 1), bool))
         return ((res.used_final, res.dev_used_final),
-                (packed, res.n_waves, res.n_rescore))
+                (packed, evict, res.n_waves, res.n_rescore))
 
-    (used_f, dev_used_f), (out, waves, rescores) = jax.lax.scan(
+    (used_f, dev_used_f), (out, evict, waves, rescores) = jax.lax.scan(
         step, (used0, dev_used0), (stacked, n_places, seeds))
-    return used_f, dev_used_f, out, waves, rescores
+    return used_f, dev_used_f, out, evict, waves, rescores
 
 
 class ResidentSolver:
@@ -367,9 +384,20 @@ class ResidentSolver:
                  max_waves: int = 0, wave_mode: str = "scan",
                  stack_commit: bool = False, pallas: str = "auto",
                  delta_threshold: Optional[float] = None,
-                 shortlist_c: Optional[int] = None):
+                 shortlist_c: Optional[int] = None,
+                 evict_e: int = 0):
         import os
         self.nodes = list(nodes)
+        #: in-kernel preemption (ISSUE 7): > 0 packs top-E evictable-
+        #: alloc planes from `allocs_by_node` and runs the eviction
+        #: wave pass for groups with nothing placeable.  Stream-mode
+        #: contract: the caller must feed each batch's evictions back
+        #: as stop deltas (solve_stream_pipelined deltas=) before the
+        #: next batch — usage carries on device, but the candidate
+        #: planes only advance through apply_delta.  0 = off (default
+        #: for the raw stream engine; the worker Solver enables it via
+        #: tensorize.evict_width()).
+        self.evict_e = int(evict_e)
         self.max_waves = max_waves        # 0 = kernel default
         self.wave_mode = wave_mode        # see kernel.py loop-shape note
         self.stack_commit = stack_commit  # serial-fidelity commits
@@ -417,9 +445,14 @@ class ResidentSolver:
         #: batch count — the serving tier's EWMA solve-time model feeds
         #: from this (server/serving.py EwmaSolveModel.observe)
         self.last_solve_stats = None
+        #: [B, K, E] eviction-slot masks of the last dispatched stream
+        #: (device array; list when pipelined) — None until a preempt-
+        #: enabled stream ran
+        self.last_evict = None
         self._probe_asks = list(probe_asks)
         self._tz = Tensorizer()
-        self.template = self._tz.pack(nodes, probe_asks, allocs_by_node)
+        self.template = self._tz.pack(nodes, probe_asks, allocs_by_node,
+                                      evict_e=self.evict_e)
         self.node_index = {n.id: i for i, n in enumerate(self.nodes)}
         self.gp = gp or self.template.ask_res.shape[0]
         self.kp = kp or self.template.p_ask.shape[0]
@@ -469,6 +502,12 @@ class ResidentSolver:
             "attr_rank": self._put_node("attr_rank", t.attr_rank),
             "dev_cap": self._put_node("dev_cap", t.dev_cap),
         }
+        if t.ev_prio is not None:
+            # evictable-alloc planes live in HBM next to the other
+            # node-axis planes (delta-maintained through apply_delta)
+            self._dev_node["ev_prio"] = self._put_node("ev_prio",
+                                                       t.ev_prio)
+            self._dev_node["ev_res"] = self._put_node("ev_res", t.ev_res)
         self._used = self._put_node("used", t.used0)
         self._dev_used = self._put_node("dev_used", t.dev_used0)
         # compact int16 result payload needs int16-expressible node ids
@@ -479,7 +518,9 @@ class ResidentSolver:
         self.delta_counters["bytes_dispatched_full"] += int(
             t.avail.nbytes + t.reserved.nbytes + t.valid.nbytes
             + t.node_dc.nbytes + t.attr_rank.nbytes + t.dev_cap.nbytes
-            + t.used0.nbytes + t.dev_used0.nbytes)
+            + t.used0.nbytes + t.dev_used0.nbytes
+            + (t.ev_prio.nbytes + t.ev_res.nbytes
+               if t.ev_prio is not None else 0))
 
     def _delta_set(self, arr, idx, rows):
         """Row-scatter 'set' into resident node state (subclass hook:
@@ -577,6 +618,24 @@ class ResidentSolver:
             self._used = self._delta_add(self._used, u_idx, u_res)
             self._dev_used = self._delta_add(self._dev_used, u_idx,
                                              u_dev)
+        if self.template.ev_lists is not None:
+            # eviction-plane rows the host apply just recomputed
+            # (_apply_evict_delta) scatter like every other node plane
+            ev_slots = sorted({s for s, _ in nd.alloc_place}
+                              | {s for s, _ in nd.alloc_stop})
+            ev_slots = [s for s in ev_slots
+                        if s < self.template.ev_prio.shape[0]]
+            if ev_slots:
+                t = self.template
+                e_idx, (e_prio, e_res) = _pad(
+                    np.asarray(ev_slots, np.int32),
+                    [t.ev_prio[ev_slots], t.ev_res[ev_slots]],
+                    repeat_first=True)
+                dn = self._dev_node
+                dn["ev_prio"] = self._delta_set(dn["ev_prio"], e_idx,
+                                                e_prio)
+                dn["ev_res"] = self._delta_set(dn["ev_res"], e_idx,
+                                               e_res)
         self.delta_counters["delta_applies"] += 1
         self.delta_counters["bytes_dispatched_delta"] += nd.nbytes()
         return "delta"
@@ -613,11 +672,26 @@ class ResidentSolver:
                 if n.id not in seen and n.id not in removed:
                     new_nodes.append(n)
                     seen.add(n.id)
+        old_ev_lists = (None if self.template.ev_lists is None else
+                        {nid: self.template.ev_lists[i]
+                         for i, nid in enumerate(old_ids)
+                         if i < len(self.template.ev_lists)})
         self.nodes = new_nodes
-        self.template = self._tz.pack(self.nodes, self._probe_asks)
+        self.template = self._tz.pack(self.nodes, self._probe_asks,
+                                      evict_e=self.evict_e)
         self.node_index = {n.id: i for i, n in enumerate(self.nodes)}
         # carry usage across by node id (slots moved in the compaction)
         t = self.template
+        if t.ev_lists is not None and old_ev_lists is not None:
+            # eviction candidates carry by node id too
+            from .tensorize import _evict_row
+            E = t.ev_prio.shape[1]
+            for j, nid in enumerate(t.node_ids):
+                cands = old_ev_lists.get(nid)
+                if cands:
+                    t.ev_lists[j] = list(cands)
+                    t.ev_prio[j], t.ev_res[j], t.ev_ids[j] = _evict_row(
+                        cands, E)
         for i, nid in enumerate(old_ids):
             j = self.node_index.get(nid)
             if j is not None:
@@ -632,6 +706,14 @@ class ResidentSolver:
                 j = self.node_index.get(nid)
                 if j is not None:
                     t.used0[j] -= alloc_usage_vector(alloc)
+            if t.ev_lists is not None:
+                from .tensorize import apply_evict_ops
+                slot_ops = lambda grp: [  # noqa: E731
+                    (j, a) for nid, a in grp
+                    for j in (self.node_index.get(nid),)
+                    if j is not None]
+                apply_evict_ops(t, slot_ops(delta.stop),
+                                slot_ops(delta.place))
         self._node_epoch += 1
         self._row_cache.clear()
         self._drv_cache.clear()
@@ -747,20 +829,31 @@ class ResidentSolver:
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
                     else np.asarray(list(seeds), np.int32))
-        (self._used, self._dev_used, out, self.last_waves,
-         self.last_rescore_waves) = _stream_kernel(
+        has_distinct = self._has_distinct(batches)
+        preempt = self._preempt_on(has_distinct)
+        (self._used, self._dev_used, out, self.last_evict,
+         self.last_waves, self.last_rescore_waves) = _stream_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
             self._dev_node["valid"], self._dev_node["node_dc"],
             self._dev_node["attr_rank"], self._dev_node["dev_cap"],
             self._used, self._dev_used, stacked, n_places, seed_arr,
+            ev_res=self._dev_node.get("ev_res"),
+            ev_prio=self._dev_node.get("ev_prio"),
             has_spread=self._has_spread(batches),
             group_count_hint=self._group_count_hint(batches),
             max_waves=self.max_waves, wave_mode=self.wave_mode,
-            has_distinct=self._has_distinct(batches),
+            has_distinct=has_distinct,
             has_devices=self._has_devices(batches),
             stack_commit=self.stack_commit, compact=self._compact,
-            pallas_mode=self.pallas, shortlist_c=self.shortlist_c)
+            pallas_mode=self.pallas, shortlist_c=self.shortlist_c,
+            has_preempt=preempt)
         return out
+
+    def _preempt_on(self, has_distinct: bool) -> bool:
+        """Eviction waves run only when the planes are resident and
+        the stream has no distinct_hosts groups (the pass statically
+        refuses them — those batches keep the host-side walk)."""
+        return ("ev_prio" in self._dev_node) and not has_distinct
 
     def finish_stream(self, out) -> Tuple[np.ndarray, np.ndarray,
                                           np.ndarray, np.ndarray]:
@@ -797,7 +890,7 @@ class ResidentSolver:
         chunks = list(chunks)
         if not chunks:
             raise ValueError("solve_stream_pipelined needs >= 1 chunk")
-        outs, waves, rescores = [], [], []
+        outs, waves, rescores, evicts = [], [], [], []
         pack_s = dispatch_s = delta_s = 0.0
         bytes_shipped = 0
 
@@ -825,6 +918,7 @@ class ResidentSolver:
                 [pb], seeds=None if seeds is None else [seeds[b]]))
             waves.append(self.last_waves)
             rescores.append(self.last_rescore_waves)
+            evicts.append(self.last_evict)
             bytes_shipped += self.last_dispatch_bytes
             t1 = time.perf_counter()
             dispatch_s += t1 - t0
@@ -839,6 +933,7 @@ class ResidentSolver:
         fetch_s = time.perf_counter() - t3
         self.last_waves = waves
         self.last_rescore_waves = rescores
+        self.last_evict = evicts
         self.last_pipeline_stats = {
             "pack_s": pack_s, "dispatch_s": dispatch_s,
             "delta_apply_s": delta_s,

@@ -8,6 +8,7 @@ through to the next-best node instead of failing the eval.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -20,6 +21,7 @@ from .kernel import TOP_K, solve_kernel
 from .tensorize import (NUM_R, ClusterDelta, PackedBatch, PlacementAsk,
                         Tensorizer, alloc_device_usage,
                         alloc_usage_vector, apply_node_delta_host,
+                        evict_width,
                         R_CPU, R_DISK, R_MEM, R_NET)
 
 _DIM_NAMES = {R_CPU: "cpu", R_MEM: "memory", R_DISK: "disk", R_NET: "network"}
@@ -164,8 +166,10 @@ class _ResidentWorld:
             if not a.terminal_status():
                 by_node.setdefault(a.node_id, []).append(a)
                 self.live[a.id] = (a.node_id, a)
+        # evictable planes ride on the template (in-kernel preemption,
+        # ISSUE 7) and are delta-maintained with every other node plane
         self.template = self._tz.pack(self.nodes, self.probe_asks,
-                                      by_node)
+                                      by_node, evict_e=evict_width())
         # the template packs EVERY node; readiness (status, drain,
         # eligibility) lives in the valid mask instead of list filtering
         for i, n in enumerate(self.nodes):
@@ -260,6 +264,51 @@ class _ResidentWorld:
         self.last_index = snapshot.index
 
 
+def _overlay_usage(world: _ResidentWorld, pb: PackedBatch,
+                   proposed_delta) -> PackedBatch:
+    """Copy-on-read overlay: apply this plan's proposed stops/probes to
+    COPIES of the resident template's carried usage (and, for stops,
+    the eviction candidate rows), leaving `world` bit-identical.  Both
+    the steady-state solve and the what-if plan path
+    (PlanSolverView) go through here — neither ever mutates
+    _ResidentWorld state."""
+    import copy as _copy
+    pb = _copy.copy(pb)
+    t = world.template
+    used0 = t.used0.copy()
+    dev_used0 = t.dev_used0.copy()
+    stops, probes = proposed_delta or ((), ())
+    D = dev_used0.shape[1]
+    ev_gone: Dict[int, set] = {}
+    for sign, group in ((-1.0, stops), (1.0, probes)):
+        for a in group:
+            i = world.node_index.get(a.node_id)
+            if i is None:
+                continue
+            used0[i] += sign * alloc_usage_vector(a)
+            drow = alloc_device_usage(t.dev_pattern_ids, D, a)
+            if drow is not None:
+                dev_used0[i] += sign * drow
+            if sign < 0 and t.ev_lists is not None:
+                ev_gone.setdefault(i, set()).add(a.id)
+    pb.used0, pb.dev_used0 = used0, dev_used0
+    if ev_gone and pb.ev_prio is not None:
+        # an eager-stopped alloc's usage already left the overlay; it
+        # must not ALSO be selectable as an eviction victim (its freed
+        # capacity would double-count).  Rebuild the touched rows on
+        # copies; sticky probes are additions and never candidates.
+        from .tensorize import _evict_row
+        ev_prio = pb.ev_prio.copy()
+        ev_res = pb.ev_res.copy()
+        ev_ids = list(pb.ev_ids)
+        E = ev_prio.shape[1]
+        for i, gone in ev_gone.items():
+            cands = [c for c in t.ev_lists[i] if c[2] not in gone]
+            ev_prio[i], ev_res[i], ev_ids[i] = _evict_row(cands, E)
+        pb.ev_prio, pb.ev_res, pb.ev_ids = ev_prio, ev_res, ev_ids
+    return pb
+
+
 @dataclass
 class Placement:
     ask_index: int
@@ -268,6 +317,10 @@ class Placement:
     metrics: AllocMetric
     resources: Optional[AllocatedResources] = None
     failed_reason: str = ""
+    #: alloc ids the in-kernel preemption pass selected as victims for
+    #: this placement (empty for normal placements) — the scheduler
+    #: turns these into plan.node_preemptions
+    evicted: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -304,6 +357,9 @@ class Solver:
         self._delta_threshold = delta_threshold
         self._world: Optional[_ResidentWorld] = None
         self._degraded = False
+        #: serializes resident-world access between the worker thread
+        #: and overlay (what-if) solves from the HTTP plan endpoint
+        self._world_lock = threading.Lock()
 
     # ---------------------------------------------------------- brownout
     def set_degraded(self, degraded: bool) -> None:
@@ -362,57 +418,61 @@ class Solver:
             self._world = None
 
     def resident_counters(self) -> Optional[Dict]:
-        return dict(self._world.counters) if self._world else None
+        with self._world_lock:
+            world = self._world
+            return dict(world.counters) if world else None
 
-    def _resident_pack(self, snapshot, asks, proposed_delta
-                       ) -> Optional[PackedBatch]:
+    def plan_view(self) -> "PlanSolverView":
+        """Facade for dry-run (what-if) schedulers: same resident
+        template, overlay-only solves, zero writes to carried state."""
+        return PlanSolverView(self)
+
+    def _resident_pack(self, snapshot, asks, proposed_delta,
+                       overlay_only: bool = False):
         """The steady-state pack: sync the world to the snapshot via
         the change log, repack ONLY the ask side against the resident
         template, and overlay this plan's proposed stops/probes onto a
-        copy of the maintained usage.  None -> caller full-packs."""
+        copy of the maintained usage.  None -> caller full-packs.
+
+        `overlay_only` (the what-if plan path): NEVER create, sync,
+        rebuild, or grow the world — read the current template under
+        the lock and overlay onto copies, so carried state stays
+        bit-identical no matter how many plan solves interleave.
+        Returns (pb, nodes) so callers never re-read self._world (a
+        concurrent rebuild could swap the node list under them)."""
         if any(a.property_limits for a in asks):
             return None          # host-side walk the resident path skips
-        if self._world is None:
-            if len(snapshot._t["nodes"]) < self._resident_min_nodes:
-                return None
-            self._world = _ResidentWorld(
-                self._tensorizer, self._store, snapshot, asks,
-                self._delta_threshold)
-        world = self._world
-        world.sync(snapshot)
-        gp = max(self._pad(len(asks)), 1)
-        kp = max(self._pad(sum(max(a.count, 1) for a in asks)), 1)
-        pb = self._tensorizer.repack_asks(
-            world.nodes, asks, world.template, gp=gp, kp=kp,
-            drv_cache=world.drv_cache, row_cache=world.row_cache)
-        if pb is None:
-            # ask universe escape: grow the probes and rebuild once
-            if not world.add_probes(asks):
-                return None
-            world.rebuild(snapshot)
+        with self._world_lock:
+            if self._world is None:
+                if overlay_only:
+                    return None
+                if len(snapshot._t["nodes"]) < self._resident_min_nodes:
+                    return None
+                self._world = _ResidentWorld(
+                    self._tensorizer, self._store, snapshot, asks,
+                    self._delta_threshold)
+            world = self._world
+            if not overlay_only:
+                world.sync(snapshot)
+            gp = max(self._pad(len(asks)), 1)
+            kp = max(self._pad(sum(max(a.count, 1) for a in asks)), 1)
             pb = self._tensorizer.repack_asks(
                 world.nodes, asks, world.template, gp=gp, kp=kp,
                 drv_cache=world.drv_cache, row_cache=world.row_cache)
             if pb is None:
-                return None
-        import copy as _copy
-        pb = _copy.copy(pb)
-        used0 = world.template.used0.copy()
-        dev_used0 = world.template.dev_used0.copy()
-        stops, probes = proposed_delta or ((), ())
-        D = dev_used0.shape[1]
-        for sign, group in ((-1.0, stops), (1.0, probes)):
-            for a in group:
-                i = world.node_index.get(a.node_id)
-                if i is None:
-                    continue
-                used0[i] += sign * alloc_usage_vector(a)
-                drow = alloc_device_usage(
-                    world.template.dev_pattern_ids, D, a)
-                if drow is not None:
-                    dev_used0[i] += sign * drow
-        pb.used0, pb.dev_used0 = used0, dev_used0
-        return pb
+                if overlay_only:
+                    return None
+                # ask universe escape: grow the probes and rebuild once
+                if not world.add_probes(asks):
+                    return None
+                world.rebuild(snapshot)
+                pb = self._tensorizer.repack_asks(
+                    world.nodes, asks, world.template, gp=gp, kp=kp,
+                    drv_cache=world.drv_cache, row_cache=world.row_cache)
+                if pb is None:
+                    return None
+            return (_overlay_usage(world, pb, proposed_delta),
+                    world.nodes)
 
     @staticmethod
     def _pad(n: int) -> int:
@@ -421,20 +481,31 @@ class Solver:
     def solve(self, nodes: Sequence[Node], asks: Sequence[PlacementAsk],
               allocs_by_node: Optional[Dict[str, list]] = None,
               by_dc: Optional[Dict[str, int]] = None, *,
-              snapshot=None, proposed_delta=None) -> SolveOutput:
+              snapshot=None, proposed_delta=None, preempt: bool = False,
+              _overlay_only: bool = False) -> SolveOutput:
+        """`preempt`: the scheduler resolved preemption as enabled for
+        this eval — the resident path then runs the in-kernel eviction
+        wave pass (ISSUE 7) and failed-capacity placements may come
+        back with `Placement.evicted` victim ids instead of a failure.
+        `_overlay_only`: what-if plan mode (see PlanSolverView)."""
         if not asks:
             return SolveOutput(placements=[])
         pb = None
         sol_nodes = nodes
         if snapshot is not None and self.resident_active(snapshot):
-            pb = self._resident_pack(snapshot, asks, proposed_delta)
-            if pb is not None:
-                sol_nodes = self._world.nodes
+            packed = self._resident_pack(snapshot, asks, proposed_delta,
+                                         overlay_only=_overlay_only)
+            if packed is not None:
+                pb, sol_nodes = packed
         if pb is None:
-            pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
+            with self._world_lock:
+                # the tensorizer's interners are shared with concurrent
+                # plan-view solves — serialize every pack through it
+                pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
         res = _run_kernel(pb, host_mode=self._host,
                           max_waves=BROWNOUT_MAX_WAVES
-                          if self._degraded else 0)
+                          if self._degraded else 0,
+                          preempt=preempt)
 
         choice = np.asarray(res.choice)
         choice_ok = np.asarray(res.choice_ok)
@@ -445,6 +516,8 @@ class Solver:
         feas = np.asarray(res.feas)
         cons_filtered = np.asarray(res.cons_filtered)
         unfinished = np.asarray(res.unfinished)
+        evict = (np.asarray(res.evict) if res.evict is not None
+                 else None)
 
         # host fixup state: per-node port/device accounting incl. in-batch.
         # host_used is the AUTHORITATIVE usage: when a placement falls through
@@ -459,8 +532,20 @@ class Solver:
         # distinct_property charges shared batch-wide by (scope, target) key
         prop_used: Dict[tuple, Dict[str, int]] = {}
 
-        placements: List[Placement] = []
-        for p in range(pb.n_place):
+        # Replay commits in KERNEL WAVE order when the preemption pass
+        # ran: evictions make in-batch usage non-monotone, so an
+        # ask-order replay can transiently exceed `avail` on a node
+        # whose eviction the kernel sequenced earlier (false fall-
+        # through).  Without evictions usage only grows and any prefix
+        # of a feasible final state is feasible, so ask order is fine
+        # (and commit_wave is None).
+        order = list(range(pb.n_place))
+        if res.commit_wave is not None:
+            cwave = np.asarray(res.commit_wave)
+            order.sort(key=lambda p: (int(cwave[p]) if cwave[p] >= 0
+                                      else np.iinfo(np.int32).max, p))
+        by_p: Dict[int, Placement] = {}
+        for p in order:
             g = int(pb.p_ask[p])
             ask = asks[g]
             m = AllocMetric()
@@ -484,6 +569,23 @@ class Solver:
 
             placed = None
             ask_vec = pb.ask_res[g]
+            if (evict is not None and evict[p].any()
+                    and bool(choice_ok[p, 0])):
+                # in-kernel preemption pass committed this placement:
+                # slot 0 is its single node (no fall-through — the
+                # victim set is node-specific); validate the discrete
+                # leftovers with the victims removed, then charge
+                # host_used with the NET usage (ask minus freed)
+                placed = self._evict_commit(
+                    int(choice[p, 0]), g, ask, pb, sol_nodes,
+                    allocs_by_node, evict[p], host_used,
+                    float(score[p, 0]), m)
+                if placed is not None:
+                    by_p[p] = placed
+                    continue
+                # discrete fixup failed (ports, stale victim view):
+                # fall through as a normal failure — the scheduler's
+                # host-side preemption walk remains the safety net
             for k in range(TOP_K):
                 if not choice_ok[p, k]:
                     break
@@ -526,7 +628,12 @@ class Solver:
                     reason = "no feasible nodes"
                 placed = Placement(ask_index=g, node=None, score=0.0,
                                    metrics=m, failed_reason=reason)
-            placements.append(placed)
+            by_p[p] = placed
+        # emit in ask order regardless of replay order: the scheduler
+        # maps placements back to its per-ask missing queues by
+        # position
+        placements: List[Placement] = [by_p[p]
+                                       for p in range(pb.n_place)]
 
         # class eligibility for blocked-eval optimization
         class_elig: List[Dict[str, bool]] = []
@@ -543,6 +650,53 @@ class Solver:
 
         return SolveOutput(placements=placements,
                            class_eligibility=class_elig)
+
+    def _evict_commit(self, ni: int, g: int, ask: PlacementAsk,
+                      pb: PackedBatch, sol_nodes, allocs_by_node,
+                      ev_row: np.ndarray, host_used: np.ndarray,
+                      score: float, m: AllocMetric
+                      ) -> Optional[Placement]:
+        """Host fixup for a kernel-committed (place, evict) pair: map
+        the victim-slot mask back to alloc ids through the packed
+        `ev_ids` rows, re-check capacity net of the freed usage, and
+        run the discrete port/device assignment against the node MINUS
+        its victims (fresh accounting — the shared caches still hold
+        the victims' reservations).  Returns None when the discrete
+        leftovers fail; the caller falls back to the host preemption
+        walk."""
+        if pb.ev_ids is None or ni >= len(pb.ev_ids):
+            return None
+        node = sol_nodes[ni]
+        victim_ids = [pb.ev_ids[ni][e] for e in np.nonzero(ev_row)[0]
+                      if e < len(pb.ev_ids[ni]) and pb.ev_ids[ni][e]]
+        if not victim_ids:
+            return None
+        vset = set(victim_ids)
+        proposed = (list(allocs_by_node.get(node.id, ()))
+                    if allocs_by_node is not None else [])
+        victims = [a for a in proposed if a.id in vset]
+        if len(victims) != len(vset):
+            # the lazy view and the packed planes disagree (stale
+            # world): refuse rather than evict the wrong alloc
+            return None
+        freed = np.zeros(NUM_R, np.float32)
+        for a in victims:
+            freed += alloc_usage_vector(a)
+        ask_vec = pb.ask_res[g]
+        if not np.all(host_used[ni] + ask_vec - freed
+                      <= pb.avail[ni]):
+            return None
+        remaining = [a for a in proposed if a.id not in vset]
+        resources = self._host_commit(node, ni, ask, {}, {},
+                                      {node.id: remaining})
+        if resources is None:
+            return None
+        host_used[ni] += ask_vec - freed
+        m.score_meta = [{"node_id": pb.node_ids[ni],
+                         "normalized_score": score}]
+        return Placement(ask_index=g, node=node, score=score,
+                         metrics=m, resources=resources,
+                         evicted=sorted(victim_ids))
 
     @staticmethod
     def _host_commit(node: Node, node_ix: int, ask: PlacementAsk,
@@ -647,22 +801,76 @@ class Solver:
         return None
 
 
+class PlanSolverView:
+    """Read-only facade over a worker's Solver for what-if planning
+    (`/v1/job/:id/plan`, ISSUE 7): dry-run schedulers share the
+    resident template — plan solves answer at steady-state speed
+    instead of re-walking the cluster — but every solve goes through
+    the copy-on-read overlay with `overlay_only` pinned, so the world
+    is never created, synced, rebuilt, grown, or fed from a plan.
+    Carried usage stays bit-identical under any plan/solve
+    interleaving (tests/test_plan_overlay.py)."""
+
+    def __init__(self, inner: Solver):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def resident_active(self, snapshot=None) -> bool:
+        # only ride a world that already exists; a plan never builds one
+        return (self._inner._resident != "off"
+                and self._inner._world is not None)
+
+    def note_plan_result(self, plan, result) -> None:
+        return None              # dry-run plans never feed the world
+
+    def set_degraded(self, degraded: bool) -> None:
+        return None              # brownout belongs to the worker
+
+    def solve(self, *args, **kw) -> SolveOutput:
+        kw["_overlay_only"] = True
+        return self._inner.solve(*args, **kw)
+
+
 def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
-                pallas: str = "auto", max_waves: int = 0):
+                pallas: str = "auto", max_waves: int = 0,
+                preempt: bool = False):
     import numpy as _np
     has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
+    # in-kernel preemption (ISSUE 7): only when the batch carries the
+    # evictable-alloc planes (resident path, evict_width() > 0) and has
+    # no distinct_hosts groups — cross-group blocking is invisible to
+    # the eviction pass, so those batches keep the host-side walk.
+    # Host twin and device kernel get the SAME decision (bit-identity).
+    ev_kw = {}
+    if (preempt and pb.ev_prio is not None
+            and not bool((_np.asarray(pb.distinct) >= 0).any())):
+        ev_kw = dict(has_preempt=True, ev_res=pb.ev_res,
+                     ev_prio=pb.ev_prio, ask_prio=pb.ask_prio)
+        if max_waves == 0:
+            # eviction commits serialize one-per-node-per-wave, so an
+            # overcommitted batch needs more waves than the default
+            # budget; host twin and device kernel get the same value
+            from .kernel import MAX_WAVES
+            max_waves = 2 * MAX_WAVES
     if host_mode != "never":
         from .host import host_solve_kernel, prefer_host
         if host_mode == "always" or prefer_host(
                 pb.avail.shape[0], pb.n_asks, pb.n_place):
             return host_solve_kernel(*_kernel_args(pb),
                                      has_spread=has_spread,
-                                     max_waves=max_waves)
+                                     max_waves=max_waves, **ev_kw)
     # "auto" resolves to the pallas fused wave on TPU backends (or when
     # NOMAD_TPU_PALLAS forces it) and to the unfused kernel otherwise —
     # placement-identical either way (tests/test_pallas_kernel.py)
+    if ev_kw:
+        # the eviction pass statically asserts no distinct batches;
+        # the check above established it for this batch
+        ev_kw["has_distinct"] = False
     return solve_kernel(*_kernel_args(pb), has_spread=has_spread,
-                        pallas_mode=pallas, max_waves=max_waves)
+                        pallas_mode=pallas, max_waves=max_waves,
+                        **ev_kw)
 
 
 def _kernel_args(pb: PackedBatch):
