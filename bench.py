@@ -681,7 +681,7 @@ def measure_device_ceiling(config=3):
 
 
 def run_multichip(n_devices=8, sizes=None, n_evals=16, count=64,
-                  evals_per_call=8, write_detail=True):
+                  evals_per_call=8, write_detail=True, n_hosts=None):
     """Multichip phase (ISSUE 5): the mesh-resident sharded solve vs
     the stateless GSPMD wrapper, per node-scale.
 
@@ -702,21 +702,26 @@ def run_multichip(n_devices=8, sizes=None, n_evals=16, count=64,
     50k/100k-node configs (NOMAD_TPU_MULTICHIP_NODES overrides)."""
     import importlib
     graft = importlib.import_module("__graft_entry__")
-    graft._ensure_devices(n_devices)
+    if n_hosts is None:
+        # dcn_tier leg (ISSUE 8): simulated host grouping on the CPU
+        # mesh — NOMAD_TPU_MESH_HOSTS overrides the default 4
+        from nomad_tpu.parallel.sharded import env_mesh_hosts
+        n_hosts = env_mesh_hosts() or 4
+    n_devices, n_hosts = graft._ensure_devices(n_devices, n_hosts)
     import jax
     import numpy as np
-    from nomad_tpu.parallel.sharded import (ShardedResidentSolver,
-                                            kernel_args, make_mesh,
-                                            make_node_mesh,
-                                            sharded_solve_args)
+    from nomad_tpu.parallel.sharded import (
+        ElasticShardedResidentSolver, ShardedResidentSolver,
+        kernel_args, make_mesh, make_node_mesh, make_two_tier_mesh,
+        sharded_solve_args)
     from nomad_tpu.solver.tensorize import Tensorizer
 
     if sizes is None:
         raw = os.environ.get("NOMAD_TPU_MULTICHIP_NODES", "50000,100000")
         sizes = [int(s) for s in raw.split(",") if s.strip()]
     out = {"phase": "multichip", "n_devices": int(n_devices),
-           "skipped": False, "backend": jax.default_backend(),
-           "configs": []}
+           "n_hosts": int(n_hosts), "skipped": False,
+           "backend": jax.default_backend(), "configs": []}
     mesh_stateless = make_mesh(n_devices, n_regions=1)
     for n_nodes in sizes:
         nodes = make_nodes(n_nodes)
@@ -793,8 +798,92 @@ def run_multichip(n_devices=8, sizes=None, n_evals=16, count=64,
                 <= ici["bound_candidate_keys"]),
             "measured": wt.get("measured"),
         }
+
+        # ---- dcn_tier leg (ISSUE 8): two-tier hierarchical exchange
+        # on a simulated host grouping, vs the flat PR-5 exchange.
+        # Plain ShardedResidentSolver on the two-tier mesh: same
+        # extraction semantics as the flat run (incl. the approx_max_k
+        # window at large Np), so the parity spot check is exact ----
+        if n_hosts > 1 and n_devices % n_hosts == 0:
+            rs2 = ShardedResidentSolver(
+                nodes, asks_for(probe_job),
+                mesh=make_two_tier_mesh(n_hosts, n_devices),
+                gp=1 << max(0, (gp_need - 1).bit_length()),
+                kp=1 << max(0, (count - 1).bit_length()),
+                max_waves=18, pallas="off")
+            b2 = [rs2.pack_batch(asks_for(j)) for j in jobs]
+            t_tiered = None
+            for round_ in range(2):
+                rs2.reset_usage()
+                t0 = time.perf_counter()
+                outs2 = []
+                for b in range(NB):
+                    outs2.append(rs2.solve_stream_async(
+                        b2[b * epc:(b + 1) * epc]))
+                jax.block_until_ready(outs2[-1])
+                t_tiered = time.perf_counter() - t0
+            # placement parity spot check vs the flat mesh run
+            rs.reset_usage()
+            rs2.reset_usage()
+            c1, o1, _, st1 = rs.solve_stream(batches[:epc])
+            c2, o2, _, st2 = rs2.solve_stream(b2[:epc])
+            parity = bool(np.array_equal(o1, o2)
+                          and np.array_equal(st1, st2)
+                          and np.array_equal(np.where(o1, c1, -1),
+                                             np.where(o2, c2, -1)))
+            wt2 = rs2.wave_traffic(b2[:epc])
+            dcn = wt2["dcn"]
+            rec["dcn_tier"] = {
+                "n_hosts": int(n_hosts),
+                "chips_per_host": dcn["chips_per_host"],
+                "tiered_wall_s": round(t_tiered, 4),
+                "bytes_dcn_per_wave": dcn["bytes_dcn_total_per_wave"],
+                "flat_dcn_per_wave": dcn["flat_dcn_total_per_wave"],
+                "dcn_cut_vs_flat": round(dcn["dcn_cut_vs_flat"], 4),
+                "dcn_within_quarter": bool(
+                    dcn["dcn_cut_vs_flat"] <= 0.25),
+                "bytes_ici_per_wave": dcn["bytes_ici_per_wave"],
+                "placements_match_flat": parity,
+            }
+
+            # ---- kill-one-shard recovery-time probe (the elastic
+            # solver: tile layout + fail/recover state machine) ----
+            es = ElasticShardedResidentSolver(
+                nodes, asks_for(probe_job),
+                mesh=make_two_tier_mesh(n_hosts, n_devices),
+                gp=1 << max(0, (gp_need - 1).bit_length()),
+                kp=1 << max(0, (count - 1).bit_length()),
+                max_waves=18, pallas="off")
+            b2 = [es.pack_batch(asks_for(j)) for j in jobs]
+            victim = es.n_shards - 1
+            lost = es.fail_shard(victim)
+            t0 = time.perf_counter()
+            es.solve_stream(b2[:epc])          # degraded, fast path
+            t_degraded = time.perf_counter() - t0
+            rc = es.reshard_counters
+            rec_bytes = es.recover()
+            es.reset_usage()
+            t0 = time.perf_counter()
+            es.solve_stream(b2[:epc])
+            t_recovered = time.perf_counter() - t0
+            grown = es.grow_tiles(1)
+            rec["recovery_probe"] = {
+                "killed_shard": int(victim),
+                "lost_tiles": len(lost),
+                "degraded_solve_s": round(t_degraded, 4),
+                "degraded_on_fast_path": rc["degraded_solves"] >= 1,
+                "recovery_s": round(rc["last_recovery_s"], 4),
+                "recovery_bytes": int(rec_bytes),
+                "recovered_solve_s": round(t_recovered, 4),
+                "grow_tiles": grown,
+                "grow_bytes_measured": rc["last_reshard_bytes"],
+            }
         out["configs"].append(rec)
     out["ok"] = all(c["ici_within_bound"] for c in out["configs"])
+    out["dcn_ok"] = all(
+        c["dcn_tier"]["dcn_within_quarter"]
+        and c["dcn_tier"]["placements_match_flat"]
+        for c in out["configs"] if "dcn_tier" in c)
     if write_detail:
         with open(os.path.join(REPO, "MULTICHIP_DETAIL.json"),
                   "w") as f:

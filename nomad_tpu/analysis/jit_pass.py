@@ -70,12 +70,92 @@ def _is_mesh_wrapper(full: str) -> bool:
             or full.endswith(".pmap") or full.endswith("xmap"))
 
 
+def _module_str_constants(index: PackageIndex,
+                          module: str) -> Dict[str, str]:
+    """Module-level `NAME = "literal"` string constants (the axis-name
+    spelling: MESH_HOST_AXIS = "hosts")."""
+    cache = getattr(index, "_str_const_cache", None)
+    if cache is None:
+        cache = index._str_const_cache = {}
+    out = cache.get(module)
+    if out is not None:
+        return out
+    out = {}
+    mi = index.modules.get(module)
+    if mi is not None:
+        for node in mi.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[node.targets[0].id] = node.value.value
+    cache[module] = out
+    return out
+
+
+def _axis_str(index: PackageIndex, fi, aliases: Dict[str, str],
+              node) -> Optional[str]:
+    """Resolve an expression to an axis-name string: a literal, or a
+    Name/Attribute bound to a module-level string constant (local or
+    imported)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    d = _dotted(node)
+    if not d:
+        return None
+    head = d.split(".")[0]
+    target = aliases.get(head)
+    if target is not None:
+        d = target + d[len(head):]
+    if "." in d:
+        mod, name = d.rsplit(".", 1)
+        return _module_str_constants(index, mod).get(name)
+    return _module_str_constants(index, fi.module).get(d)
+
+
+def _mesh_ctor_axes(index: PackageIndex, fi, aliases: Dict[str, str],
+                    call: ast.Call) -> Optional[Set[str]]:
+    """Axis names bound by a `Mesh(devices, ("a", "b"))` constructor
+    call with statically resolvable names; None when unresolvable."""
+    names_arg = None
+    if len(call.args) >= 2:
+        names_arg = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            names_arg = kw.value
+    if names_arg is None:
+        return None
+    elts = (names_arg.elts if isinstance(names_arg, (ast.Tuple, ast.List))
+            else [names_arg])
+    axes: Set[str] = set()
+    for e in elts:
+        s = _axis_str(index, fi, aliases, e)
+        if s is None:
+            return None
+        axes.add(s)
+    return axes or None
+
+
 def find_mesh_roots(index: PackageIndex) -> List[str]:
     """Functions handed to shard_map/pmap — the roots under which a
-    collective primitive has a bound axis name.  Resolves the direct
-    callable, a functools.partial(f, ...) wrapper, and a local
-    `name = functools.partial(f, ...)` binding."""
-    roots: List[str] = []
+    collective primitive has a bound axis name (see
+    find_mesh_roots_with_axes for the per-root bound-axis sets)."""
+    return list(find_mesh_roots_with_axes(index))
+
+
+def find_mesh_roots_with_axes(
+        index: PackageIndex) -> Dict[str, Optional[Set[str]]]:
+    """Mesh roots -> the axis names their enclosing mesh context binds
+    (ISSUE 8: nested ("hosts", "chips") axes make a wrong-axis psum a
+    real hazard).  Resolves the direct callable, a
+    functools.partial(f, ...) wrapper, and a local
+    `name = functools.partial(f, ...)` binding; the bound axes come
+    from the shard_map call's `mesh=` argument when it is a local
+    `m = Mesh(devs, ("a", "b"))` binding with literal (or module-
+    constant) names, or pmap's literal `axis_name=`.  None = the
+    context exists but its axes are not statically resolvable (a mesh
+    passed in as a parameter) — the axis check stays silent there."""
+    roots: Dict[str, Optional[Set[str]]] = {}
     for fkey, fi in index.functions.items():
         la = index._local_imports(fi)
         lt = index._local_var_types(fi)
@@ -103,15 +183,40 @@ def find_mesh_roots(index: PackageIndex) -> List[str]:
                     la, lt)
             return None
 
-        # local `body = functools.partial(f, ...)` bindings
+        # local `body = functools.partial(f, ...)` bindings, and local
+        # `m = Mesh(devs, ("a", "b"))` mesh constructions
         partial_locals: Dict[str, Optional[str]] = {}
+        mesh_locals: Dict[str, Optional[Set[str]]] = {}
         for node in index._own_nodes(fi):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
                     and isinstance(node.value, ast.Call):
+                full_v = _full(node.value.func)
+                if full_v.endswith("Mesh"):
+                    mesh_locals[node.targets[0].id] = _mesh_ctor_axes(
+                        index, fi, aliases, node.value)
+                    continue
                 tgt = _target_of(node.value)
                 if tgt:
                     partial_locals[node.targets[0].id] = tgt
+
+        def _axes_of_call(call: ast.Call) -> Optional[Set[str]]:
+            """Bound axes of one shard_map/pmap call site, if
+            statically resolvable."""
+            for kw in call.keywords:
+                if kw.arg == "axis_name":          # pmap spelling
+                    s = _axis_str(index, fi, aliases, kw.value)
+                    return {s} if s is not None else None
+                if kw.arg == "mesh":
+                    if isinstance(kw.value, ast.Call) and \
+                            _full(kw.value.func).endswith("Mesh"):
+                        return _mesh_ctor_axes(index, fi, aliases,
+                                               kw.value)
+                    if isinstance(kw.value, ast.Name):
+                        return mesh_locals.get(kw.value.id)
+                    return None
+            return None
+
         for node in index._own_nodes(fi):
             if not isinstance(node, ast.Call):
                 continue
@@ -124,7 +229,16 @@ def find_mesh_roots(index: PackageIndex) -> List[str]:
             else:
                 tgt = _target_of(arg0)
             if tgt:
-                roots.append(tgt)
+                axes = _axes_of_call(node)
+                if tgt in roots:
+                    # several contexts wrap the same body: an axis is
+                    # only provably unbound if EVERY context is known
+                    prev = roots[tgt]
+                    roots[tgt] = (prev | axes
+                                  if prev is not None and axes is not None
+                                  else None)
+                else:
+                    roots[tgt] = axes
     return roots
 
 
@@ -368,9 +482,67 @@ def run_jit_pass(index: PackageIndex, cfg: AnalysisConfig
                              "the branch with lax.cond/jnp.where"))
 
     # ---- JIT205: collectives outside a mesh/shard_map context
-    mesh_ok = index.reachable(find_mesh_roots(index))
+    mesh_roots = find_mesh_roots_with_axes(index)
+    mesh_ok = index.reachable(mesh_roots)
+    # per-function union of the axis names every enclosing mesh
+    # context provably binds; None = some context is statically
+    # unresolvable, so the axis-binding check stays silent (ISSUE 8:
+    # nested ("hosts", "chips") axes make wrong-axis psums a hazard
+    # the reachability check alone cannot see)
+    fn_axes: Dict[str, Optional[Set[str]]] = {}
+    for root, axes in mesh_roots.items():
+        for fkey in index.reachable([root]):
+            if fkey in fn_axes:
+                prev = fn_axes[fkey]
+                fn_axes[fkey] = (prev | axes
+                                 if prev is not None and axes is not None
+                                 else None)
+            else:
+                fn_axes[fkey] = set(axes) if axes is not None else None
     for fkey, fi in sorted(index.functions.items()):
         if fkey in mesh_ok:
+            bound = fn_axes.get(fkey)
+            if not bound:
+                continue
+            la = index._local_imports(fi)
+            aliases = dict(index.modules[fi.module].aliases)
+            aliases.update(la)
+
+            def _full(node, _a=aliases) -> str:
+                d = _dotted(node)
+                if not d:
+                    return ""
+                head = d.split(".")[0]
+                resolved = _a.get(head)
+                return (resolved + d[len(head):]) if resolved else d
+
+            for node in index._own_nodes(fi):
+                if not isinstance(node, ast.Call) \
+                        or not _is_collective(_full(node.func)):
+                    continue
+                exprs = list(node.args) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("axis_name", "axis_names")]
+                for e in exprs:
+                    elts = (e.elts if isinstance(e, (ast.Tuple,
+                                                     ast.List))
+                            else [e])
+                    for el in elts:
+                        s = _axis_str(index, fi, aliases, el)
+                        if s is not None and s not in bound:
+                            findings.append(Finding(
+                                "JIT205", fi.module, fi.qual,
+                                _full(node.func), fi.path, node.lineno,
+                                f"collective axis name {s!r} is not "
+                                "bound by the enclosing mesh context "
+                                f"(bound: {sorted(bound)}); under "
+                                "nested mesh axes this psum/gather "
+                                "reduces over the wrong tier or fails "
+                                "at trace time",
+                                hint="use an axis name the wrapping "
+                                     "shard_map's mesh actually "
+                                     "carries, or thread the axis in "
+                                     "as a parameter"))
             continue
         for name, lineno in index.external_calls(fkey):
             if _is_collective(name):

@@ -103,7 +103,7 @@ class FederatedResidentSolver:
     def __init__(self, region_nodes: Sequence[Sequence[Node]],
                  probe_asks: Sequence[PlacementAsk],
                  gp: Optional[int] = None, kp: Optional[int] = None,
-                 max_waves: int = 0):
+                 max_waves: int = 0, evict_e: int = 0):
         if not region_nodes:
             raise ValueError("need at least one region")
         # regions passed the SAME node-list object share one packed
@@ -120,7 +120,8 @@ class FederatedResidentSolver:
             if entry is None or entry[0] is not nodes:
                 entry = (nodes, ResidentSolver(nodes, probe_asks,
                                                gp=gp, kp=kp,
-                                               max_waves=max_waves))
+                                               max_waves=max_waves,
+                                               evict_e=evict_e))
                 shared[id(nodes)] = entry
             self.solvers.append(entry[1])
         self.R = len(self.solvers)
@@ -238,8 +239,15 @@ class FederatedResidentSolver:
         EPOCH (bumped by apply_delta/repack): a delta applied to a
         region between steps invalidates that step's cached stack, so a
         re-dispatch can never serve ask planes packed against the old
-        node universe."""
+        node universe.  It ALSO keys on each solver's EVICT-PLANE epoch
+        (ISSUE 8 satellite): PR 7's ev rows advance on pure alloc
+        place/stop deltas that never move the node epoch — today the
+        stacked dict carries no ev operand (the federated kernel solves
+        preemption-free), but any future ev plumbing through this stack
+        would otherwise serve rows from before the replay, so the key
+        is pinned conservatively now and the regression test holds it."""
         step_key = (tuple(s._node_epoch for s in self.solvers),
+                    tuple(s._ev_epoch for s in self.solvers),
                     tuple(id(pb) for rb in batches for pb in rb))
         cached = getattr(self, "_step_cache", None)
         if cached is None:

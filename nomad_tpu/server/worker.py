@@ -25,6 +25,11 @@ class Worker(threading.Thread):
         self.paused = threading.Event()
         self._solver = None
         self._solver_lock = threading.Lock()
+        #: optional parallel.sharded.ElasticMeshSupervisor: node-update
+        #: evals feed the elastic mesh's fail/recover state machine
+        #: (ISSUE 8) — the scheduler-plane recovery trigger next to the
+        #: serf-plane gossip callbacks
+        self.mesh_supervisor = None
 
     def fleet_solver(self):
         """One Solver per worker, store-attached: its tensorizer's
@@ -164,6 +169,17 @@ class Worker(threading.Thread):
         t0 = _t.monotonic()
         server.store.wait_for_index(wait_index, timeout=5.0)
         _m.measure_since("worker.wait_for_index", t0)
+        if self.mesh_supervisor is not None and ev.node_id:
+            from ..structs import EVAL_TRIGGER_NODE_UPDATE
+            if ev.triggered_by == EVAL_TRIGGER_NODE_UPDATE:
+                # recovery trigger (ISSUE 8): a mesh-host node going
+                # down fails its shard BEFORE this eval solves, so the
+                # solve runs at degraded width instead of stalling on a
+                # dead shard; its return to ready triggers the rejoin
+                node = server.store.snapshot().node_by_id(ev.node_id)
+                if node is not None:
+                    self.mesh_supervisor.note_node_event(ev.node_id,
+                                                         node.status)
         _invoke_t0 = _t.monotonic()
         try:
             from ..structs import JOB_TYPE_CORE

@@ -258,7 +258,8 @@ def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
                                     "has_distinct", "has_devices",
                                     "stack_commit", "pallas_mode",
                                     "shortlist_c", "mesh_axis",
-                                    "mesh_shards", "has_preempt"))
+                                    "mesh_shards", "has_preempt",
+                                    "mesh_hosts", "mesh_nt", "tile_np"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -272,7 +273,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  pallas_mode="off", shortlist_c=0,
                  mesh_axis=None, mesh_shards=0,
                  has_preempt=False, ev_res=None, ev_prio=None,
-                 ask_prio=None) -> SolveResult:
+                 ask_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
+                 node_gid=None, owner_map=None, slot_map=None
+                 ) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -284,21 +287,200 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     S = sp_col.shape[1]
     R = avail.shape[1]
     K = p_ask.shape[0]
-    # ---------- mesh-resident sharding (ISSUE 5) ----------
+    # ---------- mesh-resident sharding (ISSUE 5 / ISSUE 8) ----------
     # mesh_axis names the shard_map axis the NODE dimension is split
     # over: every [.., Np, ..] arg here is that shard's LOCAL plane.
     # Scoring, extraction, and the shortlist stay shard-local; only
     # per-group candidate KEYS (score, global node id) and K-sized
-    # commit/counter vectors cross ICI — never a [Gp, Np] plane.
+    # commit/counter vectors cross the interconnect — never a
+    # [Gp, Np] plane.
+    #
+    # ISSUE 8 generalizes the flat "nodes" axis to a TWO-TIER
+    # ("hosts", "chips") hierarchy: the candidate-key exchange first
+    # all-gathers within a host over fast ICI and lex-merges the host's
+    # shards into ONE host window, and only the merged host-winner keys
+    # cross the (10-40x slower) DCN between hosts — chip-sliced so each
+    # host window traverses DCN once, not once per chip.  Commit psums
+    # tier the same way (ICI reduce, then host-level reduce).  Both
+    # tiers merge in the exact (score desc, global id asc) lex order of
+    # the single-device tournament, so placements stay bit-identical.
     in_mesh = mesh_axis is not None
+    two_tier = in_mesh and isinstance(mesh_axis, tuple)
     if in_mesh:
         assert mesh_shards >= 1, \
             "mesh_axis requires the static mesh_shards axis size"
-    NT = Np * mesh_shards if in_mesh else Np      # global node axis
-    # shard offset: NamedSharding splits the node axis into contiguous
-    # axis-index-ordered blocks, so global id = axis_index * Np + local
-    off = (lax.axis_index(mesh_axis).astype(jnp.int32) * jnp.int32(Np)
-           if in_mesh else None)
+        if two_tier:
+            assert len(mesh_axis) == 2 and mesh_hosts >= 1 \
+                and mesh_shards % mesh_hosts == 0, (
+                    "two-tier mesh_axis needs (host_axis, chip_axis) "
+                    "and mesh_hosts dividing mesh_shards; got "
+                    f"{mesh_axis!r} hosts={mesh_hosts} "
+                    f"shards={mesh_shards}")
+            host_ax, chip_ax = mesh_axis
+            CPH = mesh_shards // mesh_hosts
+            my_lin = (lax.axis_index(host_ax).astype(jnp.int32)
+                      * jnp.int32(CPH)
+                      + lax.axis_index(chip_ax).astype(jnp.int32))
+        else:
+            host_ax = chip_ax = None
+            CPH = mesh_shards
+            my_lin = lax.axis_index(mesh_axis).astype(jnp.int32)
+    # elastic tile layout (ISSUE 8): tile_np > 0 means the node axis is
+    # owned in TILES of tile_np slots routed by an owner remap table
+    # instead of contiguous axis-index blocks — a reshard moves one
+    # tile's planes, never the world.  node_gid maps this shard's local
+    # slots to stable GLOBAL node ids; owner_map/slot_map (replicated,
+    # with a trailing -1 sentinel row) invert a global id to its owning
+    # shard and local tile position.
+    elastic = in_mesh and tile_np > 0
+    if elastic:
+        assert node_gid is not None and owner_map is not None \
+            and slot_map is not None, \
+            "tile_np > 0 needs node_gid/owner_map/slot_map tables"
+    # global node axis: the elastic layout carries per-shard slack
+    # (dead slots), so the true global width is passed in via mesh_nt —
+    # it must match the host twin's padded axis or the TK clamp (and
+    # with it the candidate window) would diverge from the twin
+    NT = ((mesh_nt or Np * mesh_shards) if in_mesh else Np)
+    # shard offset (contiguous layout): NamedSharding splits the node
+    # axis into contiguous axis-index-ordered blocks, so global id =
+    # axis_index * Np + local
+    off = (my_lin * jnp.int32(Np) if (in_mesh and not elastic)
+           else None)
+    if in_mesh:
+        if elastic:
+            g_of_local = node_gid.astype(jnp.int32)       # [Np]
+            n_tiles_s = owner_map.shape[0] - 1            # sentinel row
+
+            def _l2g(idx):
+                return g_of_local[idx]
+
+            def _g2l(gid):
+                """global id -> (owned-here, scatter-safe local slot
+                (non-owned pinned to the dropped Np slot), clipped
+                gather-safe slot).  Dead-slot gids land on the
+                sentinel owner row (-1) and are never owned."""
+                t = jnp.clip(gid // jnp.int32(tile_np), 0, n_tiles_s)
+                own = (owner_map[t] == my_lin) & (gid >= 0)
+                loc_ = (slot_map[t] * jnp.int32(tile_np)
+                        + gid % jnp.int32(tile_np))
+                loc = jnp.where(own, loc_, Np)
+                return own, loc, jnp.clip(loc, 0, Np - 1)
+        else:
+            g_of_local = off + jnp.arange(Np, dtype=jnp.int32)
+
+            def _l2g(idx):
+                return idx + off
+
+            def _g2l(gid):
+                loc_ = gid - off
+                own = (loc_ >= 0) & (loc_ < Np)
+                loc = jnp.where(own, loc_, Np)
+                return own, loc, jnp.clip(loc, 0, Np - 1)
+
+    def _psum_mesh(x):
+        """Tiered reduction: ICI (chips) first, then a CHIP-SLICED
+        host tier — each chip ships only its 1/CPH slice of the
+        host-reduced vector across DCN (reduce-scatter over ICI, host
+        psum on the slice, reassembled over ICI), so a commit vector
+        crosses DCN once per host, not once per chip.  Integer
+        operands everywhere, so the tiering is order-exact."""
+        if not two_tier:
+            return lax.psum(x, mesh_axis)
+        x = lax.psum(x, chip_ax)
+        if mesh_hosts == 1:
+            return x
+        if CPH == 1:
+            return lax.psum(x, host_ax)
+        shp = x.shape
+        n = 1
+        for d in shp:
+            n *= d
+        np_ = -(-n // CPH) * CPH
+        flat = jnp.ravel(x)
+        if np_ != n:
+            flat = jnp.pad(flat, (0, np_ - n))
+        # x is already chip-replicated, so the reduce-scatter degrades
+        # to a slice: dynamic_slice keeps it collective-free on ICI
+        wl = np_ // CPH
+        sl = lax.dynamic_slice_in_dim(
+            flat, lax.axis_index(chip_ax) * wl, wl, axis=0)
+        sl = lax.psum(sl, host_ax)
+        flat = lax.all_gather(sl, chip_ax, axis=0, tiled=True)
+        return flat[:n].reshape(shp)
+
+    def _merge_mesh(s, i, k):
+        """Hierarchical candidate-key merge: returns the top-k of the
+        union of every shard's (score, global id) keys in the exact
+        (score desc, id asc) lex order, replicated on all shards.
+
+        Flat mesh: one all-gather + merge (the PR-5 exchange).  Two
+        tiers: all-gather + merge within the host over ICI; then a
+        chip-SLICED exchange over DCN — each chip ships 1/CPH of its
+        host's window to the partner host and the slices reassemble
+        over ICI, so one host window crosses DCN once per transfer,
+        not once per chip.  Power-of-two host counts run a
+        recursive-doubling tournament (every host ships log2(H)
+        windows); other counts fall back to one sliced all-gather."""
+        ax_last = s.ndim - 1
+        if not two_tier:
+            gs_ = lax.all_gather(s, mesh_axis, axis=ax_last, tiled=True)
+            gi_ = lax.all_gather(i, mesh_axis, axis=ax_last, tiled=True)
+            return _lex_topk(gs_, gi_, k)
+        if CPH > 1:                      # ICI tier: merge the host
+            gs_ = lax.all_gather(s, chip_ax, axis=ax_last, tiled=True)
+            gi_ = lax.all_gather(i, chip_ax, axis=ax_last, tiled=True)
+            s, i = _lex_topk(gs_, gi_, min(k, gs_.shape[ax_last]))
+        H = mesh_hosts
+        if H == 1:
+            return _lex_topk(s, i, k)
+        pad_c = lambda w: -(-w // CPH) * CPH     # noqa: E731
+
+        def _padw(s, i, w):
+            d = w - s.shape[ax_last]
+            if d <= 0:
+                return s, i
+            pads = [(0, 0)] * ax_last + [(0, d)]
+            return (jnp.pad(s, pads, constant_values=NEG_INF),
+                    jnp.pad(i, pads,
+                            constant_values=jnp.int32(2 ** 30)))
+
+        def _slice(x):
+            wl = x.shape[ax_last] // CPH
+            ci = lax.axis_index(chip_ax)
+            return lax.dynamic_slice_in_dim(x, ci * wl, wl, axis=ax_last)
+
+        kp = pad_c(min(k, NT))
+        s, i = _padw(s, i, pad_c(s.shape[ax_last]))
+        if H & (H - 1) == 0:
+            # DCN tournament: round r exchanges with the host at
+            # distance 2^r; widths grow toward kp so no candidate that
+            # could reach the global top-k is ever truncated
+            for r in range(H.bit_length() - 1):
+                d = 1 << r
+                perm = [(x, x ^ d) for x in range(H)]
+                ps = lax.ppermute(_slice(s), host_ax, perm)
+                pi = lax.ppermute(_slice(i), host_ax, perm)
+                fs = lax.all_gather(ps, chip_ax, axis=ax_last,
+                                    tiled=True)
+                fi = lax.all_gather(pi, chip_ax, axis=ax_last,
+                                    tiled=True)
+                w = min(kp, 2 * s.shape[ax_last])
+                s, i = _lex_topk(jnp.concatenate([s, fs], axis=ax_last),
+                                 jnp.concatenate([i, fi], axis=ax_last),
+                                 w)
+                s, i = _padw(s, i, pad_c(w))
+            return _lex_topk(s, i, k)
+        # non-pow2 host count: one sliced all-gather over DCN, slices
+        # reassembled over ICI, single merge (order-free — the lex sort
+        # below restores the tournament order)
+        gs_ = lax.all_gather(_slice(s), host_ax, axis=ax_last,
+                             tiled=True)
+        gi_ = lax.all_gather(_slice(i), host_ax, axis=ax_last,
+                             tiled=True)
+        fs = lax.all_gather(gs_, chip_ax, axis=ax_last, tiled=True)
+        fi = lax.all_gather(gi_, chip_ax, axis=ax_last, tiled=True)
+        return _lex_topk(fs, fi, k)
     # wider waves for bigger batches: a group may commit up to W
     # placements per wave, so a K-placement batch converges in O(K / W)
     # fused-wave iterations. Size W to ~2x the LARGEST per-group
@@ -383,7 +565,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # [Gp, C] explainability sums reduce once per solve; `feas`
         # itself stays a shard-local plane (reassembled by the caller's
         # out_spec when fetched at all)
-        cons_filtered = lax.psum(cons_filtered, mesh_axis)
+        cons_filtered = _psum_mesh(cons_filtered)
 
     # affinity matches are also placement-invariant: [Gp, Np]
     def per_ask_aff(g):
@@ -448,8 +630,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     node_gids = jnp.arange(Np, dtype=jnp.uint32)
     if in_mesh:
         # jitter hashes the GLOBAL node id so seeded scoring is
-        # invariant to how the node axis is split over the mesh
-        node_gids = node_gids + off.astype(jnp.uint32)
+        # invariant to how the node axis is split (or re-tiled) over
+        # the mesh
+        node_gids = g_of_local.astype(jnp.uint32)
     h = (node_gids[None, :] * jnp.uint32(2654435761)
          + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
             + jnp.uint32(seed)) * jnp.uint32(40503))
@@ -488,6 +671,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # shard narrower than TKv would pad tables past the local
         # plane; the "score" pass is the same exact math unfused and
         # lets the jnp extraction use the shard-local width
+        pallas_mode = "score"
+    if elastic and pallas_mode == "topk":
+        # the fused top-K tournament tie-breaks by LOCAL slot order,
+        # which under a tile remap is not global-id order; the "score"
+        # pass is the same exact math with extraction left to the
+        # gid-ordered lex sort below
         pallas_mode = "score"
     use_pk = pallas_mode != "off"
     if use_pk:
@@ -690,9 +879,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                      + spread_total) / n_scorers
             total = jnp.where(jnp.int32(seed) == 0, total,
                               jnp.floor(total / SCORE_BIN) * SCORE_BIN)
-            gid = idx.astype(jnp.uint32)
-            if in_mesh:
-                gid = gid + off.astype(jnp.uint32)
+            gid = (_l2g(idx) if in_mesh else idx).astype(jnp.uint32)
             h2 = (gid * jnp.uint32(2654435761)
                   + (gs.astype(jnp.uint32)[:, None] * jnp.uint32(7919)
                      + jnp.uint32(seed)) * jnp.uint32(40503))
@@ -784,8 +971,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             # python-style negatives before the drop check)
             chosen = jnp.where(committed, out_idx[:, 0], 0)
             if in_mesh:
-                chosen_l = chosen - off
-                chosen_l = jnp.where(chosen_l >= 0, chosen_l, Np)
+                _, chosen_l, _ = _g2l(chosen)
             else:
                 chosen_l = chosen
             coll = coll0.at[g_idx, chosen_l].add(
@@ -862,13 +1048,28 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             # far smaller perturbation than the reference's 14-node
             # subsample. Small problems (tests, dryruns) keep the exact
             # path.
-            if use_pk and pallas_mode == "topk":
+            if elastic:
+                # under a tile remap local slot order is NOT global-id
+                # order, so top_k's index tie-break would diverge from
+                # the host twin; extract by the explicit (score desc,
+                # GLOBAL id asc) lex key, carrying the local slot
+                gid_pl = jnp.broadcast_to(g_of_local[None, :],
+                                          (Gp, Np))
+                slot_pl = jnp.broadcast_to(
+                    jnp.arange(Np, dtype=jnp.int32)[None, :], (Gp, Np))
+                neg, eg, ei = lax.sort((-score, gid_pl, slot_pl),
+                                       num_keys=2)
+                ext_s, ext_g, ext_i = -neg[:, :NE], eg[:, :NE], \
+                    ei[:, :NE]
+            elif use_pk and pallas_mode == "topk":
                 ext_s, ext_i = pk["top_score"], pk["top_idx"]
             elif Np >= _APPROX_MIN_NP:
                 ext_s, ext_i = lax.approx_max_k(score, NE)
             else:
                 ext_s, ext_i = lax.top_k(score, NE)        # [Gp, NE]
             top_score, top_idx = ext_s[:, :TKl], ext_i[:, :TKl]
+            if elastic:
+                top_idx = ext_g[:, :TKl]       # window keys are GLOBAL
 
             # per-value candidate tables for the spread interleave
             # (applied to the window AFTER the cross-shard merge — see
@@ -891,7 +1092,10 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                     for v in range(Vs + 1):
                         vmask = (vnode == v) if v < Vs else (vnode < 0)
                         sv = jnp.where(vmask, score, NEG_INF)
-                        if Np >= _APPROX_MIN_NP:
+                        if elastic:
+                            # gid-ordered ties, ids leave GLOBAL
+                            ts, ti = _lex_topk(sv, gid_pl, TW)
+                        elif Np >= _APPROX_MIN_NP:
                             ts, ti = lax.approx_max_k(sv, TW)
                         else:
                             ts, ti = lax.top_k(sv, TW)
@@ -899,12 +1103,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                         tabs_s.append(ts)
                     tab_i = jnp.stack(tabs_i, axis=1)      # [Gp, V+1, TW]
                     tab_s = jnp.stack(tabs_s, axis=1)
-                if in_mesh:
+                if in_mesh and not elastic:
                     tab_i = tab_i + off
             else:
                 tab_s = jnp.full((Gp, 1, 1), NEG_INF, jnp.float32)
                 tab_i = jnp.zeros((Gp, 1, 1), jnp.int32)
-            if in_mesh:
+            if in_mesh and not elastic:
                 # window keys leave the shard with GLOBAL node ids
                 top_idx = top_idx + off
 
@@ -929,7 +1133,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                     vn=vn, de=de,
                     coll=jnp.take_along_axis(coll, sl_i, axis=1),
                     cut_s=ext_s[:, NE - 1],
-                    cut_i=ext_i[:, NE - 1],
+                    # era cutoff tie-break key: GLOBAL id under the
+                    # elastic remap (the extraction's lex order), local
+                    # slot otherwise (identical — the block map is
+                    # monotonic)
+                    cut_i=(ext_g[:, NE - 1] if elastic
+                           else ext_i[:, NE - 1]),
                     comp=(n_feas_g - n_exh_g) <= jnp.int32(C),
                     nfeas=n_feas_g, nexh=n_exh_g, ndim=dim_exh_g,
                     win_s=top_score, win_i=top_idx,
@@ -965,21 +1174,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         # so shards may mix carried/full waves freely — each shard's
         # contribution is trigger-proven exact either way.
         if in_mesh:
-            gw_s = lax.all_gather(top_score, mesh_axis, axis=1,
-                                  tiled=True)   # [Gp, TKl * shards]
-            gw_i = lax.all_gather(top_idx, mesh_axis, axis=1,
-                                  tiled=True)
-            top_score, top_idx = _lex_topk(gw_s, gw_i, TK)
+            top_score, top_idx = _merge_mesh(top_score, top_idx, TK)
             if want_tables:
-                gt_s = lax.all_gather(tab_s, mesh_axis, axis=2,
-                                      tiled=True)  # [Gp, V+1, TW*shards]
-                gt_i = lax.all_gather(tab_i, mesh_axis, axis=2,
-                                      tiled=True)
-                tab_s, tab_i = _lex_topk(gt_s, gt_i, TKv)
-            n_feas_out = lax.psum(n_feas_g, mesh_axis)
-            n_exh_out = lax.psum(n_exh_g, mesh_axis)
-            dim_exh_out = lax.psum(dim_exh_g, mesh_axis)
-            grp_any = lax.psum(grp_any.astype(jnp.int32), mesh_axis) > 0
+                tab_s, tab_i = _merge_mesh(tab_s, tab_i, TKv)
+            n_feas_out = _psum_mesh(n_feas_g)
+            n_exh_out = _psum_mesh(n_exh_g)
+            dim_exh_out = _psum_mesh(dim_exh_g)
+            grp_any = _psum_mesh(grp_any.astype(jnp.int32)) > 0
         else:
             n_feas_out, n_exh_out, dim_exh_out = (n_feas_g, n_exh_g,
                                                   dim_exh_g)
@@ -1136,18 +1337,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         if in_mesh:
             # candidate rows live on their owning shard: each shard
             # evaluates the fit for the <= K candidates it owns and the
-            # K-sized bit vectors reduce over ICI (candidate-only
-            # traffic — the [Np, R] planes stay put)
-            loc = cand - off
-            inb = (loc >= 0) & (loc < Np)
-            # scatter-safe local index: negative locals WRAP python-
-            # style before mode="drop" checks bounds, so pin every
-            # non-owned candidate to the (always-dropped) Np slot
-            loc = jnp.where(inb, loc, Np)
-            locc = jnp.clip(loc, 0, Np - 1)
+            # K-sized bit vectors reduce over the tiered interconnect
+            # (candidate-only traffic — the [Np, R] planes stay put).
+            # _g2l pins every non-owned candidate to the always-dropped
+            # Np slot (scatter WRAPS python-style negatives before
+            # mode="drop" checks bounds).
+            inb, loc, locc = _g2l(cand)
             fits_l = ((used[locc] + prior + ask_res[g_idx])
                       <= avail[locc]).all(axis=-1) & inb
-            fits = lax.psum(fits_l.astype(jnp.int32), mesh_axis) > 0
+            fits = _psum_mesh(fits_l.astype(jnp.int32)) > 0
         else:
             loc = locc = cand
             inb = None
@@ -1160,8 +1358,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 dev_fits_l = ((dev_used[locc] + prior_dev
                                + dev_ask[g_idx])
                               <= dev_cap[locc]).all(axis=-1) & inb
-                dev_fits = lax.psum(dev_fits_l.astype(jnp.int32),
-                                    mesh_axis) > 0
+                dev_fits = _psum_mesh(
+                    dev_fits_l.astype(jnp.int32)) > 0
             else:
                 dev_fits = ((dev_used[cand] + prior_dev + dev_ask[g_idx])
                             <= dev_cap[cand]).all(axis=-1)
@@ -1170,10 +1368,9 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         if in_mesh and has_spread:
             # one [K, A] psum-gather of the candidates' attribute-rank
             # rows serves both the spread quota and the commit below
-            ar_cand = lax.psum(
+            ar_cand = _psum_mesh(
                 jnp.where(inb[:, None],
-                          attr_rank[locc].astype(jnp.int32), 0),
-                mesh_axis)
+                          attr_rank[locc].astype(jnp.int32), 0))
         else:
             ar_cand = None
 
@@ -1355,17 +1552,21 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                                     jnp.clip(raw, 0.0, 18.0) / 18.0,
                                     0.0)
                 ev_score = jnp.where(ok_node, binpack, f32(NEG_INF))
-                ids = jnp.arange(Np, dtype=jnp.int32)
-                if in_mesh:
-                    ids = ids + off
+                ids = (g_of_local if in_mesh
+                       else jnp.arange(Np, dtype=jnp.int32))
                 ids2 = jnp.broadcast_to(ids[None, :], (Gp, Np))
-                nv_s2, nv_i2 = _lex_topk(ev_score, ids2, 1)
-                nv_s_l, nv_i_l = nv_s2[:, 0], nv_i2[:, 0]
+                slots2 = jnp.broadcast_to(
+                    jnp.arange(Np, dtype=jnp.int32)[None, :], (Gp, Np))
+                # lex top-1 by (score desc, GLOBAL id asc), carrying
+                # the local slot (under the elastic remap slot order is
+                # not id order, so the slot cannot be derived back)
+                neg_e, nv_i2, nv_l2 = lax.sort(
+                    (-ev_score, ids2, slots2), num_keys=2)
+                nv_s_l, nv_i_l = -neg_e[:, 0], nv_i2[:, 0]
                 # freed/picked at the LOCAL best node: the cross-shard
                 # winner is always some shard's local best, so the
                 # owner already holds its victim set
-                loc_best = (jnp.clip(nv_i_l - off, 0, Np - 1) if in_mesh
-                            else nv_i_l)
+                loc_best = nv_l2[:, 0]
                 sel_freed = freed[gs, loc_best]             # [Gp, R]
                 sel_mask = picked[gs, loc_best]             # [Gp, EV]
                 return nv_s_l, nv_i_l, sel_freed, sel_mask
@@ -1384,11 +1585,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 (used, dev_used, EVT))
 
             if in_mesh:
-                g_s = lax.all_gather(nv_s[:, None], mesh_axis, axis=1,
-                                     tiled=True)   # [Gp, shards]
-                g_i = lax.all_gather(nv_i[:, None], mesh_axis, axis=1,
-                                     tiled=True)
-                wv_s2, wv_i2 = _lex_topk(g_s, g_i, 1)
+                wv_s2, wv_i2 = _merge_mesh(nv_s[:, None],
+                                           nv_i[:, None], 1)
                 win_s, win_i = wv_s2[:, 0], wv_i2[:, 0]
             else:
                 win_s, win_i = nv_s, nv_i
@@ -1402,10 +1600,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             ev_commit = p_ok & (prior_rank_any(e_cand, p_ok) == 0)
             ecm = ev_commit[:, None]
             if in_mesh:
-                e_loc = e_cand - off
-                e_inb = (e_loc >= 0) & (e_loc < Np)
-                e_loc = jnp.where(e_inb, e_loc, Np)
-                e_locc = jnp.clip(e_loc, 0, Np - 1)
+                e_inb, e_loc, e_locc = _g2l(e_cand)
             else:
                 e_loc = e_locc = e_cand
                 e_inb = jnp.ones(K, bool)
@@ -1420,16 +1615,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             EVT = EVT | (jnp.zeros((Np, EV), jnp.int32).at[e_loc].add(
                 em_local.astype(jnp.int32), mode="drop") > 0)
             if in_mesh:
-                em_rep = lax.psum(em_local.astype(jnp.int32),
-                                  mesh_axis) > 0
+                em_rep = _psum_mesh(em_local.astype(jnp.int32)) > 0
             else:
                 em_rep = em_local
             if has_spread:
                 if in_mesh:
-                    ar_ev = lax.psum(
+                    ar_ev = _psum_mesh(
                         jnp.where(own,
                                   attr_rank[e_locc].astype(jnp.int32),
-                                  0), mesh_axis)
+                                  0))
                     evals_ = jnp.take_along_axis(
                         ar_ev, jnp.maximum(sp_col[g_idx], 0), axis=1)
                 else:
@@ -1538,7 +1732,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             # full-wave window may hold interleave entries outside the
             # shortlist — those drop here AND fail TR1, forcing the
             # rescore that rebuilds coll from the plane)
-            tloc = top_idx - off if in_mesh else top_idx
+            tloc = _g2l(top_idx)[1] if in_mesh else top_idx
             win_pos = jax.vmap(jnp.searchsorted)(SL.idx, tloc)
             pos_hit = jnp.take_along_axis(
                 SL.idx, jnp.minimum(win_pos, C - 1), axis=1) == tloc
@@ -1562,10 +1756,15 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                          - dim_pre.astype(jnp.int32)).sum(axis=1)
                 nexh_next = n_exh_g + d_exh
                 ndim_next = dim_exh_g + d_dim
-                w_s, w_i = _lex_topk(f_score, sl.idx, TKl)
+                # lex tie-break key: GLOBAL ids under the elastic remap
+                # (matching the building extraction and cut_i), local
+                # slots otherwise (the block map is monotonic)
+                sl_key = _l2g(sl.idx) if elastic else sl.idx
+                w_s, w_i = _lex_topk(f_score, sl_key, TKl)
                 # TR3: the re-ranked TKl-th key must still dominate the
                 # era cutoff — no frozen outsider can rank inside (both
-                # sides of the lex compare are shard-LOCAL node ids)
+                # sides of the lex compare use the same id space as
+                # cut_i)
                 ls, li = w_s[:, TKl - 1], w_i[:, TKl - 1]
                 tr3_g = (ls > sl.cut_s) | ((ls == sl.cut_s)
                                            & (li <= sl.cut_i))
@@ -1582,12 +1781,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                         vmask = ((vnode0 == v) if v < Vs
                                  else (vnode0 < 0))
                         sv = jnp.where(vmask, f_score, NEG_INF)
-                        ts, ti = _lex_topk(sv, sl.idx, TW)
+                        ts, ti = _lex_topk(sv, sl_key, TW)
                         tabs_s.append(ts)
                         tabs_i.append(ti)
                     tab_s = jnp.stack(tabs_s, axis=1)   # [Gp, V+1, TW]
                     tab_i = jnp.stack(tabs_i, axis=1)
-                    if in_mesh:
+                    if in_mesh and not elastic:
                         tab_i = tab_i + off
                 else:
                     tab_s = jnp.full((Gp, 1, 1), NEG_INF, jnp.float32)
@@ -1595,7 +1794,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 gany_next = jnp.where(sl.comp, f_place.any(axis=1),
                                       jnp.bool_(True))
                 ok_next = ((tr3_g | sl.comp) | ~act_next_g).all()
-                if in_mesh:
+                if in_mesh and not elastic:
                     w_i = w_i + off
                 return (w_s, w_i, tab_s, tab_i, nexh_next, ndim_next,
                         gany_next, ok_next)
@@ -1672,7 +1871,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     if in_mesh:
         # per-shard full-pass count summed over the mesh: the HBM byte
         # model multiplies bytes_wave1 (a PER-SHARD plane walk) by this
-        n_resc = (lax.psum(n_resc, mesh_axis) if use_sl
+        n_resc = (_psum_mesh(n_resc) if use_sl
                   else waves * jnp.int32(mesh_shards))
 
     return SolveResult(choice=out_idx, choice_ok=out_ok, score=out_score,

@@ -199,7 +199,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                has_distinct=True, has_devices=True, stack_commit=False,
                pallas_mode="off", shortlist_c=0, mesh_axis=None,
                mesh_shards=0, has_preempt=False, ev_res=None,
-               ev_prio=None):
+               ev_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
+               node_gid=None, owner_map=None, slot_map=None):
     # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
     # lanes, 1/8th the transport bytes of the dense bool planes);
     # unpack on device — dtype is static, so either form compiles once
@@ -233,7 +234,9 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         has_distinct=has_distinct, has_devices=has_devices,
         stack_commit=stack_commit, pallas_mode=pallas_mode,
         shortlist_c=shortlist_c, mesh_axis=mesh_axis,
-        mesh_shards=mesh_shards, **ev_kw)
+        mesh_shards=mesh_shards, mesh_hosts=mesh_hosts,
+        mesh_nt=mesh_nt, tile_np=tile_np, node_gid=node_gid,
+        owner_map=owner_map, slot_map=slot_map, **ev_kw)
 
 
 @functools.partial(jax.jit,
@@ -437,6 +440,11 @@ class ResidentSolver:
         #: bumps on every node-shape change; device-side stacked-batch
         #: caches are keyed on it so a stale ask plane is never reused
         self._node_epoch = 0
+        #: bumps whenever the EVICTION planes advance (alloc place/stop
+        #: deltas replay ev rows WITHOUT touching the node shape, so
+        #: the node epoch alone cannot invalidate ev-dependent caches —
+        #: ISSUE 8 satellite; see federated._stack_args)
+        self._ev_epoch = 0
         #: host bytes the LAST dispatch actually shipped (0 on a
         #: device-cached re-dispatch)
         self.last_dispatch_bytes = 0
@@ -626,6 +634,7 @@ class ResidentSolver:
             ev_slots = [s for s in ev_slots
                         if s < self.template.ev_prio.shape[0]]
             if ev_slots:
+                self._ev_epoch += 1
                 t = self.template
                 e_idx, (e_prio, e_res) = _pad(
                     np.asarray(ev_slots, np.int32),
@@ -715,6 +724,7 @@ class ResidentSolver:
                 apply_evict_ops(t, slot_ops(delta.stop),
                                 slot_ops(delta.place))
         self._node_epoch += 1
+        self._ev_epoch += 1
         self._row_cache.clear()
         self._drv_cache.clear()
         self._eval_cache.clear()

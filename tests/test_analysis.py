@@ -204,6 +204,33 @@ FIX_JIT = """
         body = functools.partial(meshy_partial_body, scale=2)
         g = shard_map(body, mesh=mesh, in_specs=None, out_specs=None)
         return f(x) + g(x)
+
+
+    HOST_AX = "hosts"
+
+
+    def two_tier_body(x):
+        # both axes bound by the enclosing ("hosts", "chips") mesh
+        s = jax.lax.psum(x, "chips")
+        return jax.lax.psum(s, HOST_AX)
+
+
+    def wrong_axis_body(x):
+        # the enclosing mesh binds hosts/chips, not the flat "nodes"
+        return jax.lax.psum(x, "nodes")                    # JIT205
+
+
+    def run_two_tier(devices, x):
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(devices).reshape(2, 2),
+                    ("hosts", "chips"))
+        f = shard_map(two_tier_body, mesh=mesh, in_specs=None,
+                      out_specs=None)
+        g = shard_map(wrong_axis_body, mesh=mesh, in_specs=None,
+                      out_specs=None)
+        return f(x) + g(x)
 """
 
 FIX_LOCKS = """
@@ -430,6 +457,17 @@ def test_jit_collective_outside_mesh_detected(fixture_report):
                for k in keys)
     assert all(":meshy_body:" not in k and ":meshy_helper:" not in k
                and ":meshy_partial_body:" not in k for k in keys)
+
+
+def test_jit_collective_axis_not_bound_by_mesh_detected(fixture_report):
+    """ISSUE 8: under a statically-resolvable ("hosts", "chips") mesh,
+    a collective naming an axis the ENCLOSING context does not bind is
+    flagged; literal and module-constant spellings of the bound axes
+    are quiet, and a mesh passed in as a parameter (run_meshy) keeps
+    the axis check silent rather than guessing."""
+    keys = _keys(fixture_report, "JIT205")
+    assert any(":wrong_axis_body:" in k for k in keys)
+    assert all(":two_tier_body:" not in k for k in keys)
 
 
 def test_jit_donated_carry_subscript_detected(fixture_report):

@@ -440,6 +440,22 @@ def test_bench_multichip_phase_cannot_silently_skip():
     assert rec["mesh_resident_s"] > 0
     assert rec["stateless_wrapper_s"] > 0
     assert rec["measured"]["waves_total"] > 0
+    # ISSUE 8: the dcn_tier leg + kill-one-shard recovery probe ride
+    # the same phase (4-host simulated grouping on the CPU mesh)
+    assert out["n_hosts"] == 4
+    dcn = rec["dcn_tier"]
+    assert dcn["placements_match_flat"]
+    # the <= 1/4 acceptance holds at config-3 scale (see
+    # tests/test_elastic_mesh.py and MULTICHIP_DETAIL.json's real
+    # sizes); this smoke shape (512 nodes) is commit-psum dominated,
+    # so only the ordering is asserted here
+    assert dcn["bytes_dcn_per_wave"] < dcn["flat_dcn_per_wave"]
+    assert dcn["dcn_cut_vs_flat"] < 0.5
+    probe = rec["recovery_probe"]
+    assert probe["degraded_on_fast_path"]
+    assert probe["recovery_bytes"] > 0
+    assert probe["recovery_s"] >= 0
+    assert probe["grow_bytes_measured"] > 0
 
 
 def test_federated_stack_cache_keyed_on_node_epoch():
@@ -467,3 +483,31 @@ def test_federated_stack_cache_keyed_on_node_epoch():
     after = fed._stack_args(batches, 1)
     assert after is not first, (
         "node epoch moved but the cached stack was served")
+
+
+def test_federated_stack_cache_keyed_on_ev_epoch():
+    """ISSUE 8 satellite: a pure alloc place/stop delta replays the
+    PR-7 eviction-plane rows WITHOUT moving the node epoch — the
+    federated step cache must still miss (it keys on the evict-plane
+    epoch too), so no future ev plumbing can ever serve rows from
+    before the replay."""
+    nodes_a = [make_node(i) for i in range(12)]
+    nodes_b = [make_node(100 + i) for i in range(12)]
+    probe = [make_ask()]
+    fed = FederatedResidentSolver([nodes_a, nodes_b], probe,
+                                  gp=4, kp=16, evict_e=4)
+    asks = [make_ask(count=2)]
+    batches = [[fed.pack_batch(r, asks)] for r in range(2)]
+    first = fed._stack_args(batches, 1)
+    assert fed._stack_args(batches, 1) is first
+    delta = ClusterDelta()
+    delta.place.append((nodes_a[0].id, make_alloc(cpu=100)))
+    node_ep = fed.solvers[0]._node_epoch
+    ev_ep = fed.solvers[0]._ev_epoch
+    fed.solvers[0].apply_delta(delta)
+    # premise: the delta touched ev rows only, never the node planes
+    assert fed.solvers[0]._node_epoch == node_ep
+    assert fed.solvers[0]._ev_epoch == ev_ep + 1
+    after = fed._stack_args(batches, 1)
+    assert after is not first, (
+        "evict-plane epoch moved but the cached stack was served")
