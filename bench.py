@@ -1929,13 +1929,23 @@ def lint_summary():
     counts), recorded in BENCH_DETAIL so every benchmark carries the
     lint state it was measured under."""
     try:
-        from nomad_tpu.analysis import ANALYZER_VERSION, analyze
+        from nomad_tpu.analysis import ANALYZER_VERSION, analyze, \
+            pass_of
         rep = analyze()
+        baselined_by_pass = {}
+        for f in rep.suppressed:
+            p = pass_of(f.rule)
+            baselined_by_pass[p] = baselined_by_pass.get(p, 0) + 1
         return {"version": ANALYZER_VERSION,
                 "unsuppressed": len(rep.findings),
+                "errors": len(rep.errors),
+                "warnings": len(rep.warnings),
                 "baselined": len(rep.suppressed),
                 "stale_baseline_keys": rep.stale_baseline_keys,
-                "by_rule": rep.counts_by_rule()}
+                "by_rule": rep.counts_by_rule(),
+                "by_pass": rep.counts_by_pass(),
+                "baselined_by_pass": dict(sorted(
+                    baselined_by_pass.items()))}
     except Exception as e:          # never lose the run over lint
         return {"error": str(e)}
 

@@ -1,8 +1,10 @@
-"""nomadlint: AST-driven invariant analyzer for the nomad_tpu package.
+"""nomadlint: static invariant analyzer for the nomad_tpu package.
 
-Three passes over a module-level call graph (no imports are executed —
-everything is `ast` on source text, so the analyzer runs without JAX or
-a device):
+Six passes over a module-level call graph plus a dataflow layer
+(def-use chains, buffer-identity provenance, interprocedural
+summaries — see dataflow.py). No analyzed module is ever imported:
+everything is `ast` on source text, so the analyzer runs without JAX
+or a device.
 
   * FSM determinism (fsm_pass):   the raft apply path must be
     bit-deterministic across replicas — no wall clock, no randomness,
@@ -16,10 +18,24 @@ a device):
     server plane must be written under their class lock; racy getters,
     unlocked module-global mutation and lock-ordering cycles are
     flagged.
+  * SPMD partition safety (shard_pass): no plain-jit scatters on
+    NamedSharding-sharded operands (the GSPMD double-apply class), no
+    ownership-mask-free scatters in shard_map bodies, no contiguous-
+    block axis arithmetic that breaks under elastic TileLayout remaps.
+  * buffer aliasing / donation lifetime (alias_pass): no host mutation
+    of buffers that flowed uncopied into device_put (the PR-5 zero-
+    copy double-charge), no reads through transitively-donated
+    carries (sharpens JIT204 across wrapper layers).
+  * cross-backend scoring drift (score_pass): the four float-order-
+    exact scorer replicas (host twin, kernel twin, shortlist _sl_eval,
+    pallas fused pass) plus the native C++ source are fingerprinted
+    per term and must agree; scoring-shaped arithmetic outside the
+    registered sites is flagged.
 
 Checked-in suppressions live in baseline.toml next to this file; every
 entry must carry a non-empty justification. Run `python -m
-nomad_tpu.analysis`; exit 0 means zero unsuppressed findings.
+nomad_tpu.analysis`; exit 0 means zero unsuppressed findings (exit 3:
+warn-tier only; exit 2: baseline error — see __main__).
 See STATIC_ANALYSIS.md at the repo root for the rule catalog.
 """
 from __future__ import annotations
@@ -27,10 +43,11 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from .core import AnalysisConfig, Finding, PackageIndex, Report
+from .core import (AnalysisConfig, Finding, PackageIndex, Report,
+                   pass_of, severity_of)
 from .baseline import Baseline, BaselineError, load_baseline
 
-ANALYZER_VERSION = "1.0"
+ANALYZER_VERSION = "2.0"
 
 # the directory CONTAINING the nomad_tpu package (analysis/ -> pkg -> root)
 _PKG_DIR = os.path.dirname(os.path.dirname(
@@ -52,14 +69,24 @@ def analyze(package_dir: Optional[str] = None,
     from .fsm_pass import run_fsm_pass
     from .jit_pass import run_jit_pass
     from .lock_pass import run_lock_pass
+    from .shard_pass import run_shard_pass
+    from .alias_pass import run_alias_pass
+    from .score_pass import run_score_pass
+    from .dataflow import DataflowEngine
 
     package_dir = package_dir or _PKG_DIR
     cfg = config or AnalysisConfig()
     index = PackageIndex.build(package_dir, package_name)
+    engine = DataflowEngine(index, cfg)
     findings: List[Finding] = []
     findings += run_fsm_pass(index, cfg)
     findings += run_jit_pass(index, cfg)
     findings += run_lock_pass(index, cfg)
+    findings += run_shard_pass(index, cfg, engine)
+    # alias pass sees prior findings so ALIAS502 never double-reports
+    # a read JIT204 already covers
+    findings += run_alias_pass(index, cfg, engine, prior=findings)
+    findings += run_score_pass(index, cfg, package_dir=package_dir)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
 
     if baseline is None and use_baseline:
@@ -71,4 +98,5 @@ def analyze(package_dir: Optional[str] = None,
 
 __all__ = ["ANALYZER_VERSION", "AnalysisConfig", "Baseline",
            "BaselineError", "Finding", "PackageIndex", "Report",
-           "analyze", "default_baseline_path", "load_baseline"]
+           "analyze", "default_baseline_path", "load_baseline",
+           "pass_of", "severity_of"]

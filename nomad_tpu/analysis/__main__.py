@@ -1,5 +1,19 @@
-"""CLI: `python -m nomad_tpu.analysis` — exit 0 iff zero unsuppressed
-findings (baseline errors exit 2)."""
+"""CLI for nomadlint.
+
+Exit-code contract (stable, scripted against by CI):
+
+  0  no unsuppressed findings (clean, or everything baselined)
+  1  at least one unsuppressed ERROR-tier finding
+  2  baseline/config error (unjustified entry, unreadable file)
+  3  unsuppressed WARN-tier findings only (advisory heuristics:
+     LOCK302 / SHARD403 / ALIAS503 / SCORE603)
+
+`--no-baseline` is a REPORTING mode, not a gating mode: it lists every
+finding (each tagged with whether the checked-in baseline would
+suppress it) but the exit code is still computed from the
+baseline-aware verdict — so `--no-baseline --json` in a CI pipeline
+does not fail a clean tree just because accepted findings exist.
+"""
 from __future__ import annotations
 
 import argparse
@@ -7,53 +21,99 @@ import json
 import sys
 
 from . import (ANALYZER_VERSION, BaselineError, analyze,
-               default_baseline_path, load_baseline)
+               default_baseline_path, load_baseline, pass_of)
+
+
+def _exit_code(rep) -> int:
+    if rep.errors:
+        return 1
+    if rep.warnings:
+        return 3
+    return 0
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m nomad_tpu.analysis",
         description="nomadlint: FSM determinism / jit purity / lock "
-                    "discipline analyzer")
+                    "discipline / SPMD partition safety / buffer "
+                    "aliasing / scoring drift analyzer",
+        epilog="exit codes: 0 clean, 1 unsuppressed errors, "
+               "2 baseline error, 3 unsuppressed warnings only")
     ap.add_argument("--no-baseline", action="store_true",
-                    help="report every finding, ignoring baseline.toml")
+                    help="report every finding (tagged with its "
+                         "baseline status); the EXIT CODE still "
+                         "follows the baseline-aware verdict")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--baseline", default=None,
                     help="alternate baseline file "
                          f"(default: {default_baseline_path()})")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="rewrite the baseline file without entries "
+                         "that no longer match any finding")
     args = ap.parse_args(argv)
 
+    bl_path = args.baseline or default_baseline_path()
     try:
-        baseline = None
-        if not args.no_baseline:
-            path = args.baseline or default_baseline_path()
-            baseline = load_baseline(path)
-        rep = analyze(baseline=baseline, use_baseline=not args.no_baseline)
+        baseline = load_baseline(bl_path)
+        rep = analyze(baseline=baseline)
     except BaselineError as e:
         print(f"baseline error: {e}", file=sys.stderr)
         return 2
+    except OSError as e:
+        if args.baseline is not None:
+            print(f"baseline error: {e}", file=sys.stderr)
+            return 2
+        baseline = None
+        rep = analyze(use_baseline=False)
+
+    if args.prune_stale and rep.stale_baseline_keys:
+        pruned = baseline.without(rep.stale_baseline_keys)
+        pruned.save(bl_path)
+        print(f"pruned {len(rep.stale_baseline_keys)} stale baseline "
+              f"entr{'y' if len(rep.stale_baseline_keys) == 1 else 'ies'}"
+              f" from {bl_path}", file=sys.stderr)
+        rep = analyze(baseline=pruned)
+
+    shown = (rep.findings + rep.suppressed) if args.no_baseline \
+        else rep.findings
+    shown = sorted(shown, key=lambda f: (f.path, f.line, f.rule))
+    suppressed_keys = {id(f) for f in rep.suppressed}
 
     if args.json:
         print(json.dumps({
             "version": rep.version,
-            "unsuppressed": [vars(f) | {"key": f.key}
-                             for f in rep.findings],
+            "unsuppressed": [
+                vars(f) | {"key": f.key, "severity": f.severity,
+                           "pass": pass_of(f.rule),
+                           "baselined": id(f) in suppressed_keys}
+                for f in shown],
             "suppressed": len(rep.suppressed),
             "stale_baseline_keys": rep.stale_baseline_keys,
+            "stale_suggestions": rep.stale_suggestions,
             "by_rule": rep.counts_by_rule(),
+            "by_pass": rep.counts_by_pass(),
+            "errors": len(rep.errors),
+            "warnings": len(rep.warnings),
+            "exit_code": _exit_code(rep),
         }, indent=1))
     else:
-        for f in rep.findings:
-            print(f.render())
+        for f in shown:
+            tag = " [baselined]" if id(f) in suppressed_keys else ""
+            sev = "" if f.severity == "error" else " (warn)"
+            print(f.render() + tag + sev)
         for k in rep.stale_baseline_keys:
-            print(f"warning: stale baseline entry matches nothing: {k}",
-                  file=sys.stderr)
+            near = rep.stale_suggestions.get(k)
+            extra = f" (nearest current key: {near})" if near else ""
+            print("warning: stale baseline entry matches nothing: "
+                  f"{k}{extra}", file=sys.stderr)
         print(f"nomadlint v{rep.version}: "
-              f"{len(rep.findings)} finding(s), "
+              f"{len(rep.errors)} error(s), "
+              f"{len(rep.warnings)} warning(s), "
               f"{len(rep.suppressed)} baselined"
               + (f" [{rep.counts_by_rule()}]" if rep.findings else ""))
-    return 0 if rep.ok else 1
+    return _exit_code(rep)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,201 @@
+"""Pass 5: buffer aliasing and donation lifetime (ALIAS5xx).
+
+Two shipped bugs motivate this pass. PR-5 found that on the CPU
+backend `jax.device_put(arr)` can alias the numpy buffer ZERO-COPY:
+a host-side in-place update of the template array then leaks into the
+device carry and double-charges usage, depending on nothing more than
+heap alignment (`ResidentSolver._put_node` now copies first — this
+pass keeps it that way). PR-4's donated-carry bug read a buffer that
+had already been passed at a `donate_argnums` position of a dispatch
+two wrapper layers down — one hop deeper than JIT204's wrapper scan
+can see.
+
+Rules
+  ALIAS501  host in-place mutation of a buffer that previously flowed
+            into `device_put` WITHOUT a copy (`np.asarray`, dtype
+            casts and slicing are identity-preserving and do not
+            count). Checked order-sensitively within a function and
+            order-insensitively across the methods of a class (the
+            put-in-__init__ / mutate-in-apply shape).
+  ALIAS502  read of a buffer after it was passed into a TRANSITIVELY
+            donating call chain — the dataflow donation fixpoint
+            follows parameter positions through any number of wrapper
+            layers, subsuming and sharpening JIT204 (which stays for
+            the direct/one-hop cases; ALIAS502 reports only what
+            JIT204 cannot see, so nothing is double-reported).
+  ALIAS503  `self.<attr> = device_put(<parameter>)` without a copy:
+            the caller retains a live handle to the exact buffer now
+            aliased by long-lived device state. Nothing mutates it
+            *in this package*, but the contract is one caller `+=`
+            away from the ALIAS501 double-charge.  (warn tier)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisConfig, Finding, PackageIndex
+from .dataflow import DataflowEngine
+from .jit_pass import _check_donated_reads, find_jit_roots
+
+
+def run_alias_pass(index: PackageIndex, cfg: AnalysisConfig,
+                   engine: Optional[DataflowEngine] = None,
+                   prior: Sequence[Finding] = ()) -> List[Finding]:
+    engine = engine or DataflowEngine(index, cfg)
+    findings: List[Finding] = []
+    findings += _alias501_local(index, engine)
+    findings += _alias501_class(index, engine)
+    findings += _alias503(index, engine)
+    findings += _alias502(index, cfg, engine, prior)
+    return findings
+
+
+# ------------------------------------------------------------ ALIAS501
+def _alias501_local(index: PackageIndex,
+                    engine: DataflowEngine) -> List[Finding]:
+    """Within one function, in source order: device_put of an uncopied
+    buffer, then an in-place mutation of the same buffer."""
+    findings: List[Finding] = []
+    for fkey, fi in sorted(index.functions.items()):
+        fl = engine.flow(fkey, bound_cls=_own_class(index, fi))
+        if not fl.puts or not fl.mutations:
+            continue
+        for put in fl.puts:
+            if put.src.copied or not (put.src.atoms or put.src.key):
+                continue
+            for mut in fl.mutations:
+                if mut.line <= put.line:
+                    continue
+                if _same_buffer(put.src.atoms, put.src.key,
+                                mut.target.atoms, mut.target.key):
+                    sym = put.src.key or sorted(put.src.atoms)[0]
+                    findings.append(Finding(
+                        "ALIAS501", fi.module, fi.qual, sym, fi.path,
+                        mut.line,
+                        f"in-place mutation of `{sym}` after it flowed "
+                        f"into device_put on line {put.line} without a "
+                        "copy; on the CPU backend device_put can alias "
+                        "the numpy buffer zero-copy, so the device "
+                        "carry sees the host write too (the PR-5 "
+                        "usage double-charge)",
+                        hint="device_put(np.array(x)) — copy before "
+                             "placing — or stop mutating the host "
+                             "buffer after shipping it"))
+                    break
+    return findings
+
+
+def _alias501_class(index: PackageIndex,
+                    engine: DataflowEngine) -> List[Finding]:
+    """Across the methods of one concrete class: some method ships
+    `self.<a>` (or a buffer it aliases) uncopied, another mutates it
+    in place."""
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for ckey in sorted(index.classes):
+        facts = engine.class_facts(ckey)
+        for attr, fact in sorted(facts.items()):
+            if not fact.uncopied_puts or not fact.mutations:
+                continue
+            put_fkey, put_line = fact.uncopied_puts[0]
+            for mut_fkey, mut_line, desc in fact.mutations:
+                if mut_fkey == put_fkey and mut_line <= put_line:
+                    continue     # already covered order-sensitively
+                mfi = index.functions[mut_fkey]
+                key = f"{mut_fkey}:{mut_line}:{attr}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                pfi = index.functions[put_fkey]
+                findings.append(Finding(
+                    "ALIAS501", mfi.module, mfi.qual, attr, mfi.path,
+                    mut_line,
+                    f"in-place mutation ({desc}) of `self.{attr}`, "
+                    "which flows into device_put without a copy in "
+                    f"{pfi.qual} ({pfi.path}:{put_line}); through a "
+                    "zero-copy alias the device carry sees both the "
+                    "host write and the device scatter",
+                    hint="copy at the device_put site "
+                         "(device_put(np.array(...))) or make the "
+                         "host update produce a fresh array"))
+                break
+    return findings
+
+
+def _same_buffer(atoms_a, key_a, atoms_b, key_b) -> bool:
+    if atoms_a & atoms_b:
+        return True
+    if key_a and key_b:
+        return (key_a == key_b or key_b.startswith(key_a + "[")
+                or key_a.startswith(key_b + "["))
+    return False
+
+
+def _own_class(index: PackageIndex, fi) -> Optional[str]:
+    return f"{fi.module}:{fi.cls}" if fi.cls else None
+
+
+# ------------------------------------------------------------ ALIAS503
+def _alias503(index: PackageIndex,
+              engine: DataflowEngine) -> List[Finding]:
+    findings: List[Finding] = []
+    for fkey, fi in sorted(index.functions.items()):
+        fl = engine.flow(fkey, bound_cls=_own_class(index, fi))
+        for put in fl.puts:
+            if put.stored_attr is None or put.src.copied:
+                continue
+            params = sorted(a[6:] for a in put.src.atoms
+                            if a.startswith("param:"))
+            if not params:
+                continue
+            findings.append(Finding(
+                "ALIAS503", fi.module, fi.qual, put.stored_attr,
+                fi.path, put.line,
+                f"`self.{put.stored_attr}` aliases caller-owned buffer "
+                f"`{params[0]}` through an uncopied device_put; the "
+                "caller keeps a live handle to device-resident state",
+                hint="device_put(np.array(...)) to sever the alias at "
+                     "the boundary"))
+    return findings
+
+
+# ------------------------------------------------------------ ALIAS502
+def _alias502(index: PackageIndex, cfg: AnalysisConfig,
+              engine: DataflowEngine,
+              prior: Sequence[Finding]) -> List[Finding]:
+    donation = engine.donation_map()
+    if not donation:
+        return []
+    # what JIT204 already covers: direct donating roots and their
+    # one-hop wrappers (jit_pass's wrapper scan)
+    direct: Dict[str, Tuple[int, ...]] = {}
+    for r in find_jit_roots(index):
+        if r.donate:
+            direct[r.fkey] = r.donate
+    one_hop: Set[str] = set()
+    for fkey, fi in index.functions.items():
+        if fi.parent is None and index.callees(fkey) & set(direct):
+            one_hop.add(fkey)
+    prior_sites = {(f.path, f.line, f.symbol) for f in prior
+                   if f.rule == "JIT204"}
+
+    findings: List[Finding] = []
+    for fkey, fi in sorted(index.functions.items()):
+        callees = index.callees(fkey)
+        targets = {c: donation[c] for c in callees
+                   if c in donation and c not in direct
+                   and c not in one_hop}
+        if not targets:
+            continue
+        for f in _check_donated_reads(index, fi, targets,
+                                      rule="ALIAS502"):
+            if (f.path, f.line, f.symbol) in prior_sites:
+                continue
+            findings.append(Finding(
+                f.rule, f.module, f.func, f.symbol, f.path, f.line,
+                f.message + " (donation reaches this call through a "
+                "multi-hop wrapper chain the direct JIT204 scan "
+                "cannot see)",
+                hint=f.hint))
+    return findings

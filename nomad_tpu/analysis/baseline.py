@@ -26,6 +26,20 @@ class BaselineError(Exception):
     pass
 
 
+_HEADER = """\
+# nomadlint baseline: accepted pre-existing findings.
+#
+# Keys match Finding.key = "RULE:module:qualname:symbol" (fnmatch
+# wildcards allowed). Every entry MUST explain why the finding is
+# accepted — the analyzer refuses to load entries without a
+# justification. Remove entries as the underlying code is fixed; stale
+# entries are reported as warnings (`--prune-stale` rewrites the file
+# without them).
+
+version = 1
+"""
+
+
 class Baseline:
     def __init__(self, entries: List[Dict[str, str]]):
         self.entries = entries
@@ -41,6 +55,28 @@ class Baseline:
             if fnmatch.fnmatchcase(finding_key, e["key"]):
                 return e["key"]
         return None
+
+    def without(self, dead_keys) -> "Baseline":
+        dead = set(dead_keys)
+        return Baseline([e for e in self.entries
+                         if e["key"] not in dead])
+
+    def render(self) -> str:
+        """Regenerate the TOML-subset text (used by --prune-stale)."""
+        parts = [_HEADER]
+        for e in self.entries:
+            parts.append("\n[[suppress]]")
+            for k in ("rule", "key", "justification"):
+                if k in e:
+                    parts.append(f'{k} = "{e[k]}"')
+            for k in sorted(e):
+                if k not in ("rule", "key", "justification"):
+                    parts.append(f'{k} = "{e[k]}"')
+        return "\n".join(parts) + "\n"
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.render())
 
 
 def _parse_scalar(raw: str, path: str, lineno: int):

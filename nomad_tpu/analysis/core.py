@@ -16,9 +16,33 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import difflib
 import fnmatch
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# severity tiers: "error" findings gate CI (exit 1); "warn" findings
+# are advisory heuristics (exit 3 when they are the only findings).
+# Everything not listed here is an error.
+WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503", "SCORE603"})
+
+# rule-id prefix -> pass name (used by --json/by_pass and bench's
+# lint_summary so BENCH_DETAIL records per-pass lint state)
+RULE_PASSES: Tuple[Tuple[str, str], ...] = (
+    ("FSM", "fsm"), ("JIT", "jit"), ("LOCK", "lock"),
+    ("SHARD", "shard"), ("ALIAS", "alias"), ("SCORE", "score"),
+)
+
+
+def severity_of(rule: str) -> str:
+    return "warn" if rule in WARN_RULES else "error"
+
+
+def pass_of(rule: str) -> str:
+    for prefix, name in RULE_PASSES:
+        if rule.startswith(prefix):
+            return name
+    return "other"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +62,10 @@ class Finding:
         unrelated edits don't invalidate entries."""
         return f"{self.rule}:{self.module}:{self.func}:{self.symbol}"
 
+    @property
+    def severity(self) -> str:
+        return severity_of(self.rule)
+
     def render(self) -> str:
         loc = f"{self.path}:{self.line}"
         out = f"{loc}: {self.rule} [{self.module}:{self.func}] {self.message}"
@@ -52,6 +80,11 @@ class Report:
     findings: List[Finding]          # unsuppressed
     suppressed: List[Finding]
     stale_baseline_keys: List[str]   # baseline entries matching nothing
+    # stale key -> nearest current finding key (rename forensics: a
+    # mid-PR file/function rename silently strands baseline entries;
+    # the nearest miss names the probable new spelling)
+    stale_suggestions: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
 
     @classmethod
     def build(cls, findings: Sequence[Finding], baseline,
@@ -67,13 +100,35 @@ class Report:
             else:
                 kept.append(f)
         stale = [k for k in baseline.keys() if k not in used]
-        return cls(version, kept, supp, stale)
+        all_keys = sorted({f.key for f in findings})
+        suggestions: Dict[str, str] = {}
+        for k in stale:
+            near = difflib.get_close_matches(k, all_keys, n=1,
+                                             cutoff=0.5)
+            if near:
+                suggestions[k] = near[0]
+        return cls(version, kept, supp, stale, suggestions)
 
     def counts_by_rule(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
         for f in self.findings:
             out[f.rule] = out.get(f.rule, 0) + 1
         return dict(sorted(out.items()))
+
+    def counts_by_pass(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            p = pass_of(f.rule)
+            out[p] = out.get(p, 0) + 1
+        return dict(sorted(out.items()))
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warn"]
 
     @property
     def ok(self) -> bool:
@@ -100,6 +155,18 @@ class AnalysisConfig:
         "nomad_tpu.server", "nomad_tpu.state", "nomad_tpu.rpc",
         "nomad_tpu.raft", "nomad_tpu.solver",
     )
+    # SHARD401: scatter helpers whose jit body is built dynamically
+    # (defeating call resolution), as "module:qualname@param_pos" —
+    # passing a NamedSharding-sharded operand at that position outside
+    # shard_map is the GSPMD double-apply hazard.
+    scatter_helpers: Tuple[str, ...] = (
+        "nomad_tpu.solver.kernel:delta_scatter_set@0",
+        "nomad_tpu.solver.kernel:delta_scatter_add@0",
+    )
+    # SCORE6xx: override of the scoring-site registry (None = the
+    # package registry in score_pass.DEFAULT_SCORER_SITES); tests
+    # point this at synthetic fixture backends.
+    scorer_sites: Optional[Tuple] = None
 
 
 class FuncInfo:
@@ -405,8 +472,14 @@ class PackageIndex:
         return self.classes.get(f"{fi.module}:{fi.cls}")
 
     def _local_imports(self, fi: FuncInfo) -> Dict[str, str]:
-        out: Dict[str, str] = {}
-        _collect_imports(fi.module, ast.walk(fi.node), out)
+        cache = getattr(self, "_li_cache", None)
+        if cache is None:
+            cache = self._li_cache = {}
+        out = cache.get(fi.key)
+        if out is None:
+            out = {}
+            _collect_imports(fi.module, ast.walk(fi.node), out)
+            cache[fi.key] = out
         return out
 
     def _param_annotations(self, fi: FuncInfo) -> Dict[str, str]:
@@ -422,6 +495,12 @@ class PackageIndex:
     def _local_var_types(self, fi: FuncInfo) -> Dict[str, str]:
         """Single-pass local inference: `x = Cls(...)` / annotated
         params."""
+        cache = getattr(self, "_lvt_cache", None)
+        if cache is None:
+            cache = self._lvt_cache = {}
+        cached = cache.get(fi.key)
+        if cached is not None:
+            return cached
         mi = self.modules[fi.module]
         ann = self._param_annotations(fi)
         out = dict(ann)
@@ -431,6 +510,7 @@ class PackageIndex:
                 t = self._expr_class(mi, ann, node.value)
                 if t:
                     out.setdefault(node.targets[0].id, t)
+        cache[fi.key] = out
         return out
 
     def resolve_call(self, fi: FuncInfo, call: ast.Call,

@@ -592,8 +592,8 @@ def _is_host_effect(name: str) -> bool:
 
 
 def _check_donated_reads(index: PackageIndex, fi,
-                         targets: Dict[str, Tuple[int, ...]]
-                         ) -> List[Finding]:
+                         targets: Dict[str, Tuple[int, ...]],
+                         rule: str = "JIT204") -> List[Finding]:
     """Linear scan of the caller: after a call that donates `name` (or
     self-contained subscript), a load of the same expression without an
     intervening rebind is a read of a dead buffer."""
@@ -670,7 +670,7 @@ def _check_donated_reads(index: PackageIndex, fi,
             if rebind_line is not None and ln >= rebind_line:
                 continue
             findings.append(Finding(
-                "JIT204", fi.module, fi.qual, key, fi.path, ln,
+                rule, fi.module, fi.qual, key, fi.path, ln,
                 f"`{key}` is read after being passed at a donated "
                 f"position on line {cline}; the buffer is dead once "
                 "the call dispatches",

@@ -1,0 +1,507 @@
+"""Pass 6: cross-backend scoring drift (SCORE6xx).
+
+The exact scorer is replicated float-order-exact in FOUR backends —
+the numpy host twin (`host.group_scores`), the jit kernel twin
+(`kernel.group_scores`), the shortlist VMEM twin
+(`kernel._sl_eval`), the pallas fused pass (`_wave_tile_kernel`) —
+plus the native C++ engine (`host_solve.cc`). Every new scoring term
+must land in all of them with the same constants and the same float-op
+structure, or placements silently diverge between backends (ROADMAP
+item 5 names this replication the main drag on the learned-scorer and
+in-kernel-preemption work).
+
+This pass normalizes each REGISTERED scorer site into a canonical
+per-term float-op fingerprint and fails on structural divergence:
+
+  * terms are groups of assignments to canonical names (`free_cpu`/
+    `free_mem`, `raw`+`binpack`, `anti`, `pen*`, `n_scorers`,
+    `total`);
+  * a term fingerprint is the multiset of float CONSTANTS plus the
+    counts of arithmetic ops (+ - * / ** neg) in those assignments —
+    leaf variable names, indexing and where/select CONDITIONS are
+    excluded (they legitimately differ between vectorized numpy,
+    pallas refs and scalar C++), cast wrappers (`f32(...)`,
+    `.astype(...)`) are transparent;
+  * the native backend is tokenized from C++ source with a small
+    translation layer: `std::pow` -> `**`, `std::min(std::max(x,a),b)`
+    -> `clip(a, b)`, ternaries drop their condition like `where`,
+    bool-to-float `(c ? 1.0f : 0.0f)` folds away like an implicit
+    cast, subscripts are stripped;
+  * the `spread` term is compared as a SET of core constants only —
+    its loop structure genuinely differs per backend (numpy
+    take_along_axis vs pallas select-sum vs scalar C++).
+
+Rules
+  SCORE601  a registered backend's term fingerprint diverges from the
+            reference backend (first site in the registry)
+  SCORE602  scoring-shaped arithmetic outside the registered sites: an
+            assignment combining two or more registered score terms
+            (the "new term hand-added in one backend, or a fifth
+            ad-hoc scorer" shape) — register the site or move the
+            logic into a registered scorer
+  SCORE603  a registered site no longer resolves (registry rot after a
+            rename/refactor: the drift check would go silently blind)
+            (warn tier)
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisConfig, Finding, FuncInfo, PackageIndex, \
+    _dotted
+
+# ---------------------------------------------------------- registry
+@dataclasses.dataclass(frozen=True)
+class ScorerSite:
+    backend: str          # "host" | "kernel" | "shortlist" | ...
+    kind: str             # "python" | "native"
+    site: str             # "module:qualname" fnmatch pattern, or a
+                          # package-relative source path for native
+    terms: Tuple[str, ...] = ()   # terms this backend must carry;
+                                  # empty = DEFAULT_TERMS
+
+
+DEFAULT_TERMS = ("free", "binpack", "anti", "pen", "n_scorers",
+                 "total", "spread")
+
+#: the scoring-site registry: ONE row per backend replica of the exact
+#: scorer. Adding a new backend scorer = adding a row here (and
+#: keeping its float ops term-identical); writing scoring arithmetic
+#: anywhere else trips SCORE602. The first row is the drift reference.
+DEFAULT_SCORER_SITES: Tuple[ScorerSite, ...] = (
+    ScorerSite("host", "python",
+               "nomad_tpu.solver.host:host_solve_kernel.group_scores"),
+    ScorerSite("kernel", "python",
+               "nomad_tpu.solver.kernel:solve_kernel.group_scores"),
+    ScorerSite("shortlist", "python",
+               "nomad_tpu.solver.kernel:solve_kernel._sl_eval"),
+    ScorerSite("pallas", "python",
+               "nomad_tpu.solver.pallas_kernel:_wave_tile_kernel"),
+    ScorerSite("native", "native",
+               os.path.join("nomad_tpu", "solver", "native",
+                            "host_solve.cc")),
+)
+
+# canonical term -> the assignment-target names that belong to it
+TERM_NAMES: Dict[str, Tuple[str, ...]] = {
+    "free": ("free_cpu", "free_mem"),
+    "binpack": ("raw", "binpack"),
+    "anti": ("anti",),
+    "pen": ("pen", "pen_score", "pen_sc"),
+    "n_scorers": ("n_scorers",),
+    "total": ("total",),
+    "spread": ("cur", "boost", "targeted", "delta_boost", "even",
+               "contrib", "spread_total", "sp_total", "minc", "maxc",
+               "desired"),
+}
+# terms compared as {const set} only (loop structure differs/backend)
+CONST_SET_TERMS = {"spread"}
+
+# where/select-family calls whose FIRST argument is a condition
+_COND_CALLS = {"where", "select"}
+# calls that are transparent casts
+_CAST_CALLS = {"f32", "float32", "int32", "astype", "asarray", "int8",
+               "int16", "uint32", "u32", "i32", "float", "f64",
+               "float64", "bool_"}
+# composite term names whose co-occurrence outside a registered site
+# is scoring-shaped arithmetic (SCORE602)
+_COMPOSITE_NAMES = {"binpack", "anti", "pen", "pen_score", "pen_sc",
+                    "aff_score", "aff_sc", "spread_total", "sp_total",
+                    "n_scorers"}
+
+
+@dataclasses.dataclass
+class TermPrint:
+    consts: Tuple[float, ...] = ()       # sorted multiset
+    ops: Tuple[Tuple[str, int], ...] = ()  # sorted (op, count)
+    const_set: Tuple[float, ...] = ()    # sorted set (spread policy)
+
+    def describe(self) -> str:
+        ops = ", ".join(f"{o}x{n}" for o, n in self.ops) or "-"
+        return f"ops[{ops}] consts{list(self.consts)}"
+
+
+# ====================================================== python extract
+class _PyPrinter:
+    """Collect one term-group fingerprint from python assignment
+    expressions."""
+
+    def __init__(self):
+        self.consts: List[float] = []
+        self.ops: Dict[str, int] = {}
+
+    def feed(self, node) -> None:
+        self._walk(node)
+
+    def _op(self, name: str) -> None:
+        self.ops[name] = self.ops.get(name, 0) + 1
+
+    def _walk(self, node) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (int, float)) \
+                    and not isinstance(node.value, bool):
+                self.consts.append(float(node.value))
+            return
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                        ast.USub):
+            # fold -1.0 into a constant; keep neg as an op otherwise
+            if isinstance(node.operand, ast.Constant) and isinstance(
+                    node.operand.value, (int, float)):
+                self.consts.append(-float(node.operand.value))
+                return
+            self._op("neg")
+            self._walk(node.operand)
+            return
+        if isinstance(node, ast.BinOp):
+            opname = {ast.Add: "add", ast.Sub: "sub", ast.Mult: "mul",
+                      ast.Div: "div", ast.Pow: "pow"}.get(
+                          type(node.op))
+            if opname:
+                self._op(opname)
+            self._walk(node.left)
+            self._walk(node.right)
+            return
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            last = name.rsplit(".", 1)[-1]
+            if not last and isinstance(node.func, ast.Attribute):
+                # method on a non-trivial expression, e.g.
+                # `(a + b).astype(f32)` — _dotted can't chain it
+                last = node.func.attr
+            if last in _CAST_CALLS:
+                # transparent: f32(20.0) -> 20.0, x.astype(f32) -> x
+                if isinstance(node.func, ast.Attribute) \
+                        and last == "astype":
+                    self._walk(node.func.value)
+                    return
+                for a in node.args:
+                    self._walk(a)
+                return
+            args = node.args
+            if last in _COND_CALLS and args:
+                args = args[1:]          # drop the condition
+            for a in args:
+                self._walk(a)
+            for kw in node.keywords:
+                if kw.arg not in ("axis", "keepdims", "dtype",
+                                  "num_keys", "mode"):
+                    self._walk(kw.value)
+            return
+        if isinstance(node, ast.Subscript):
+            # indexing is layout plumbing, not scoring structure
+            self._walk(node.value)
+            return
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Compare,
+                             ast.BoolOp)):
+            # leaves and conditions are excluded by design
+            return
+        if isinstance(node, ast.IfExp):
+            self._walk(node.body)
+            self._walk(node.orelse)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+
+def _collect_assigns(index: PackageIndex, fi: FuncInfo,
+                     names: Tuple[str, ...], nested: bool
+                     ) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    keys = [fi.key]
+    while keys:
+        cur = index.functions[keys.pop(0)]
+        for node in index._own_nodes(cur):
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                tgt = node.target.id
+            if tgt in names:
+                out.append(node)
+        if nested:
+            keys.extend(cur.nested)
+    return out
+
+
+def _term_assignments(index: PackageIndex, fi: FuncInfo,
+                      names: Tuple[str, ...]) -> List[ast.AST]:
+    """Assignments to any of `names` in the site function INCLUDING its
+    nested helper defs (kernel's spread lives in a nested
+    `one_spread`); when a term is not defined there at all, climb the
+    enclosing-def chain own-nodes-only (host's `pen_score` lives in
+    host_solve_kernel's scope, one level above group_scores — own
+    nodes only, so a sibling nested scorer is not double-collected)."""
+    out = _collect_assigns(index, fi, names, nested=True)
+    cur: Optional[FuncInfo] = fi
+    while not out and cur is not None and cur.parent:
+        cur = index.functions.get(cur.parent)
+        if cur is None:
+            break
+        out = _collect_assigns(index, cur, names, nested=False)
+    return out
+
+
+def python_fingerprint(index: PackageIndex, fi: FuncInfo,
+                       terms: Sequence[str]) -> Dict[str, TermPrint]:
+    prints: Dict[str, TermPrint] = {}
+    for term in terms:
+        nodes = _term_assignments(index, fi, TERM_NAMES[term])
+        if not nodes:
+            continue
+        p = _PyPrinter()
+        for node in nodes:
+            val = node.value
+            p.feed(val)
+            if isinstance(node, ast.AugAssign):
+                p._op({ast.Add: "add", ast.Sub: "sub",
+                       ast.Mult: "mul", ast.Div: "div"}.get(
+                           type(node.op), "add"))
+        prints[term] = TermPrint(
+            consts=tuple(sorted(p.consts)),
+            ops=tuple(sorted(p.ops.items())),
+            const_set=tuple(sorted(set(p.consts))))
+    return prints
+
+
+# ====================================================== native extract
+_C_FLOAT = re.compile(r"(?<![\w.])(-?\d+(?:\.\d*)?(?:e-?\d+)?)f?\b")
+_C_STMT = re.compile(
+    r"(?:const\s+)?(?:float|double|auto)?\s*"
+    r"(?P<name>\w+)\s*(?P<aug>[+\-*/]?)=\s*(?P<rhs>[^;]+);")
+
+
+def _c_statements(src: str) -> List[Tuple[str, str, str]]:
+    """(name, augop, rhs) for every simple assignment statement, with
+    line comments stripped and continuation lines joined."""
+    src = re.sub(r"//[^\n]*", "", src)
+    src = re.sub(r"\s+", " ", src)
+    return [(m.group("name"), m.group("aug"), m.group("rhs"))
+            for m in _C_STMT.finditer(src)]
+
+
+def _c_normalize(rhs: str) -> str:
+    """Translate C++ scoring idioms onto the python canonical form."""
+    # subscripts are plumbing: strip [...] including nested ones
+    prev = None
+    while prev != rhs:
+        prev = rhs
+        rhs = re.sub(r"\[[^\[\]]*\]", "", rhs)
+    # bool->float coercions fold away like implicit casts
+    rhs = re.sub(r"\(\s*\w+\s*\?\s*1\.0f?\s*:\s*0\.0f?\s*\)", "B", rhs)
+    # clip spelled as min(max(x, lo), hi)
+    rhs = re.sub(
+        r"std::min\s*\(\s*std::max\s*\(([^,]+),([^)]+)\)\s*,([^)]+)\)",
+        r"clip(\1,\2,\3)", rhs)
+    rhs = rhs.replace("std::pow", "POW").replace("std::floor", "floor")
+    rhs = rhs.replace("std::max", "MAXF").replace("std::min", "MINF")
+    return rhs
+
+
+def _c_term_print(stmts: List[Tuple[str, str, str]],
+                  names: Tuple[str, ...], term: str) -> TermPrint:
+    consts: List[float] = []
+    ops: Dict[str, int] = {}
+
+    def add_op(name, n=1):
+        ops[name] = ops.get(name, 0) + n
+
+    for name, aug, rhs in stmts:
+        if name not in names:
+            continue
+        rhs = _c_normalize(rhs)
+        # ternary: drop the condition (like where)
+        if "?" in rhs:
+            cond, _, branches = rhs.partition("?")
+            rhs = branches.replace(":", " ")
+        if aug:
+            add_op({"+": "add", "-": "sub", "*": "mul",
+                    "/": "div"}[aug])
+        # constants (before op counting so signs bind to numbers)
+        for m in _C_FLOAT.finditer(rhs):
+            consts.append(float(m.group(1)))
+        body = _C_FLOAT.sub("C", rhs)
+        add_op("pow", body.count("POW"))
+        body = body.replace("POW", "")
+        # unary minus: only when no operand precedes it (start of the
+        # expression or right after an opener/separator); a minus
+        # after an operand is the binary sub counted below
+        for m in re.finditer(r"(?:^|[(,?:=])\s*-\s*(?=[A-Za-z_(])",
+                             body.strip()):
+            add_op("neg")
+        # binary ops: a token on each side
+        for opch, opname in (("+", "add"), ("*", "mul"),
+                             ("/", "div")):
+            add_op(opname, len(re.findall(
+                re.escape(opch) if opch != "+" else r"(?<!\+)\+(?!\+)",
+                body)))
+        # binary minus: preceded by an identifier/paren/constant
+        add_op("sub", len(re.findall(r"(?<=[\w)C])\s*-\s*(?=[\w(C])",
+                                     body)))
+    # neg got double-counted as sub when preceded by '(' -> already
+    # excluded by the lookbehind; pow args contribute their own consts
+    zero = {k: v for k, v in ops.items() if v}
+    return TermPrint(consts=tuple(sorted(consts)),
+                     ops=tuple(sorted(zero.items())),
+                     const_set=tuple(sorted(set(consts))))
+
+
+def native_fingerprint(path: str,
+                       terms: Sequence[str]) -> Dict[str, TermPrint]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    # scope to the scoring region when the source carries the standard
+    # section markers, so same-named scratch vars elsewhere (top-k
+    # scratch `score`, commit loops) don't pollute the fingerprint
+    lo = src.find("batched scoring")
+    hi = src.find("per-group top-k")
+    if 0 <= lo < hi:
+        src = src[lo:hi]
+    stmts = _c_statements(src)
+    out: Dict[str, TermPrint] = {}
+    for term in terms:
+        tp = _c_term_print(stmts, TERM_NAMES[term], term)
+        if tp.consts or tp.ops:
+            out[term] = tp
+    return out
+
+
+# ============================================================== pass
+def run_score_pass(index: PackageIndex, cfg: AnalysisConfig,
+                   package_dir: Optional[str] = None
+                   ) -> List[Finding]:
+    sites = getattr(cfg, "scorer_sites", None) or DEFAULT_SCORER_SITES
+    findings: List[Finding] = []
+    prints: List[Tuple[ScorerSite, str, Dict[str, TermPrint],
+                       str, int]] = []
+    site_fn_patterns: List[str] = []
+    for site in sites:
+        terms = site.terms or DEFAULT_TERMS
+        if site.kind == "python":
+            site_fn_patterns.append(site.site)
+            fkeys = index.match_funcs([site.site])
+            if not fkeys:
+                findings.append(Finding(
+                    "SCORE603", "-", "-", site.backend, site.site, 0,
+                    f"registered scorer site `{site.site}` "
+                    f"(backend {site.backend}) resolves to nothing; "
+                    "the cross-backend drift check is blind to this "
+                    "backend",
+                    hint="update the registry entry in "
+                         "analysis/score_pass.py (or AnalysisConfig."
+                         "scorer_sites) after renaming the scorer"))
+                continue
+            fi = index.functions[fkeys[0]]
+            fp = python_fingerprint(index, fi, terms)
+            prints.append((site, site.backend, fp, fi.path,
+                           fi.node.lineno))
+        else:
+            path = site.site if os.path.isabs(site.site) else \
+                os.path.join(package_dir or "", site.site)
+            if not os.path.exists(path):
+                findings.append(Finding(
+                    "SCORE603", "-", "-", site.backend, site.site, 0,
+                    f"registered native scorer source `{site.site}` "
+                    "not found; the drift check is blind to the "
+                    f"{site.backend} backend",
+                    hint="fix the path in the scoring-site registry"))
+                continue
+            fp = native_fingerprint(path, terms)
+            prints.append((site, site.backend, fp, site.site, 0))
+
+    # ---- SCORE601: compare every backend against the reference
+    if prints:
+        ref_site, ref_name, ref_fp, ref_path, _ = prints[0]
+        for site, backend, fp, path, line in prints[1:]:
+            terms = site.terms or DEFAULT_TERMS
+            for term in terms:
+                a = ref_fp.get(term)
+                b = fp.get(term)
+                if a is None:
+                    continue          # reference doesn't carry it
+                if b is None:
+                    findings.append(Finding(
+                        "SCORE601", "-", backend, term, path, line,
+                        f"backend `{backend}` is missing scoring term "
+                        f"`{term}` (reference backend `{ref_name}` "
+                        "carries it)",
+                        hint="replicate the term float-order-exactly "
+                             "or register the backend with an "
+                             "explicit reduced term list"))
+                    continue
+                if term in CONST_SET_TERMS:
+                    if set(a.const_set) != set(b.const_set):
+                        findings.append(_drift(backend, term, path,
+                                               line, a, b, ref_name,
+                                               consts_only=True))
+                elif (a.consts, a.ops) != (b.consts, b.ops):
+                    findings.append(_drift(backend, term, path, line,
+                                           a, b, ref_name))
+
+    # ---- SCORE602: scoring-shaped arithmetic outside the registry
+    for fkey, fi in sorted(index.functions.items()):
+        base = fkey.split("#")[0]
+        if any(fnmatch.fnmatchcase(base, p) or
+               fnmatch.fnmatchcase(_parent_chain(index, fi), p)
+               for p in site_fn_patterns):
+            continue
+        if fi.module.startswith("nomad_tpu.analysis"):
+            continue
+        for node in index._own_nodes(fi):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            val = node.value
+            used: Set[str] = set()
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Name) \
+                        and sub.id in _COMPOSITE_NAMES:
+                    used.add(sub.id)
+                elif isinstance(sub, ast.Attribute) \
+                        and sub.attr in _COMPOSITE_NAMES:
+                    used.add(sub.attr)
+            if len(used) >= 2:
+                findings.append(Finding(
+                    "SCORE602", fi.module, fi.qual,
+                    "+".join(sorted(used)), fi.path, node.lineno,
+                    "scoring-shaped arithmetic (combines "
+                    f"{sorted(used)}) outside the registered scorer "
+                    "sites; a term added here exists in ONE backend "
+                    "only and the twins silently diverge",
+                    hint="move the logic into the registered scorer "
+                         "sites (all backends) and/or add the site to "
+                         "the scoring registry in "
+                         "analysis/score_pass.py"))
+    return findings
+
+
+def _parent_chain(index: PackageIndex, fi: FuncInfo) -> str:
+    """module:qual of the OUTERMOST enclosing def, so nested helpers of
+    a registered site count as inside it."""
+    cur = fi
+    while cur.parent and cur.parent in index.functions:
+        cur = index.functions[cur.parent]
+    return cur.key.split("#")[0]
+
+
+def _drift(backend: str, term: str, path: str, line: int,
+           a: TermPrint, b: TermPrint, ref: str,
+           consts_only: bool = False) -> Finding:
+    what = ("constant set" if consts_only
+            else "float-op fingerprint")
+    return Finding(
+        "SCORE601", "-", backend, term, path, line,
+        f"scoring term `{term}` {what} diverges between backend "
+        f"`{backend}` ({b.describe()}) and reference `{ref}` "
+        f"({a.describe()}); the twins are no longer float-order-"
+        "identical and placements can differ per backend",
+        hint="make the term's constants and op structure identical in "
+             "every registered backend (see STATIC_ANALYSIS.md "
+             "SCORE6xx for the canonicalization rules)")

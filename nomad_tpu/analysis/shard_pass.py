@@ -1,0 +1,244 @@
+"""Pass 4: SPMD partition safety (SHARD4xx).
+
+The PR-5 mesh-resident solve pins node-axis NamedShardings and keeps
+planes resident in HBM across calls; the PR-8 elastic tier remaps node
+ownership by tile instead of contiguous blocks. Both turned up the
+same family of silent-wrong-answer bugs: array ops that are value-
+correct on one device but partition-UNSAFE once the operand is
+sharded.
+
+Rules
+  SHARD401  scatter (`x.at[...].set/add`, or a scatter-helper such as
+            kernel.delta_scatter_*) applied to a NamedSharding-sharded
+            operand OUTSIDE a shard_map context. GSPMD is free to
+            replicate the update and apply it once per shard — the
+            historical double-applied-scatter class. Sharded operands
+            must route through an owner-mapped shard_map scatter.
+  SHARD402  ownership-mask-free scatter inside a shard_map body: an
+            `x.at[idx].set/add(...)` without `mode="drop"`. Non-owned
+            rows must be pinned out of range and dropped; without the
+            mask, negative locals WRAP python-style and corrupt
+            another shard's rows.
+  SHARD403  contiguous-block axis arithmetic inside a shard_map body:
+            ownership/locality derived with `//` or `%` from an
+            axis-size expression (`x.shape[0]`, n_shards-like values).
+            Correct for the static block layout, silently wrong under
+            an elastic TileLayout remap — route global rows through
+            the owner/slot tables instead.  (warn tier: heuristic)
+
+Provenance of "sharded" comes from the dataflow engine: direct
+`device_put(x, NamedSharding(...))`, return summaries of `_put_node`-
+style hooks, and class attributes assigned from either — with
+inherited methods bound to the concrete subclass, so a subclass that
+pins shardings but inherits a plain-jit delta path is seen as the
+hazard it is.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import AnalysisConfig, Finding, PackageIndex, _dotted
+from .dataflow import (AttrFact, DataflowEngine, _at_scatter_base,
+                       _linear_nodes, _param_list, _self_offset,
+                       scatter_call_has_drop_mode)
+
+# names that look like a shard/axis count when used as a `//`/`%`
+# denominator inside a shard body
+_AXIS_SIZE_NAMES = {"n_shards", "num_shards", "nshards", "n_shard",
+                    "chips_per_host", "n_hosts", "npl", "np_local",
+                    "tile_np", "shard_count", "world_size"}
+
+
+def run_shard_pass(index: PackageIndex, cfg: AnalysisConfig,
+                   engine: Optional[DataflowEngine] = None
+                   ) -> List[Finding]:
+    engine = engine or DataflowEngine(index, cfg)
+    findings: List[Finding] = []
+    findings += _shard401(index, cfg, engine)
+    findings += _shard402_403(index, cfg, engine)
+    return findings
+
+
+# ------------------------------------------------------------ SHARD401
+def _shard401(index: PackageIndex, cfg: AnalysisConfig,
+              engine: DataflowEngine) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    safe = engine.shard_safe()
+    scatter_map = engine.scatter_map()
+
+    def check_function(fkey: str, bound_cls: Optional[str],
+                       facts: Optional[Dict[str, AttrFact]]) -> None:
+        if fkey in safe:
+            return
+        fi = index.functions[fkey]
+        env: Dict = {}
+        for node in _linear_nodes(index, fi):
+            if isinstance(node, ast.Assign):
+                val = engine._eval(fi, node.value, env, bound_cls)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        env[t.id] = val
+            if not isinstance(node, ast.Call):
+                continue
+            # direct x.at[...].set/add on a sharded operand
+            base = _at_scatter_base(node)
+            if base is not None:
+                val = engine._eval(fi, base, env, bound_cls)
+                if engine.value_is_sharded(val, facts):
+                    _emit(findings, seen, fi, node.lineno,
+                          _render(base), direct=True)
+                continue
+            # scatter-helper call with a sharded operand
+            target = engine._resolve(fi, node, bound_cls)
+            if target is None:
+                continue
+            positions = scatter_map.get(target)
+            if not positions:
+                continue
+            off = _self_offset(index, target, node)
+            for pos in positions:
+                apos = pos - off
+                if not (0 <= apos < len(node.args)):
+                    continue
+                val = engine._eval(fi, node.args[apos], env, bound_cls)
+                if engine.value_is_sharded(val, facts):
+                    _emit(findings, seen, fi, node.lineno,
+                          _render(node.args[apos]), direct=False,
+                          helper=target.split(":")[-1])
+
+    # module-level functions (no attr facts)
+    for fkey, fi in sorted(index.functions.items()):
+        if fi.cls is None:
+            check_function(fkey, None, None)
+    # methods, bound to each concrete class that reaches them — an
+    # inherited method is re-checked under every subclass, because the
+    # subclass's _put_node/_delta overrides change what is sharded
+    for ckey in sorted(index.classes):
+        facts = engine.class_facts(ckey)
+        for mname, fkey in engine._mro_methods(ckey).items():
+            check_function(fkey, ckey, facts)
+    return findings
+
+
+def _render(node) -> str:
+    d = _dotted(node)
+    if d:
+        return d
+    if isinstance(node, ast.Subscript):
+        b = _dotted(node.value)
+        if b:
+            return f"{b}[...]"
+    return "<expr>"
+
+
+def _emit(findings: List[Finding], seen: Set[str], fi, line: int,
+          operand: str, direct: bool, helper: str = "") -> None:
+    sym = operand
+    key = f"{fi.key}:{line}:{sym}"
+    if key in seen:
+        return
+    seen.add(key)
+    via = "an `.at[...]` scatter" if direct else \
+        f"scatter helper `{helper}`"
+    findings.append(Finding(
+        "SHARD401", fi.module, fi.qual, sym, fi.path, line,
+        f"`{operand}` carries a NamedSharding but is updated through "
+        f"{via} outside shard_map; GSPMD may replicate the update and "
+        "apply it once per shard (the double-applied-scatter class)",
+        hint="route the update through an owner-mapped shard_map "
+             "scatter (each shard writes only rows it owns, "
+             "mode=\"drop\"), or drop the sharding before the scatter"))
+
+
+# ----------------------------------------------------- SHARD402 / 403
+def _shard402_403(index: PackageIndex, cfg: AnalysisConfig,
+                  engine: DataflowEngine) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in sorted(engine.mesh_roots()):
+        fi = index.functions.get(root)
+        if fi is None:
+            continue
+        # the body itself plus directly nested defs (they trace inline)
+        for fkey in [root] + list(fi.nested):
+            sfi = index.functions[fkey]
+            sizeish = _axis_size_locals(index, sfi)
+            for node in index._own_nodes(sfi):
+                if isinstance(node, ast.Call):
+                    base = _at_scatter_base(node)
+                    if base is not None and node.func.attr in (
+                            "set", "add", "mul", "min", "max") \
+                            and not scatter_call_has_drop_mode(node):
+                        findings.append(Finding(
+                            "SHARD402", sfi.module, sfi.qual,
+                            _render(base), sfi.path, node.lineno,
+                            f"scatter on `{_render(base)}` inside a "
+                            "shard_map body without mode=\"drop\": "
+                            "non-owned rows are not masked, and "
+                            "negative locals WRAP python-style into "
+                            "another shard's rows",
+                            hint="pin non-owned indices to the dropped "
+                                 "slot (e.g. local==Npl) and pass "
+                                 "mode=\"drop\""))
+                if isinstance(node, ast.BinOp) and isinstance(
+                        node.op, (ast.FloorDiv, ast.Mod)):
+                    why = _axis_size_expr(node.right, sizeish)
+                    if why:
+                        op = "//" if isinstance(node.op,
+                                                ast.FloorDiv) else "%"
+                        findings.append(Finding(
+                            "SHARD403", sfi.module, sfi.qual,
+                            f"{op}:{why}", sfi.path, node.lineno,
+                            f"ownership arithmetic `{op} {why}` inside "
+                            "a shard_map body assumes the contiguous "
+                            "block layout; under an elastic TileLayout "
+                            "remap slot order is not id order and the "
+                            "derived owner/local is silently wrong",
+                            hint="route global rows through the "
+                                 "owner/slot tables (pass them in as "
+                                 "operands) instead of deriving them "
+                                 "from axis sizes"))
+    return findings
+
+
+def _axis_size_locals(index: PackageIndex, fi) -> Set[str]:
+    """Local names bound to an axis-size-like expression."""
+    out: Set[str] = set()
+    for node in index._own_nodes(fi):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if _axis_size_expr(node.value, set()):
+                out.add(node.targets[0].id)
+    # parameters with axis-size names count too (closures over
+    # n_shards/tile_np are the usual spelling)
+    for name in _param_list(fi):
+        if name.lower() in _AXIS_SIZE_NAMES:
+            out.add(name)
+    return out
+
+
+def _axis_size_expr(node, sizeish: Set[str]) -> str:
+    """Human-readable description when the expression is an axis-size
+    source; '' otherwise."""
+    if isinstance(node, ast.Subscript):
+        b = node.value
+        if isinstance(b, ast.Attribute) and b.attr == "shape":
+            d = _dotted(b)
+            return d or "shape[...]"
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d and (d.endswith("axis_size") or d.endswith("psum")):
+            return d
+    if isinstance(node, ast.Name):
+        if node.id in sizeish or node.id.lower() in _AXIS_SIZE_NAMES:
+            return node.id
+    if isinstance(node, ast.Attribute):
+        d = _dotted(node)
+        if d and d.split(".")[-1].lower() in _AXIS_SIZE_NAMES:
+            return d
+    if isinstance(node, ast.BinOp):
+        # N // n_shards and friends: size-of-size is still a size
+        return (_axis_size_expr(node.left, sizeish)
+                or _axis_size_expr(node.right, sizeish))
+    return ""

@@ -301,5 +301,9 @@ class FederatedResidentSolver:
         if dev_used0 is None:
             dev_used0 = np.stack(
                 [s.template.dev_used0 for s in self.solvers])
-        self._used = jax.device_put(used0)
-        self._dev_used = jax.device_put(dev_used0)
+        # copy before placing: CPU device_put can alias a caller-owned
+        # numpy buffer zero-copy, and a later in-place edit on the
+        # caller's side would leak into the resident usage carry (the
+        # PR-5 double-charge class; nomadlint ALIAS503)
+        self._used = jax.device_put(np.array(used0))
+        self._dev_used = jax.device_put(np.array(dev_used0))

@@ -5,7 +5,11 @@ must carry zero unsuppressed findings.
 The fixtures are written as source files into a throwaway package —
 the analyzer is pure AST and never imports them, so they can reference
 jax freely without a device (and contain deliberate bugs without
-runtime consequences)."""
+runtime consequences).  The SHARD/ALIAS fixtures include seeded
+reproductions of the three shipped historical bugs (PR-5 zero-copy
+device_put aliasing, GSPMD double-applied scatter, PR-4 donated-carry
+read) so the passes provably catch what we actually shipped."""
+import os
 import textwrap
 
 import pytest
@@ -14,6 +18,8 @@ from nomad_tpu.analysis import (AnalysisConfig, BaselineError, analyze,
                                 default_baseline_path, load_baseline)
 from nomad_tpu.analysis.baseline import parse_baseline_text
 from nomad_tpu.analysis.core import PackageIndex
+from nomad_tpu.analysis.score_pass import (DEFAULT_SCORER_SITES,
+                                           ScorerSite)
 
 
 def write_fixture(tmp_path, files):
@@ -355,23 +361,312 @@ FIX_LOCKS = """
 """
 
 
+FIX_SHARD = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+    @jax.jit
+    def plain_scatter_add(arr, idx, rows):
+        # generic single-device scatter helper: fine on plain buffers
+        return arr.at[idx].add(rows)
+
+
+    def shard_planes(mesh, arr):
+        return jax.device_put(arr, NamedSharding(mesh, P("nodes")))
+
+
+    class DoubleApply:
+        # seeded GSPMD double-apply reproduction: node planes pinned
+        # to a NamedSharding, but the delta path still routes through
+        # the plain jit scatter (the exact shape of the historical
+        # sharded-operand bug — GSPMD may replicate the update and
+        # apply it once per shard)
+        def __init__(self, mesh, plane):
+            self._plane = shard_planes(mesh, plane)
+
+        def apply_delta(self, idx, rows):
+            self._plane = plain_scatter_add(self._plane, idx, rows)
+
+
+    class OwnerRouted:
+        # clean twin: same sharded planes, scatter under shard_map
+        # with owner masking
+        def __init__(self, mesh, plane):
+            self._mesh = mesh
+            self._plane = shard_planes(mesh, plane)
+
+        def apply_delta(self, idx, rows):
+            def body(a_l, idx_, rows_):
+                off = jax.lax.axis_index("nodes") * a_l.shape[0]
+                loc = idx_ - off
+                loc = jnp.where((loc >= 0) & (loc < a_l.shape[0]),
+                                loc, a_l.shape[0])
+                return a_l.at[loc].add(rows_, mode="drop")
+            fn = shard_map(body, mesh=self._mesh,
+                           in_specs=(P("nodes"), P(), P()),
+                           out_specs=P("nodes"))
+            self._plane = fn(self._plane, idx, rows)
+
+
+    def naked_scatter_body(a_l, idx_, rows_):
+        # SHARD402: no ownership mask — negative locals wrap into
+        # another shard's rows
+        return a_l.at[idx_].add(rows_)
+
+
+    def masked_scatter_body(a_l, idx_, rows_):
+        loc = jnp.where((idx_ >= 0) & (idx_ < a_l.shape[0]), idx_,
+                        a_l.shape[0])
+        return a_l.at[loc].add(rows_, mode="drop")
+
+
+    def block_owner_body(a_l, idx_, rows_):
+        # SHARD403: contiguous-block owner arithmetic breaks under the
+        # elastic TileLayout remap
+        owner = idx_ // a_l.shape[0]
+        loc = jnp.where(owner == jax.lax.axis_index("nodes"),
+                        idx_ - owner * a_l.shape[0], a_l.shape[0])
+        return a_l.at[loc].add(rows_, mode="drop")
+
+
+    def table_routed_body(a_l, slot_map, idx_, rows_):
+        # clean twin: global rows routed through the owner/slot table
+        loc = slot_map[idx_]
+        return a_l.at[loc].add(rows_, mode="drop")
+
+
+    def run_bodies(mesh, plane, slot_map, idx, rows):
+        f = shard_map(naked_scatter_body, mesh=mesh,
+                      in_specs=(P("nodes"), P(), P()),
+                      out_specs=P("nodes"))
+        g = shard_map(block_owner_body, mesh=mesh,
+                      in_specs=(P("nodes"), P(), P()),
+                      out_specs=P("nodes"))
+        h = shard_map(masked_scatter_body, mesh=mesh,
+                      in_specs=(P("nodes"), P(), P()),
+                      out_specs=P("nodes"))
+        k = shard_map(table_routed_body, mesh=mesh,
+                      in_specs=(P("nodes"), P(), P(), P()),
+                      out_specs=P("nodes"))
+        return (f(plane, idx, rows) + g(plane, idx, rows)
+                + h(plane, idx, rows) + k(plane, slot_map, idx, rows))
+"""
+
+FIX_ALIAS = """
+    import functools
+
+    import jax
+    import numpy as np
+
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def donating_set(arr, rows):
+        return arr.at[0].set(rows)
+
+
+    def layer_one(buf, rows):
+        return donating_set(buf, rows)
+
+
+    def layer_two(state, rows):
+        return layer_one(state, rows)
+
+
+    def deep_dead_read(state, rows):
+        # seeded PR-4 donated-carry reproduction, two wrapper hops
+        # deep: JIT204's direct/one-hop scan cannot see this
+        out = layer_two(state, rows)
+        return out + state.sum()                       # ALIAS502
+
+
+    def deep_live_read(state, rows):
+        state = layer_two(state, rows)
+        return state.sum()            # rebound to the result: fine
+
+
+    class Planes:
+        # seeded PR-5 reproduction: template planes shipped to device
+        # WITHOUT a copy (np.asarray is identity-preserving), then
+        # mutated host-side in place — through a zero-copy alias the
+        # device carry sees both writes (the usage double-charge)
+        def __init__(self, template):
+            self._template = template
+            self._dev = jax.device_put(np.asarray(self._template))
+
+        def host_apply(self, rows):
+            self._template[: rows.shape[0]] += rows    # ALIAS501
+
+
+    class PlanesCopied:
+        # clean twin: copy severs the alias at the boundary
+        def __init__(self, template):
+            self._template = template
+            self._dev = jax.device_put(np.array(self._template))
+
+        def host_apply(self, rows):
+            self._template[: rows.shape[0]] += rows
+
+
+    def local_alias_mutation(t):
+        dev = jax.device_put(t)
+        t[0] = 7                                       # ALIAS501
+        return dev
+
+
+    def local_copy_mutation(t):
+        dev = jax.device_put(t.copy())
+        t[0] = 7              # the device buffer owns a copy: fine
+        return dev
+
+
+    class EscapedAlias:
+        def reset(self, used0):
+            self._used = jax.device_put(used0)         # ALIAS503
+
+
+    class EscapedAliasCopied:
+        def reset(self, used0):
+            self._used = jax.device_put(np.array(used0))
+"""
+
+FIX_SCORE_HOST = """
+    import numpy as np
+
+    f32 = np.float32
+
+
+    def host_scores(avail, used, reserved, coll, penalty, aff_score,
+                    desired):
+        util_cpu = used + reserved
+        util_mem = used + reserved
+        denom_cpu = avail
+        denom_mem = avail
+        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+        free_cpu = f32(1.0) - util_cpu / np.maximum(denom_cpu, f32(1.0))
+        free_mem = f32(1.0) - util_mem / np.maximum(denom_mem, f32(1.0))
+        raw = f32(20.0) - (f32(10.0) ** free_cpu + f32(10.0) ** free_mem)
+        binpack = np.where(ok_denoms,
+                           np.clip(raw, f32(0.0), f32(18.0)) / f32(18.0),
+                           f32(0.0))
+        anti = np.where(coll > 0, -(coll + f32(1.0)) / desired,
+                        f32(0.0))
+        anti_counts = coll > 0
+        pen_score = np.where(penalty, f32(-1.0), f32(0.0))
+        aff_counts = aff_score != 0.0
+        n_scorers = (f32(1.0) + anti_counts + penalty
+                     + aff_counts).astype(f32)
+        total = (binpack + anti + pen_score + aff_score) / n_scorers
+        return total
+"""
+
+FIX_SCORE_SL = """
+    import jax.numpy as jnp
+
+
+    def sl_scores(avail, used, reserved, coll, penalty, aff, desired):
+        util_cpu = used + reserved
+        util_mem = used + reserved
+        denom_cpu = avail
+        denom_mem = avail
+        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+        free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
+        free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
+        raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
+        binpack = jnp.where(ok_denoms,
+                            jnp.clip(raw, 0.0, 18.0) / 18.0, 0.0)
+        anti = jnp.where(coll > 0, -(coll + 1.0) / desired, 0.0)
+        anti_counts = coll > 0
+        pen_sc = jnp.where(penalty, -1.0, 0.0)
+        aff_counts = aff != 0.0
+        n_scorers = (1.0 + anti_counts + penalty + aff_counts)
+        total = (binpack + anti + pen_sc + aff) / n_scorers
+        return total
+"""
+
+FIX_SCORE_ROGUE = """
+    import numpy as np
+
+
+    def sneaky_bonus(binpack, anti):
+        # SCORE602: combining registered score terms outside the
+        # registered sites — a term added here exists in one backend
+        tweak = binpack + anti
+        return tweak
+
+
+    def fine_single_term(binpack):
+        x = binpack * 2.0     # one term: plumbing, not scoring
+        return x
+"""
+
+FIX_SCORE_CC = """\
+// fixpkg native scorer twin (fixture)
+void score_all(int n) {
+  // ---------- batched scoring ----------
+  for (int i = 0; i < n; ++i) {
+    const float denom_cpu = avail[i];
+    const float denom_mem = avail[i];
+    const float util_cpu = used[i] + reserved[i];
+    const float util_mem = used[i] + reserved[i];
+    const bool ok = denom_cpu > 0 && denom_mem > 0;
+    const float free_cpu = 1.0f - util_cpu / std::max(denom_cpu, 1.0f);
+    const float free_mem = 1.0f - util_mem / std::max(denom_mem, 1.0f);
+    float raw = 20.0f - (std::pow(10.0f, free_cpu)
+                         + std::pow(10.0f, free_mem));
+    float binpack = 0.0f;
+    if (ok) {
+      raw = std::min(std::max(raw, 0.0f), 18.0f);
+      binpack = raw / 18.0f;
+    }
+    const float anti = cl > 0 ? -(cl + 1.0f) / adesired : 0.0f;
+    const float pen = penalty[i] ? -1.0f : 0.0f;
+    const float n_scorers = 1.0f + (anti_cnt ? 1.0f : 0.0f)
+                            + (pen_cnt ? 1.0f : 0.0f)
+                            + (aff_cnt ? 1.0f : 0.0f);
+    float total = (binpack + anti + pen + af) / n_scorers;
+    score[i] = total;
+  }
+  // ---------- per-group top-k ----------
+}
+"""
+
+FIX_SCORER_SITES = (
+    ScorerSite("host", "python", "fixpkg.score_host:host_scores"),
+    ScorerSite("shortlist", "python", "fixpkg.score_sl:sl_scores"),
+    ScorerSite("native", "native",
+               os.path.join("fixpkg", "native_score.cc")),
+)
+
+FIX_FILES = {
+    "store.py": FIX_STORE,
+    "fsm.py": FIX_FSM,
+    "rogue.py": FIX_ROGUE,
+    "jitmod.py": FIX_JIT,
+    "locks.py": FIX_LOCKS,
+    "shardmod.py": FIX_SHARD,
+    "aliasmod.py": FIX_ALIAS,
+    "score_host.py": FIX_SCORE_HOST,
+    "score_sl.py": FIX_SCORE_SL,
+    "score_rogue.py": FIX_SCORE_ROGUE,
+    "native_score.cc": FIX_SCORE_CC,
+}
+
 FIX_CFG = AnalysisConfig(
     fsm_roots=("fixpkg.fsm:FSM.apply", "fixpkg.fsm:FSM._ap_*"),
     store_module="fixpkg.store",
     store_class="FakeStore",
     lock_module_prefixes=("fixpkg",),
+    scatter_helpers=(),
+    scorer_sites=FIX_SCORER_SITES,
 )
 
 
 @pytest.fixture(scope="module")
 def fixture_report(tmp_path_factory):
-    root = write_fixture(tmp_path_factory.mktemp("lintfix"), {
-        "store.py": FIX_STORE,
-        "fsm.py": FIX_FSM,
-        "rogue.py": FIX_ROGUE,
-        "jitmod.py": FIX_JIT,
-        "locks.py": FIX_LOCKS,
-    })
+    root = write_fixture(tmp_path_factory.mktemp("lintfix"), FIX_FILES)
     return analyze(package_dir=root, package_name="fixpkg",
                    use_baseline=False, config=FIX_CFG)
 
@@ -520,6 +815,144 @@ def test_lock_ordering_cycle_detected(fixture_report):
     assert "TwoLocks._a" in next(iter(keys))
 
 
+# -------------------------------------------------------- shard pass
+def test_shard_double_apply_detected_owner_routed_quiet(fixture_report):
+    """Seeded GSPMD double-apply reproduction: NamedSharding-pinned
+    planes updated through the plain jit scatter helper fire SHARD401;
+    the owner-routed shard_map twin is quiet."""
+    keys = _keys(fixture_report, "SHARD401")
+    assert any(":DoubleApply.apply_delta:" in k for k in keys)
+    assert not any(":OwnerRouted." in k for k in keys)
+
+
+def test_shard_helper_itself_not_flagged(fixture_report):
+    """The generic scatter helper is fine on plain buffers — only the
+    sharded-operand CALL SITE is the bug."""
+    keys = _keys(fixture_report, "SHARD401")
+    assert not any(":plain_scatter_add:" in k for k in keys)
+
+
+def test_shard_maskfree_scatter_detected_masked_quiet(fixture_report):
+    keys = _keys(fixture_report, "SHARD402")
+    assert any(":naked_scatter_body:" in k for k in keys)
+    assert not any(":masked_scatter_body:" in k for k in keys)
+    assert not any(":table_routed_body:" in k for k in keys)
+
+
+def test_shard_block_arithmetic_detected_table_quiet(fixture_report):
+    keys = _keys(fixture_report, "SHARD403")
+    assert any(":block_owner_body:" in k for k in keys)
+    assert not any(":table_routed_body:" in k for k in keys)
+    assert not any(":masked_scatter_body:" in k for k in keys)
+
+
+# -------------------------------------------------------- alias pass
+def test_alias_uncopied_put_mutation_detected_copy_quiet(
+        fixture_report):
+    """Seeded PR-5 reproduction: template shipped via np.asarray
+    (identity-preserving) then mutated in place fires ALIAS501 at the
+    mutation site; the np.array twin is quiet."""
+    keys = _keys(fixture_report, "ALIAS501")
+    assert any(":Planes.host_apply:" in k for k in keys)
+    assert not any(":PlanesCopied." in k for k in keys)
+
+
+def test_alias_local_order_detected_copy_quiet(fixture_report):
+    keys = _keys(fixture_report, "ALIAS501")
+    assert any(":local_alias_mutation:" in k for k in keys)
+    assert not any(":local_copy_mutation:" in k for k in keys)
+
+
+def test_alias_deep_donated_read_detected_rebind_quiet(fixture_report):
+    """Seeded PR-4 donated-carry reproduction, two wrapper hops deep:
+    the dataflow donation fixpoint reaches it (JIT204 cannot), and the
+    rebind twin is quiet."""
+    a_keys = _keys(fixture_report, "ALIAS502")
+    j_keys = _keys(fixture_report, "JIT204")
+    assert any(":deep_dead_read:" in k for k in a_keys)
+    assert not any(":deep_live_read:" in k for k in a_keys)
+    # JIT204's direct scan does NOT see the two-hop chain...
+    assert not any(":deep_dead_read:" in k for k in j_keys)
+    # ...and ALIAS502 never re-reports what JIT204 already covers
+    assert not any(":bad_caller:" in k or ":bad_carry_reader:" in k
+                   for k in a_keys)
+
+
+def test_alias_escaped_param_put_detected_copy_quiet(fixture_report):
+    keys = _keys(fixture_report, "ALIAS503")
+    assert any(":EscapedAlias.reset:" in k for k in keys)
+    assert not any(":EscapedAliasCopied." in k for k in keys)
+
+
+def test_alias_warn_tier():
+    from nomad_tpu.analysis import severity_of
+    assert severity_of("ALIAS503") == "warn"
+    assert severity_of("ALIAS501") == "error"
+    assert severity_of("SHARD401") == "error"
+
+
+# -------------------------------------------------------- score pass
+def test_score_backends_agree_on_clean_fixture(fixture_report):
+    """The host / shortlist / native fixture twins are float-op
+    identical after canonicalization: no drift findings."""
+    assert _keys(fixture_report, "SCORE601") == set()
+    assert _keys(fixture_report, "SCORE603") == set()
+
+
+def test_score_rogue_arithmetic_detected_single_term_quiet(
+        fixture_report):
+    keys = _keys(fixture_report, "SCORE602")
+    assert any(":sneaky_bonus:" in k for k in keys)
+    assert not any(":fine_single_term:" in k for k in keys)
+
+
+@pytest.mark.parametrize("mutation, desc", [
+    (("18.0", "17.0"), "perturbed clip constant"),
+    (("20.0 - ", "20.0 + "), "perturbed raw sign"),
+    ((") / n_scorers", ") * n_scorers"), "perturbed normalization op"),
+    (("-(coll + 1.0) / desired", "-(coll + 1.0) * desired"),
+     "perturbed anti op"),
+])
+def test_score_perturbing_one_float_op_fails(tmp_path, mutation, desc):
+    """Acceptance: deliberately perturbing ONE float op/constant in a
+    single backend fixture makes the drift check fail."""
+    old, new = mutation
+    assert old in textwrap.dedent(FIX_SCORE_SL)
+    files = dict(FIX_FILES)
+    files["score_sl.py"] = FIX_SCORE_SL.replace(old, new)
+    root = write_fixture(tmp_path, files)
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  use_baseline=False, config=FIX_CFG)
+    keys = _keys(rep, "SCORE601")
+    assert any(":shortlist:" in k for k in keys), desc
+
+
+def test_score_perturbing_native_backend_fails(tmp_path):
+    files = dict(FIX_FILES)
+    files["native_score.cc"] = FIX_SCORE_CC.replace(
+        "raw / 18.0f", "raw / 16.0f")
+    root = write_fixture(tmp_path, files)
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  use_baseline=False, config=FIX_CFG)
+    assert any(":native:" in k and ":binpack" in k
+               for k in _keys(rep, "SCORE601"))
+
+
+def test_score_stale_registry_site_reported(tmp_path):
+    files = dict(FIX_FILES)
+    root = write_fixture(tmp_path, files)
+    cfg = AnalysisConfig(
+        fsm_roots=FIX_CFG.fsm_roots, store_module="fixpkg.store",
+        store_class="FakeStore", lock_module_prefixes=("fixpkg",),
+        scatter_helpers=(),
+        scorer_sites=FIX_SCORER_SITES + (
+            ScorerSite("ghost", "python", "fixpkg.gone:no_such"),))
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  use_baseline=False, config=cfg)
+    keys = _keys(rep, "SCORE603")
+    assert any(k.endswith(":ghost") for k in keys)
+
+
 # ----------------------------------------------------- baseline rules
 def test_baseline_requires_justification():
     with pytest.raises(BaselineError):
@@ -568,7 +1001,6 @@ def test_repo_has_zero_unsuppressed_findings():
 def test_repo_index_sanity():
     """The call graph actually resolved the load-bearing edges (guards
     against the passes going silently blind after a refactor)."""
-    import os
     import nomad_tpu
     pkg_dir = os.path.dirname(os.path.dirname(
         os.path.abspath(nomad_tpu.__file__)))
@@ -578,3 +1010,110 @@ def test_repo_index_sanity():
             in idx.callees(apply_key))
     reach = idx.reachable([apply_key])
     assert "nomad_tpu.state.store:StateStore._bump_locked" in reach
+
+
+def test_repo_scorer_registry_resolves_all_backends():
+    """SCORE6xx fingerprints all registered scorer backends on the
+    real tree — the host twin, the kernel twin, the shortlist
+    _sl_eval, the pallas fused pass AND the native C++ source — and
+    the cross-backend drift check passes (guards the registry against
+    going silently blind after a rename)."""
+    import nomad_tpu
+    from nomad_tpu.analysis.score_pass import (
+        native_fingerprint, python_fingerprint, DEFAULT_TERMS)
+    pkg_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(nomad_tpu.__file__)))
+    idx = PackageIndex.build(pkg_dir, "nomad_tpu")
+    prints = {}
+    for site in DEFAULT_SCORER_SITES:
+        if site.kind == "python":
+            fkeys = idx.match_funcs([site.site])
+            assert fkeys, f"scorer site gone: {site.site}"
+            prints[site.backend] = python_fingerprint(
+                idx, idx.functions[fkeys[0]], DEFAULT_TERMS)
+        else:
+            path = os.path.join(pkg_dir, site.site)
+            assert os.path.exists(path), path
+            prints[site.backend] = native_fingerprint(
+                path, DEFAULT_TERMS)
+    assert set(prints) == {"host", "kernel", "shortlist", "pallas",
+                           "native"}
+    ref = prints["host"]
+    # every backend carries the core terms and agrees with the host
+    for term in ("free", "binpack", "anti", "pen", "n_scorers",
+                 "total"):
+        assert term in ref, term
+        for backend, fp in prints.items():
+            assert term in fp, (backend, term)
+            assert (fp[term].consts, fp[term].ops) == \
+                (ref[term].consts, ref[term].ops), (backend, term)
+
+
+def test_repo_new_passes_have_no_unsuppressed_findings():
+    """Zero-unsuppressed gate extension for SHARD4xx/ALIAS5xx/SCORE6xx
+    specifically (the combined gate above covers everything; this one
+    localizes a regression to the new passes)."""
+    rep = analyze()
+    new = [f for f in rep.findings
+           if f.rule.startswith(("SHARD", "ALIAS", "SCORE"))]
+    assert not new, "\n".join(f.render() for f in new)
+
+
+# ------------------------------------------- baseline freshness tools
+def test_stale_baseline_nearest_miss_suggested(tmp_path):
+    """A renamed function strands its baseline entry; the freshness
+    check must name the nearest current key so the rename is obvious."""
+    root = write_fixture(tmp_path, {"store.py": FIX_STORE,
+                                    "fsm.py": FIX_FSM})
+    bl = parse_baseline_text(
+        '[[suppress]]\nrule = "FSM101"\n'
+        'key = "FSM101:fixpkg.store:FakeStore.stamp_thing_old:time.time"\n'
+        'justification = "fixture"\n')
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  baseline=bl, config=FIX_CFG)
+    key = "FSM101:fixpkg.store:FakeStore.stamp_thing_old:time.time"
+    assert rep.stale_baseline_keys == [key]
+    assert rep.stale_suggestions[key] == \
+        "FSM101:fixpkg.store:FakeStore.stamp_thing:time.time"
+
+
+def test_prune_stale_rewrites_baseline(tmp_path):
+    """--prune-stale drops dead entries, keeps live ones (with their
+    justifications), and the rewritten file round-trips the loader."""
+    from nomad_tpu.analysis.baseline import Baseline
+    bl = parse_baseline_text(
+        '[[suppress]]\nrule = "FSM101"\n'
+        'key = "FSM101:live:*"\njustification = "keep me"\n'
+        '[[suppress]]\nrule = "FSM102"\n'
+        'key = "FSM102:dead:*"\njustification = "stale"\n')
+    pruned = bl.without(["FSM102:dead:*"])
+    path = tmp_path / "baseline.toml"
+    pruned.save(str(path))
+    reloaded = load_baseline(str(path))
+    assert reloaded.keys() == ["FSM101:live:*"]
+    assert reloaded.entries[0]["justification"] == "keep me"
+
+
+# ------------------------------------------------------ CLI contract
+def test_cli_exit_contract_clean_tree():
+    """Exit 0 on the real tree (everything baselined), both plain and
+    --json."""
+    from nomad_tpu.analysis.__main__ import main
+    assert main([]) == 0
+
+
+def test_cli_no_baseline_json_reports_but_does_not_fail(capsys):
+    """The historical flag-interaction bug: `--no-baseline --json`
+    must LIST baseline-suppressed findings (tagged) but exit by the
+    baseline-aware verdict — a clean tree stays exit 0."""
+    import json as _json
+    from nomad_tpu.analysis.__main__ import main
+    rc = main(["--no-baseline", "--json"])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["exit_code"] == 0
+    assert out["suppressed"] > 0
+    listed = out["unsuppressed"]
+    assert listed and all(f["baselined"] for f in listed)
+    assert all(f["severity"] in ("error", "warn") for f in listed)
+    assert all("pass" in f for f in listed)
