@@ -117,6 +117,14 @@ class HTTPAgentServer:
                     outer.handle_monitor(self)
                     self.close_connection = True
                     return
+                if (method == "GET"
+                        and self.path.split("?")[0] == "/v1/metrics"
+                        and "format=prometheus" in (self.path.split("?")
+                                                    + [""])[1]):
+                    # text exposition needs its own content type; the
+                    # JSON dispatch below would re-encode it
+                    outer.handle_prometheus(self)
+                    return
                 if method == "GET" and (self.path == "/ui"
                                         or self.path.startswith("/ui/")
                                         or self.path == "/"):
@@ -389,7 +397,11 @@ class HTTPAgentServer:
             if not a.allow_agent_write():
                 raise HTTPError(403, "agent write permission required")
             return
-        if path.startswith("/v1/agent") or path == "/v1/metrics":
+        if path.startswith("/v1/agent") or path == "/v1/metrics" \
+                or path.startswith(("/v1/trace", "/v1/traces")):
+            # traces expose job/placement internals cluster-wide, the
+            # same blast radius as /v1/metrics + /v1/agent/monitor:
+            # agent read to look, agent write to export to disk
             ok = a.allow_agent_write() if write else a.allow_agent_read()
             if not ok:
                 raise HTTPError(403, "agent permission denied")
@@ -760,6 +772,73 @@ class HTTPAgentServer:
     def metrics(self, q, body):
         return 200, global_metrics.dump(), None
 
+    def handle_prometheus(self, handler) -> None:
+        """/v1/metrics?format=prometheus — text exposition 0.0.4
+        (served outside the JSON dispatch for the content type)."""
+        from urllib.parse import parse_qs, urlparse
+        url = urlparse(handler.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        token = handler.headers.get("X-Nomad-Token", "")
+        try:
+            self._enforce_acl("GET", "/v1/metrics", q, None, token)
+            data = global_metrics.prometheus().encode()
+            code, ctype = 200, ("text/plain; version=0.0.4; "
+                                "charset=utf-8")
+        except HTTPError as e:
+            data = json.dumps({"error": e.msg}).encode()
+            code, ctype = e.code, "application/json"
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    # -------------------------------------------------- flight recorder
+    def trace_get(self, q, body, trace_id):
+        """/v1/trace/:id — one eval's full recorded timeline (the
+        trace id IS the eval id)."""
+        from ..utils.tracing import global_tracer
+        spans = global_tracer.get(trace_id)
+        if spans is None:
+            raise HTTPError(404, f"no trace {trace_id!r}")
+        return 200, {"trace_id": trace_id, "spans": spans}, None
+
+    def traces_list(self, q, body):
+        """/v1/traces — newest-first summaries plus recorder stats."""
+        from ..utils.tracing import global_tracer
+        try:
+            limit = int(q.get("limit", 50))
+        except ValueError:
+            raise HTTPError(400, "limit must be an integer")
+        return 200, {"stats": global_tracer.stats(),
+                     "traces": global_tracer.traces(limit)}, None
+
+    def trace_corpus(self, q, body):
+        """/v1/trace/corpus — the recorded per-eval placement corpus
+        (ROADMAP item 1's training substrate).  GET returns the rows;
+        POST with {"path": ...} exports them as JSONL to that path on
+        the agent host and returns the row count."""
+        from ..utils.tracing import global_tracer
+        if body is not None and isinstance(body, dict) \
+                and body.get("path"):
+            try:
+                n = global_tracer.write_corpus(body["path"])
+            except OSError as e:
+                raise HTTPError(400, f"cannot write corpus: {e}")
+            return 200, {"path": body["path"], "rows": n}, None
+        return 200, {"rows": global_tracer.corpus_rows()}, None
+
+    def agent_events(self, q, body):
+        """/v1/agent/events — the mesh event log (elastic grow/shrink/
+        move/fail/recover transitions with measured bytes/durations)."""
+        from ..utils.tracing import global_mesh_events
+        try:
+            limit = int(q.get("limit", 256))
+        except ValueError:
+            raise HTTPError(400, "limit must be an integer")
+        return 200, {"events": global_mesh_events.events(
+            limit, kind=q.get("kind") or None)}, None
+
     # ----------------------------------------------- agent monitor/pprof
     def handle_monitor(self, handler) -> None:
         """/v1/agent/monitor — live log streaming (reference:
@@ -890,6 +969,8 @@ class HTTPAgentServer:
                 seconds = min(float(q.get("seconds", 1.0)), 30.0)
             except ValueError:
                 raise HTTPError(400, "seconds must be a number")
+            if q.get("mode") == "solver":
+                return self._solver_profile(q, seconds)
             hz = 100
             text = monmod.sample_profile(seconds=seconds, hz=hz)
             return 200, {"profile": text, "seconds": seconds,
@@ -901,6 +982,68 @@ class HTTPAgentServer:
             return 200, {"cmdline": " ".join(sys.argv)}, None
         raise HTTPError(404, f"unknown profile {profile!r} "
                              "(have: profile, goroutine, cmdline)")
+
+    def _solver_profile(self, q, seconds: float):
+        """/v1/agent/pprof/profile?mode=solver — wrap a steady-state
+        solve window in `jax.profiler.trace` and return the trace
+        artifact path (TensorBoard/XPlane format).  With ?job_id= the
+        window is driven by repeated what-if plan solves of that job
+        through the worker's read-only plan view (zero writes);
+        without, the window passively captures whatever the live
+        workers solve.  501 when the installed jax has no profiler."""
+        try:
+            import jax
+            tracer = jax.profiler.trace
+        except (ImportError, AttributeError):
+            raise HTTPError(501, "jax.profiler is not available in "
+                                 "this build")
+        import tempfile
+        import time as _t
+        logdir = tempfile.mkdtemp(prefix="nomad-tpu-solver-profile-")
+        job_id = q.get("job_id", "")
+        namespace = q.get("namespace", "default")
+        solves = 0
+        deadline = None
+        try:
+            with tracer(logdir):
+                deadline = _t.monotonic() + seconds
+                if job_id:
+                    solves = self._drive_plan_solves(
+                        namespace, job_id, deadline)
+                else:
+                    _t.sleep(seconds)
+        except Exception as e:
+            raise HTTPError(500, f"profiler trace failed: {e}")
+        return 200, {"artifact": logdir, "seconds": seconds,
+                     "mode": "solver", "solves": solves}, None
+
+    def _drive_plan_solves(self, namespace: str, job_id: str,
+                           deadline: float) -> int:
+        """Steady-state solve load for the profiler window: repeated
+        dry-run (what-if overlay) solves of an existing job."""
+        import time as _t
+        from ..scheduler.base import new_scheduler
+        from ..structs import (EVAL_STATUS_PENDING,
+                               EVAL_TRIGGER_JOB_REGISTER)
+        job = self.server.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise HTTPError(404, f"unknown job {job_id!r}")
+        workers = getattr(self.server, "workers", None)
+        solver = workers[0].fleet_solver().plan_view() if workers \
+            else None
+        solves = 0
+        while _t.monotonic() < deadline:
+            planner = _DryRunPlanner(self.server.store)
+            ev = Evaluation(namespace=namespace, job_id=job_id,
+                            type=job.type, priority=job.priority,
+                            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                            status=EVAL_STATUS_PENDING,
+                            annotate_plan=True)
+            sched = new_scheduler(job.type, self.server.store.snapshot(),
+                                  planner, solver=solver)
+            sched.process(ev)
+            solves += 1
+        return solves
 
     def system_gc(self, q, body):
         self.server.force_gc()
@@ -1697,6 +1840,13 @@ def _build_routes(s: HTTPAgentServer):
         (R(r"^/v1/status/leader$"), {"GET": s.status_leader}),
         (R(r"^/v1/status/peers$"), {"GET": s.status_peers}),
         (R(r"^/v1/metrics$"), {"GET": s.metrics}),
+        (R(r"^/v1/traces$"), {"GET": s.traces_list}),
+        # literal /v1/trace/corpus must outrank the :id capture
+        (R(r"^/v1/trace/corpus$"), {"GET": s.trace_corpus,
+                                    "POST": s.trace_corpus,
+                                    "PUT": s.trace_corpus}),
+        (R(r"^/v1/trace/([^/]+)$"), {"GET": s.trace_get}),
+        (R(r"^/v1/agent/events$"), {"GET": s.agent_events}),
         (R(r"^/v1/system/gc$"), {"PUT": s.system_gc,
                                  "POST": s.system_gc}),
         (R(r"^/v1/operator/scheduler/configuration$"),

@@ -766,6 +766,14 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
             "recoveries": 0, "last_recovery_bytes": 0,
             "last_recovery_s": 0.0, "degraded_solves": 0,
         }
+        #: mesh event log (ISSUE 10): every grow/shrink/move/fail/
+        #: recover transition lands here with its measured bytes and
+        #: duration — the /v1/agent/events surface.  The process-global
+        #: log by default so one HTTP endpoint sees every mesh.
+        from ..utils.tracing import global_mesh_events
+        _log = kw.pop("event_log", None)
+        # explicit None test: an EMPTY MeshEventLog is falsy (__len__)
+        self.event_log = global_mesh_events if _log is None else _log
         super().__init__(nodes, probe_asks, *args, mesh=mesh,
                          n_devices=n_devices, **kw)
 
@@ -994,7 +1002,9 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         normal delta path.  Raises if the per-shard capacity slack is
         exhausted — grow the slack (NOMAD_TPU_RESHARD_SLACK) or take
         a full repack."""
+        import time as _t
         from ..solver.tensorize import extend_template_rows
+        _t0 = _t.perf_counter()
         tile = self._layout.tile_np
         new = self._layout.grow(n)
         try:
@@ -1019,17 +1029,25 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         self.reshard_counters["tiles_grown"] += n
         self.reshard_counters["last_reshard_bytes"] = shipped
         self.reshard_counters["reshard_bytes_total"] += shipped
+        self.event_log.record(
+            "grow", tiles=[int(t) for t in new], n_tiles=n,
+            tile_np=tile, bytes=shipped,
+            duration_s=round(_t.perf_counter() - _t0, 6),
+            n_shards=self.n_shards)
         return new
 
     def move_tile(self, t: int, dst: int) -> int:
         """Rebalance one tile to shard `dst`, carrying its live usage.
         Only the tile's rows (planes + usage + gid marks) travel.
         Returns the measured bytes."""
+        import time as _t
+        _t0 = _t.perf_counter()
         lay = self._layout
         if lay.owner[t] < 0:
             raise ValueError(f"tile {t} is not owned")
         if lay.owner[t] == dst:
             return 0
+        src_shard = int(lay.owner[t])
         tile = lay.tile_np
         old_rows = lay.dev_rows(t).astype(np.int32)
         # live usage rides along (small device gather)
@@ -1055,6 +1073,10 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         self.reshard_counters["tiles_moved"] += 1
         self.reshard_counters["last_reshard_bytes"] = shipped
         self.reshard_counters["reshard_bytes_total"] += shipped
+        self.event_log.record(
+            "move", tile=int(t), src_shard=src_shard, dst_shard=int(dst),
+            bytes=shipped,
+            duration_s=round(_t.perf_counter() - _t0, 6))
         return shipped
 
     def shrink_tiles(self, n: int = 1) -> List[int]:
@@ -1106,6 +1128,9 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
             self._src_cache = lay.dev_src()
             self._bump_layout_epoch()
             self.reshard_counters["tiles_shrunk"] += len(out)
+            self.event_log.record("shrink",
+                                  tiles=[int(t) for t in out],
+                                  n_tiles=len(out))
         return out
 
     # ---------------- shard-loss recovery ----------------
@@ -1163,6 +1188,10 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         self._rebind(Mesh(np.array(survivors), (MESH_NODE_AXIS,)),
                      new_layout, u, du)
         self.mesh_state = "degraded"
+        self.event_log.record(
+            "fail", shard=int(shard),
+            tiles=[int(t) for t in lost],
+            surviving_shards=len(survivors))
         return lost
 
     def recover(self) -> int:
@@ -1204,6 +1233,12 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         self.reshard_counters["last_recovery_bytes"] = recovered_bytes
         self.reshard_counters["last_recovery_s"] = (
             time.perf_counter() - t0)
+        self.event_log.record(
+            "recover", shard=int(self._failed_shard),
+            bytes=recovered_bytes,
+            duration_s=round(self.reshard_counters["last_recovery_s"],
+                             6),
+            n_shards=self.n_shards)
         return recovered_bytes
 
 
@@ -1252,6 +1287,8 @@ class ElasticMeshSupervisor:
                 return
             self.solver.fail_shard(shard)
             self.events.append(("fail", mid))
+            self.solver.event_log.record("supervisor.fail",
+                                         member=mid, shard=int(shard))
 
     def on_join(self, member) -> None:
         mid = self._member_id(member)
@@ -1261,6 +1298,8 @@ class ElasticMeshSupervisor:
                 return
             self.solver.recover()
             self.events.append(("recover", mid))
+            self.solver.event_log.record("supervisor.recover",
+                                         member=mid)
 
     def note_node_event(self, node_id: str, status: str) -> None:
         """Scheduler-plane trigger: a node-update eval observed

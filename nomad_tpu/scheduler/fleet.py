@@ -40,6 +40,7 @@ class _SolveView:
     def __init__(self, placements, class_eligibility):
         self.placements = placements
         self.class_eligibility = class_eligibility
+        self.trace: dict = {}       # shared fused-solve counters
 
 
 def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
@@ -108,6 +109,7 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
                 solvable.append(e)
 
     out = None
+    spans = {}
     if all_asks:
         # fleet-mode proposed corrections: the shared world carries no
         # stop exclusions (capacity freed by an eval's own stops lands
@@ -123,6 +125,14 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
             preemption_enabled(cfg, "batch" if e.sched.batch
                                else "service")
             for e in solvable)
+        # one fused device solve, one solve span PER member trace: each
+        # eval's timeline stays self-contained, the shared counters
+        # (and fused_batch size) tie the members back together
+        from ..utils.tracing import global_tracer as _tr
+        for e in solvable:
+            spans[e.ev.id] = _tr.stage(
+                e.ev.id, "solve", job_id=e.ev.job_id, fused=True,
+                fused_batch=len(solvable))
         out = worker.fleet_solver().solve(nodes, all_asks, allocs_by_node,
                                           by_dc, snapshot=snapshot,
                                           proposed_delta=([], probes),
@@ -141,8 +151,10 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
         view = _SolveView(
             local_placements,
             out.class_eligibility[e.ask_base:e.ask_base + n_local])
+        view.trace = dict(out.trace)
         e.sched._consume_solve(snapshot, view, nodes, allocs_by_node,
-                               missing, ask_missing)
+                               missing, ask_missing,
+                               span=spans.get(e.ev.id))
 
     # finalize each eval; anything incomplete replays on the single path
     for e in fused:

@@ -250,6 +250,12 @@ class GenericScheduler:
         for d in results.destructive_update:
             self.queued_allocs[d.place_task_group.name] = \
                 self.queued_allocs.get(d.place_task_group.name, 0) + 1
+        from ..utils.tracing import global_tracer as _tr
+        _tr.event(ev.id, "schedule.reconcile",
+                  n_place=len(results.place),
+                  n_destructive=len(results.destructive_update),
+                  n_stop=len(results.stop),
+                  n_inplace=len(results.inplace_update))
 
         missing: List[_Missing] = []
         # destructive replacements go first so their capacity frees up for
@@ -278,15 +284,18 @@ class GenericScheduler:
         stops = [a for lst in self.plan.node_update.values()
                  for a in lst]
         from .preemption import preemption_enabled
+        from ..utils.tracing import global_tracer as _tr
         preempt_ok = preemption_enabled(
             snapshot.scheduler_config(),
             "batch" if self.batch else "service")
+        span = _tr.stage(self.eval.id, "solve",
+                         job_id=self.eval.job_id, fused=False)
         out = self.solver.solve(
             nodes, asks, allocs_by_node, by_dc, snapshot=snapshot,
             proposed_delta=(stops, list(self._sticky_probes)),
             preempt=preempt_ok)
         self._consume_solve(snapshot, out, nodes, allocs_by_node, missing,
-                            ask_missing)
+                            ask_missing, span=span)
         return None
 
     def _prepare_placements(self, snapshot, missing: List[_Missing],
@@ -440,18 +449,25 @@ class GenericScheduler:
 
     def _consume_solve(self, snapshot, out, nodes, allocs_by_node,
                        missing: List[_Missing],
-                       ask_missing: List[List[_Missing]]) -> None:
+                       ask_missing: List[List[_Missing]],
+                       span=None) -> None:
         """Post-solve work: emit allocs, preempt or record failures, and
         retract eager stops for failed destructive replacements. `out`
-        placements must use ask indexes local to `ask_missing`."""
+        placements must use ask indexes local to `ask_missing`.
+        `span`: the eval's open solve trace span — ended here with the
+        device counters (out.trace) and the per-placement corpus rows
+        (chosen node + candidate score window + features, the learned-
+        scorer training substrate)."""
         # map solver placements (contiguous per ask) back to missing
         from .preemption import preemption_enabled
         preempt_ok = preemption_enabled(
             snapshot.scheduler_config(), "batch" if self.batch else "service")
         queues = {g: list(ms) for g, ms in enumerate(ask_missing)}
         failed: set = set()
+        place_rows: List[dict] = []
         for placement in out.placements:
             m = queues[placement.ask_index].pop(0)
+            place_rows.append(_placement_row(m, placement))
             if placement.node is None:
                 if not (preempt_ok and self._try_preemption(
                         nodes, m, allocs_by_node)):
@@ -474,6 +490,9 @@ class GenericScheduler:
             for elig in out.class_eligibility:
                 self._class_eligibility.update(elig)
         self._stop_destructive_for_failed(missing, failed)
+        if span is not None:
+            span.set(**(getattr(out, "trace", None) or {}))
+            span.end(placements=place_rows)
 
     def _stop_destructive_for_failed(self, missing: List[_Missing],
                                      failed: set) -> None:
@@ -766,6 +785,37 @@ class GenericScheduler:
         if self.deployment is not None and status == EVAL_STATUS_COMPLETE:
             ev.deployment_id = self.deployment.id
         self.planner.update_eval(ev)
+
+
+def _placement_row(m: _Missing, placement) -> dict:
+    """One trace-corpus row per placement decision: the chosen (group,
+    node, score) plus the candidate score window and the per-eval
+    feasibility features — failed placements ride along with node_id ""
+    and the failure cause (negative training examples)."""
+    metrics = placement.metrics
+    row = {
+        "group": m.tg.name,
+        "node_id": placement.node.id if placement.node is not None
+        else "",
+        "score": round(float(placement.score), 6),
+        "candidates": [
+            {"node_id": c.get("node_id", ""),
+             "score": round(float(c.get("normalized_score", 0.0)), 6)}
+            for c in (metrics.score_meta or [])]
+        if metrics is not None else [],
+        "features": {
+            "nodes_evaluated": metrics.nodes_evaluated,
+            "nodes_filtered": metrics.nodes_filtered,
+            "nodes_exhausted": metrics.nodes_exhausted,
+            "dimension_exhausted": dict(metrics.dimension_exhausted),
+            "constraint_filtered": dict(metrics.constraint_filtered),
+        } if metrics is not None else {},
+    }
+    if placement.evicted:
+        row["evicted"] = list(placement.evicted)
+    if placement.failed_reason:
+        row["failed_reason"] = placement.failed_reason
+    return row
 
 
 def _update_reschedule_tracker(alloc: Allocation, prev: Allocation,

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..structs import EVAL_STATUS_PENDING, Evaluation
 from ..utils.ids import generate_uuid
+from ..utils.tracing import global_tracer as _tr
 
 FAILED_QUEUE = "_failed"
 DEFAULT_NACK_DELAY_S = 5.0
@@ -203,10 +204,13 @@ class EvalBroker:
             holder = self._job_evals.get(namespaced)
             if holder is not None and holder != ev.id:
                 self._blocked.setdefault(namespaced, _Heap()).push(ev)
+                _tr.event(ev.id, "broker.job_blocked", queue=queue,
+                          holder=holder)
                 return
             self._job_evals[namespaced] = ev.id
         self._ready.setdefault(queue, _Heap()).push(ev)
         self._ready_since[ev.id] = _time.monotonic()
+        _tr.event(ev.id, "broker.enqueue", queue=queue)
         self._lock.notify_all()
 
     # ------------------------------------------------------------- dequeue
@@ -215,7 +219,7 @@ class EvalBroker:
         deadline = _time.monotonic() + timeout
         with self._lock:
             while True:
-                ev = self._dequeue_locked(sched_types)
+                ev, age = self._dequeue_locked(sched_types)
                 if ev is not None:
                     token = generate_uuid()
                     u = _Unack(ev, token)
@@ -224,6 +228,9 @@ class EvalBroker:
                         self._deliveries.get(ev.id, 0) + 1
                     self._dequeues += 1
                     self._start_nack_timer(u)
+                    _tr.event(ev.id, "broker.dequeue",
+                              queue_age_s=round(age, 6),
+                              delivery=self._deliveries[ev.id])
                     return ev, token
                 remain = deadline - _time.monotonic()
                 if remain <= 0 or not self._enabled:
@@ -251,7 +258,8 @@ class EvalBroker:
         return out
 
     def _dequeue_locked(self, sched_types: Sequence[str]
-                        ) -> Optional[Evaluation]:
+                        ) -> Tuple[Optional[Evaluation], float]:
+        """Returns (eval, ready-queue age seconds)."""
         best_q, best_pri = None, None
         for q in sched_types:
             h = self._ready.get(q)
@@ -261,11 +269,14 @@ class EvalBroker:
             if best_pri is None or pri > best_pri:
                 best_q, best_pri = q, pri
         if best_q is None:
-            return None
+            return None, 0.0
         ev = self._ready[best_q].pop()
+        age = 0.0
         if ev is not None:
-            self._ready_since.pop(ev.id, None)
-        return ev
+            t0 = self._ready_since.pop(ev.id, None)
+            if t0 is not None:
+                age = _time.monotonic() - t0
+        return ev, age
 
     def _start_nack_timer(self, u: _Unack) -> None:
         t = threading.Timer(self.nack_delay_s,
@@ -313,6 +324,7 @@ class EvalBroker:
             del self._unack[eval_id]
             self._deliveries.pop(eval_id, None)
             ev = u.eval
+            _tr.event(eval_id, "broker.ack")
             self._release_job_slot_locked(ev, eval_id)
             requeue = self._requeue.pop(eval_id, None)
             if requeue is not None:
@@ -359,11 +371,16 @@ class EvalBroker:
                 # too many failed deliveries: park it for the leader reaper
                 self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
                 self._ready_since[ev.id] = _time.monotonic()
+                _tr.event(eval_id, "broker.nack", parked=True,
+                          deliveries=self._deliveries.get(eval_id, 0))
                 self._lock.notify_all()
                 return None
             # redeliver after a compounding delay
             delay = (self.initial_nack_delay_s
                      * max(1, self._deliveries.get(eval_id, 1)))
+            _tr.event(eval_id, "broker.nack", parked=False,
+                      deliveries=self._deliveries.get(eval_id, 0),
+                      redeliver_delay_s=round(delay, 6))
             ev2 = ev
             deadline = _time.time() + delay
             self._waiting[ev2.id] = ev2

@@ -306,10 +306,19 @@ class PlanApplier:
     def _account_and_respond(self, pending, plan: Plan,
                              result: PlanResult) -> None:
         from ..utils.metrics import global_metrics as _m
+        from ..utils.tracing import global_tracer as _tr
         if result.refresh_index:
             _m.incr_counter("plan.partial_commit")
         _m.incr_counter("plan.node_allocations",
                         sum(len(v) for v in result.node_allocation.values()))
+        _tr.event(plan.eval_id, "plan.apply",
+                  n_alloc=sum(len(v)
+                              for v in result.node_allocation.values()),
+                  n_stop=sum(len(v) for v in result.node_update.values()),
+                  n_preempt=sum(len(v)
+                                for v in result.node_preemptions.values()),
+                  partial=bool(result.refresh_index),
+                  alloc_index=result.alloc_index)
         # preempted allocs need follow-up evals for their jobs
         if self.create_evals and plan.node_preemptions:
             preempted_jobs = {}

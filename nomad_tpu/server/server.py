@@ -115,6 +115,7 @@ class Server:
         self.node_gc_threshold_s = node_gc_threshold_s
         self.deployment_gc_threshold_s = deployment_gc_threshold_s
         self._gc_timer: Optional[threading.Thread] = None
+        self._metrics_timer: Optional[threading.Thread] = None
         self._started = False
         self._stop_reapers = threading.Event()
         self._dup_reaper: Optional[threading.Thread] = None
@@ -176,6 +177,13 @@ class Server:
         self._gc_timer = threading.Thread(target=self._schedule_periodic_gc,
                                           daemon=True)
         self._gc_timer.start()
+        # broker gauges must not freeze while every worker is paused or
+        # draining (the worker loop was their only exporter): a leader
+        # timer re-exports them on a fixed beat, idempotently — gauges
+        # are plain sets, so the two exporters never conflict
+        self._metrics_timer = threading.Thread(
+            target=self._export_metrics_loop, daemon=True)
+        self._metrics_timer.start()
         self._started = True
         self._restore_evals()
 
@@ -257,6 +265,13 @@ class Server:
             for kind in (CORE_JOB_EVAL_GC, CORE_JOB_NODE_GC,
                          CORE_JOB_JOB_GC, CORE_JOB_DEPLOYMENT_GC):
                 self.broker.enqueue(self._core_job_eval(kind))
+
+    #: server-side broker-gauge export beat (seconds)
+    METRICS_EXPORT_INTERVAL_S = 1.0
+
+    def _export_metrics_loop(self) -> None:
+        while not self._stop_reapers.wait(self.METRICS_EXPORT_INTERVAL_S):
+            self.broker.export_metrics()
 
     def _core_job_eval(self, kind: str) -> Evaluation:
         index = self.store.latest_index()
@@ -525,6 +540,7 @@ class Server:
         (reference: fsm.go:680 handleUpsertedEval)."""
         if not evals:
             return
+        from ..utils.tracing import global_tracer as _tr
         head = self.store.latest_index() + 1
         for ev in evals:
             if not ev.create_time:
@@ -537,6 +553,13 @@ class Server:
         for ev in evals:
             stored = self.store.eval_by_id(ev.id) or ev
             if stored.should_enqueue():
+                # flight-recorder root (ISSUE 10): the eval id IS the
+                # trace id; every later lifecycle stage chains on this
+                _tr.event(stored.id, "create", parent="",
+                          job_id=stored.job_id,
+                          namespace=stored.namespace,
+                          priority=stored.priority, type=stored.type,
+                          triggered_by=stored.triggered_by)
                 # serving-tier admission gate (ISSUE 6): bounded broker
                 # ingress with priority-aware shedding.  Shed evals park
                 # in blocked_evals' shed lane — still persisted PENDING
@@ -544,11 +567,16 @@ class Server:
                 # worker's readmit tick).  Broker-internal re-enqueues
                 # (nack redelivery, blocked promotion, delayed evals)
                 # are not ingress and bypass this gate.
-                if (self.serving is not None
-                        and not self.serving.admission.offer(
-                            stored, self.broker.ready_count())):
+                admitted, cause = (
+                    self.serving.admission.offer_ex(
+                        stored, self.broker.ready_count())
+                    if self.serving is not None else (True, ""))
+                if not admitted:
+                    _tr.event(stored.id, "admit", admitted=False,
+                              shed_cause=cause)
                     self.blocked_evals.shed(stored)
                 else:
+                    _tr.event(stored.id, "admit", admitted=True)
                     self.broker.enqueue(stored)
             elif stored.should_block():
                 self.blocked_evals.block(stored)

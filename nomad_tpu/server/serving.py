@@ -33,7 +33,7 @@ from __future__ import annotations
 import os
 import threading
 import time as _time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..structs import JOB_TYPE_CORE, Evaluation
 
@@ -257,6 +257,13 @@ class AdmissionController:
     def offer(self, ev: Evaluation, ready_count: int) -> bool:
         """True = admit (caller enqueues), False = shed (caller parks
         the eval in BlockedEvals.shed)."""
+        return self.offer_ex(ev, ready_count)[0]
+
+    def offer_ex(self, ev: Evaluation, ready_count: int
+                 ) -> "Tuple[bool, str]":
+        """`offer` plus the shed cause — "max_pending", "brownout" or
+        "fairness" when shedding, "" when admitted.  The cause lands on
+        the eval's admit trace span (shed causality, ISSUE 10)."""
         now = _time.monotonic()
         protected = (ev.priority >= self.protect_priority
                      or ev.type == JOB_TYPE_CORE)
@@ -264,13 +271,13 @@ class AdmissionController:
             self._track_overload_locked(ready_count, now)
             if protected:
                 self._admitted += 1
-                return True
+                return True, ""
             if ready_count >= self.max_pending:
                 self._shed_locked(ev)
-                return False
+                return False, "max_pending"
             if self._brownout:
                 self._shed_locked(ev)
-                return False
+                return False, "brownout"
             if ready_count >= self.fairness_watermark * self.max_pending:
                 b = self._buckets.get(ev.namespace)
                 if b is None:
@@ -278,9 +285,9 @@ class AdmissionController:
                     self._buckets[ev.namespace] = b
                 if not b.take():
                     self._shed_locked(ev)
-                    return False
+                    return False, "fairness"
             self._admitted += 1
-            return True
+            return True, ""
 
     def _shed_locked(self, ev: Evaluation) -> None:
         self._shed += 1

@@ -120,7 +120,10 @@ class Worker(threading.Thread):
             broker.ready_count(), broker.oldest_ready_age())
 
     def _run_batch(self, serving, batch) -> None:
+        from ..utils.tracing import global_tracer as _tr
         if len(batch) == 1:
+            _tr.event(batch[0][0].id, "worker.batch", batch_size=1,
+                      lane="single")
             self._process(*batch[0])
             return
         express, bulk = [], []
@@ -130,6 +133,12 @@ class Worker(threading.Thread):
                 express.append((ev, token))
             else:
                 bulk.append((ev, token))
+        for ev, _tok in express:
+            _tr.event(ev.id, "worker.batch", batch_size=len(batch),
+                      lane="express")
+        for ev, _tok in bulk:
+            _tr.event(ev.id, "worker.batch", batch_size=len(batch),
+                      lane="bulk" if len(bulk) > 1 else "single")
         # bypass lane: interactive/high-priority evals solve singly
         # FIRST (the in-process host path for small clusters — one
         # tunnel round trip), ahead of the fused bulk solve
@@ -165,9 +174,11 @@ class Worker(threading.Thread):
         server.broker.pause_nack_timeout(ev.id, token)
         # wait for local state to reach the eval's creation point
         # (reference metric: nomad.worker.wait_for_index)
+        from ..utils.tracing import global_tracer as _tr
         wait_index = max(ev.modify_index, ev.snapshot_index)
         t0 = _t.monotonic()
-        server.store.wait_for_index(wait_index, timeout=5.0)
+        with _tr.stage(ev.id, "worker.wait_index", index=wait_index):
+            server.store.wait_for_index(wait_index, timeout=5.0)
         _m.measure_since("worker.wait_for_index", t0)
         if self.mesh_supervisor is not None and ev.node_id:
             from ..structs import EVAL_TRIGGER_NODE_UPDATE
@@ -219,16 +230,26 @@ class Worker(threading.Thread):
         import time as _t
 
         from ..utils.metrics import global_metrics as _m
+        from ..utils.tracing import global_tracer as _tr
         t0 = _t.monotonic()
+        sp = _tr.stage(plan.eval_id, "plan.submit",
+                       n_alloc=sum(len(v) for v in
+                                   plan.node_allocation.values()),
+                       n_stop=sum(len(v) for v in
+                                  plan.node_update.values()))
         pending = self.server.plan_queue.enqueue(plan)
         if pending is None:
+            sp.end(outcome="queue_disabled")
             return None, None
         result, err = pending.future.wait(30.0)
         # reference metric: nomad.worker.submit_plan (p50/p99 plan-submit
         # latency — the BASELINE.md headline latency metric)
         _m.measure_since("worker.submit_plan", t0)
         if err is not None or result is None:
+            sp.end(outcome=f"error: {err}" if err else "no result")
             return None, None
+        sp.end(outcome="applied", alloc_index=result.alloc_index,
+               refresh_index=result.refresh_index)
         # feed the applied changeset into the solver's resident world:
         # the next eval's solve starts from already-advanced tensors
         # (the change-log sync then dedups these same writes)

@@ -1010,6 +1010,37 @@ class ResidentSolver:
             out["measured"] = m
         return out
 
+    def trace_attrs(self, batches: Optional[Sequence[PackedBatch]] = None
+                    ) -> Dict:
+        """Flight-recorder attributes for the last dispatched stream
+        (ISSUE 10): the measured wave/rescore/shortlist counters, the
+        eviction-commit count, the resident-delta counters and — when
+        the solved batches are passed — the full two-tier byte model
+        (ICI/DCN tiers included on the mesh solvers, which override
+        wave_traffic).  This is the structured form the solve span
+        carries instead of the bench-only JSON."""
+        attrs: Dict = {"delta": dict(self.delta_counters)}
+        m = self.measured_wave_counters()
+        if m is not None:
+            attrs.update(m)
+        ev = self.last_evict
+        if ev is not None:
+            evs = ev if isinstance(ev, list) else [ev]
+            attrs["evict_commits"] = int(sum(
+                int(np.asarray(e).any(axis=-1).sum())
+                for e in evs if e is not None))
+        if self.last_solve_stats is not None:
+            attrs["solve"] = dict(self.last_solve_stats)
+        if batches:
+            try:
+                wt = self.wave_traffic(batches)
+            except Exception:   # the model must never fail a trace
+                wt = None
+            if wt is not None:
+                attrs["wave_traffic"] = {
+                    k: v for k, v in wt.items() if k != "delta"}
+        return attrs
+
     def measured_wave_counters(self) -> Optional[Dict]:
         """Waves / full-rescore waves of the LAST dispatched stream(s)
         (fetch syncs).  shortlist_waves is the remainder — the waves

@@ -328,6 +328,11 @@ class SolveOutput:
     placements: List[Placement]
     class_eligibility: List[Dict[str, bool]] = field(default_factory=list)
     # ^ per ask: computed-class -> any feasible node of that class
+    #: flight-recorder attributes for the solve span (ISSUE 10): device
+    #: wave/rescore/evict counters, the two-tier modeled HBM bytes and
+    #: the resident-world delta counters — callers attach this to the
+    #: eval's trace instead of re-deriving it
+    trace: Dict = field(default_factory=dict)
 
 
 class Solver:
@@ -497,15 +502,26 @@ class Solver:
                                          overlay_only=_overlay_only)
             if packed is not None:
                 pb, sol_nodes = packed
+        used_resident = pb is not None
         if pb is None:
             with self._world_lock:
                 # the tensorizer's interners are shared with concurrent
                 # plan-view solves — serialize every pack through it
                 pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
+        import time as _t
+        _solve_t0 = _t.perf_counter()
         res = _run_kernel(pb, host_mode=self._host,
                           max_waves=BROWNOUT_MAX_WAVES
                           if self._degraded else 0,
                           preempt=preempt)
+        trace_attrs = solve_trace_attrs(pb, res)
+        trace_attrs["kernel_wall_s"] = round(
+            _t.perf_counter() - _solve_t0, 6)
+        trace_attrs["resident"] = used_resident
+        if used_resident:
+            world = self._world
+            if world is not None:
+                trace_attrs["world"] = dict(world.counters)
 
         choice = np.asarray(res.choice)
         choice_ok = np.asarray(res.choice_ok)
@@ -649,7 +665,8 @@ class Solver:
             class_elig.append(elig)
 
         return SolveOutput(placements=placements,
-                           class_eligibility=class_elig)
+                           class_eligibility=class_elig,
+                           trace=trace_attrs)
 
     def _evict_commit(self, ni: int, g: int, ask: PlacementAsk,
                       pb: PackedBatch, sol_nodes, allocs_by_node,
@@ -831,6 +848,55 @@ class PlanSolverView:
     def solve(self, *args, **kw) -> SolveOutput:
         kw["_overlay_only"] = True
         return self._inner.solve(*args, **kw)
+
+
+def solve_trace_attrs(pb: PackedBatch, res) -> Dict:
+    """Flight-recorder attributes for one kernel run: the device wave/
+    rescore/evict counters from the SolveResult plus the ISSUE-4
+    two-tier modeled HBM bytes for this solve shape.  Pure read — the
+    result arrays were fetched by the caller's unpack anyway."""
+    import numpy as _np
+    waves = int(_np.asarray(res.n_waves))
+    rescore = (int(_np.asarray(res.n_rescore))
+               if res.n_rescore is not None else waves)
+    evicted = (int(_np.asarray(res.evict).any(axis=1).sum())
+               if res.evict is not None else 0)
+    backend = ("host" if type(res.choice).__module__
+               .startswith("numpy") else "device")
+    attrs = {"n_asks": int(pb.n_asks), "n_place": int(pb.n_place),
+             "n_nodes": int(pb.n_real), "backend": backend,
+             "waves": waves, "rescore_waves": rescore,
+             "shortlist_waves": waves - rescore,
+             "evict_commits": evicted,
+             "unfinished": int(_np.asarray(res.unfinished).sum())}
+    try:
+        # modeled bytes mirror ResidentSolver.wave_traffic's resolution
+        # (best effort: a model failure must never fail a solve)
+        from . import pallas_kernel as _pk
+        from .kernel import (MERGED_GP_MAX, TOP_K as _TK, WAVE_K,
+                             _MERGED_W_CAP, _WIDE_W_CAP,
+                             resolve_shortlist_c)
+        from .resident import model_wave_bytes
+        Np, R = pb.avail.shape
+        Gp = pb.ask_res.shape[0]
+        K = pb.p_ask.shape[0]
+        S = pb.sp_desired.shape[1]
+        has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
+        w_cap = (_MERGED_W_CAP if Gp <= MERGED_GP_MAX else _WIDE_W_CAP)
+        TKw = min(max(WAVE_K, w_cap) + _TK, Np)
+        C = (0 if bool((_np.asarray(pb.distinct) >= 0).any())
+             else resolve_shortlist_c(Np, TKw, 0))
+        V = pb.sp_desired.shape[2]
+        mode = _pk.resolve_mode(Np, Gp, TKw, V, has_spread)
+        b1, brw, _passes = model_wave_bytes(Np, Gp, K, S, R,
+                                            has_spread, mode, TKw, C)
+        attrs["bytes_wave1"] = int(b1)
+        attrs["bytes_rewave"] = int(brw)
+        attrs["modeled_bytes_total"] = int(
+            b1 * rescore + brw * (waves - rescore))
+    except Exception:
+        pass
+    return attrs
 
 
 def _run_kernel(pb: PackedBatch, host_mode: str = "auto",

@@ -8,6 +8,8 @@ analog; `timed(key)` the context-manager sugar.
 """
 from __future__ import annotations
 
+import os
+import re
 import threading
 import time as _time
 from contextlib import contextmanager
@@ -15,6 +17,14 @@ from typing import Dict, List, Optional
 
 
 _RESERVOIR = 2048
+
+#: per-namespace key-cardinality cap (namespace = the key's first
+#: dot-segment).  A runaway label (per-eval ids, per-node gauges from a
+#: buggy caller) must not grow the registry without bound: past the cap
+#: new keys are dropped and the `metrics.overflow` counter ticks.
+#: NOMAD_TPU_METRICS_MAX_KEYS overrides.
+DEFAULT_MAX_KEYS_PER_NS = 512
+OVERFLOW_KEY = "metrics.overflow"
 
 
 class _Summary:
@@ -59,23 +69,51 @@ class _Summary:
 
 
 class MetricsRegistry:
-    def __init__(self):
+    def __init__(self, max_keys_per_ns: Optional[int] = None):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._samples: Dict[str, _Summary] = {}
+        if max_keys_per_ns is None:
+            try:
+                max_keys_per_ns = int(os.environ.get(
+                    "NOMAD_TPU_METRICS_MAX_KEYS",
+                    str(DEFAULT_MAX_KEYS_PER_NS)))
+            except ValueError:
+                max_keys_per_ns = DEFAULT_MAX_KEYS_PER_NS
+        self.max_keys_per_ns = max(int(max_keys_per_ns), 1)
+        self._ns_keys: Dict[str, int] = {}   # namespace -> distinct keys
+
+    def _admit_locked(self, key: str, table: dict) -> bool:
+        """Label-explosion guard: True when `key` may be written to
+        `table` — existing keys always pass, a NEW key only while its
+        namespace is under the cap (otherwise the overflow counter
+        ticks and the write is dropped)."""
+        if key in table:
+            return True
+        ns = key.split(".", 1)[0]
+        n = self._ns_keys.get(ns, 0)
+        if n >= self.max_keys_per_ns and key != OVERFLOW_KEY:
+            self._counters[OVERFLOW_KEY] = \
+                self._counters.get(OVERFLOW_KEY, 0.0) + 1.0
+            return False
+        self._ns_keys[ns] = n + 1
+        return True
 
     def incr_counter(self, key: str, value: float = 1.0) -> None:
         with self._lock:
-            self._counters[key] = self._counters.get(key, 0.0) + value
+            if self._admit_locked(key, self._counters):
+                self._counters[key] = self._counters.get(key, 0.0) + value
 
     def set_gauge(self, key: str, value: float) -> None:
         with self._lock:
-            self._gauges[key] = value
+            if self._admit_locked(key, self._gauges):
+                self._gauges[key] = value
 
     def add_sample(self, key: str, value_s: float) -> None:
         with self._lock:
-            self._samples.setdefault(key, _Summary()).add(value_s)
+            if self._admit_locked(key, self._samples):
+                self._samples.setdefault(key, _Summary()).add(value_s)
 
     def measure_since(self, key: str, t0: float) -> None:
         """t0 from time.monotonic(); records seconds elapsed."""
@@ -103,6 +141,63 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._samples.clear()
+            self._ns_keys.clear()
+
+    # --------------------------------------------------------- prometheus
+    def prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the registry —
+        served at /v1/metrics?format=prometheus next to the JSON dump.
+        Counters map to `counter`, gauges to `gauge`, timing samples to
+        a `summary` (quantile series + _sum/_count).  Keys are mangled
+        to the metric charset ([a-zA-Z0-9_:]); collisions after
+        mangling keep the first-seen series (stable within a dump —
+        both orderings are sorted)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            samples = sorted((k, s.snapshot())
+                             for k, s in self._samples.items())
+        out: List[str] = []
+        seen: set = set()
+
+        def name(key: str) -> Optional[str]:
+            n = re.sub(r"[^a-zA-Z0-9_:]", "_", key)
+            if re.match(r"^[0-9]", n):
+                n = "_" + n
+            if n in seen:
+                return None
+            seen.add(n)
+            return n
+
+        for key, v in counters:
+            n = name(key)
+            if n is None:
+                continue
+            out.append(f"# TYPE {n} counter")
+            out.append(f"{n} {_fmt(v)}")
+        for key, v in gauges:
+            n = name(key)
+            if n is None:
+                continue
+            out.append(f"# TYPE {n} gauge")
+            out.append(f"{n} {_fmt(v)}")
+        for key, snap in samples:
+            n = name(key)
+            if n is None:
+                continue
+            out.append(f"# TYPE {n} summary")
+            out.append(f'{n}{{quantile="0.5"}} {_fmt(snap["p50"])}')
+            out.append(f'{n}{{quantile="0.99"}} {_fmt(snap["p99"])}')
+            out.append(f"{n}_sum {_fmt(snap['sum'])}")
+            out.append(f"{n}_count {snap['count']}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integral floats render bare."""
+    if float(v) == int(v):
+        return str(int(v))
+    return repr(float(v))
 
 
 #: process-global registry (the go-metrics global sink analog)
