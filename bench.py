@@ -2061,6 +2061,14 @@ def lint_summary():
     except Exception as e:          # never lose the run over lint
         out = {"error": str(e)}
     try:
+        # scoring-spec provenance: which spec version (and term list)
+        # every backend was verified against when this run was taken
+        from nomad_tpu.solver import score_spec
+        out["score_spec"] = {"version": score_spec.SPEC_VERSION,
+                             "terms": list(score_spec.term_names())}
+    except Exception:
+        pass
+    try:
         # flight-recorder shape for this run (ISSUE 10): the startup
         # line + BENCH_DETAIL record what the trace ring could hold
         from nomad_tpu.utils.tracing import global_tracer

@@ -6,13 +6,21 @@ Exit-code contract (stable, scripted against by CI):
   1  at least one unsuppressed ERROR-tier finding
   2  baseline/config error (unjustified entry, unreadable file)
   3  unsuppressed WARN-tier findings only (advisory heuristics:
-     LOCK302 / SHARD403 / ALIAS503 / SCORE603)
+     LOCK302 / SHARD403 / ALIAS503)
 
 `--no-baseline` is a REPORTING mode, not a gating mode: it lists every
 finding (each tagged with whether the checked-in baseline would
 suppress it) but the exit code is still computed from the
 baseline-aware verdict — so `--no-baseline --json` in a CI pipeline
 does not fail a clean tree just because accepted findings exist.
+
+`--paths FILE...` is file-scoped INCREMENTAL mode for pre-commit
+hooks: the whole package is still indexed (cross-file facts — mesh
+reachability, spec reference fingerprints — need the full call
+graph), but only findings in the named files are reported, and the
+registry-rot/coverage rules (SCORE603/SCORE604) are muted because a
+per-file view cannot judge them.  CI must keep running WITHOUT
+`--paths` so the whole-package invariants stay enforced.
 """
 from __future__ import annotations
 
@@ -52,12 +60,23 @@ def main(argv=None) -> int:
     ap.add_argument("--prune-stale", action="store_true",
                     help="rewrite the baseline file without entries "
                          "that no longer match any finding")
+    ap.add_argument("--paths", nargs="+", metavar="FILE", default=None,
+                    help="file-scoped incremental mode: report ONLY "
+                         "findings in these files (pre-commit); "
+                         "SCORE603/SCORE604 are muted — CI must run "
+                         "without --paths")
     args = ap.parse_args(argv)
+    if args.paths and args.prune_stale:
+        # a partial index makes most baseline entries look stale;
+        # pruning on that view would wrongly delete live entries
+        print("--prune-stale needs the whole-package view; run it "
+              "without --paths", file=sys.stderr)
+        return 2
 
     bl_path = args.baseline or default_baseline_path()
     try:
         baseline = load_baseline(bl_path)
-        rep = analyze(baseline=baseline)
+        rep = analyze(baseline=baseline, paths=args.paths)
     except BaselineError as e:
         print(f"baseline error: {e}", file=sys.stderr)
         return 2
@@ -66,7 +85,7 @@ def main(argv=None) -> int:
             print(f"baseline error: {e}", file=sys.stderr)
             return 2
         baseline = None
-        rep = analyze(use_baseline=False)
+        rep = analyze(use_baseline=False, paths=args.paths)
 
     if args.prune_stale and rep.stale_baseline_keys:
         pruned = baseline.without(rep.stale_baseline_keys)
@@ -103,7 +122,9 @@ def main(argv=None) -> int:
             tag = " [baselined]" if id(f) in suppressed_keys else ""
             sev = "" if f.severity == "error" else " (warn)"
             print(f.render() + tag + sev)
-        for k in rep.stale_baseline_keys:
+        # a partial --paths view strands most baseline entries; only a
+        # whole-package run can call an entry stale
+        for k in ([] if args.paths else rep.stale_baseline_keys):
             near = rep.stale_suggestions.get(k)
             extra = f" (nearest current key: {near})" if near else ""
             print("warning: stale baseline entry matches nothing: "

@@ -24,7 +24,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # severity tiers: "error" findings gate CI (exit 1); "warn" findings
 # are advisory heuristics (exit 3 when they are the only findings).
 # Everything not listed here is an error.
-WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503", "SCORE603"})
+WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503"})
 
 # rule-id prefix -> pass name (used by --json/by_pass and bench's
 # lint_summary so BENCH_DETAIL records per-pass lint state)
@@ -263,7 +263,8 @@ class PackageIndex:
 
     # ------------------------------------------------------------ build
     @classmethod
-    def build(cls, package_dir: str, package_name: str) -> "PackageIndex":
+    def build(cls, package_dir: str,
+              package_name: str) -> "PackageIndex":
         idx = cls(package_name)
         pkg_root = os.path.join(package_dir, package_name)
         for dirpath, dirnames, filenames in os.walk(pkg_root):

@@ -1,47 +1,61 @@
-"""Pass 6: cross-backend scoring drift (SCORE6xx).
+"""Pass 6: scoring-spec conformance (SCORE6xx, v3).
 
-The exact scorer is replicated float-order-exact in FOUR backends —
-the numpy host twin (`host.group_scores`), the jit kernel twin
-(`kernel.group_scores`), the shortlist VMEM twin
-(`kernel._sl_eval`), the pallas fused pass (`_wave_tile_kernel`) —
-plus the native C++ engine (`host_solve.cc`). Every new scoring term
-must land in all of them with the same constants and the same float-op
-structure, or placements silently diverge between backends (ROADMAP
-item 5 names this replication the main drag on the learned-scorer and
-in-kernel-preemption work).
+The exact scorer used to be replicated float-order-exact in five hand
+backends, held identical only by backend-vs-backend drift fingerprints
+(v2 of this pass).  `nomad_tpu/solver/score_spec.py` is now the single
+declarative source of truth: each term carries its exact float-op
+sequence, constants and combine order, and the backends split in two:
 
-This pass normalizes each REGISTERED scorer site into a canonical
-per-term float-op fingerprint and fails on structural divergence:
+  * DRIVEN — the host twin (`host.host_solve_kernel.group_scores`)
+    and the jit wave scorer (`kernel.solve_kernel.group_scores`) call
+    `score_spec.evaluate_wave`; they are bit-identical to the spec by
+    construction and must contain NO scoring arithmetic of their own.
+  * HAND, SPEC-VERIFIED — the shortlist VMEM twin
+    (`kernel._sl_eval`), the pallas fused pass (`_wave_tile_kernel`)
+    and the native C++ engine (`host_solve.cc`) stay hand-written for
+    performance; this pass compiles the spec into per-term reference
+    fingerprints and statically proves each of them implements the
+    spec.
 
-  * terms are groups of assignments to canonical names (`free_cpu`/
-    `free_mem`, `raw`+`binpack`, `anti`, `pen*`, `n_scorers`,
-    `total`);
-  * a term fingerprint is the multiset of float CONSTANTS plus the
-    counts of arithmetic ops (+ - * / ** neg) in those assignments —
-    leaf variable names, indexing and where/select CONDITIONS are
-    excluded (they legitimately differ between vectorized numpy,
-    pallas refs and scalar C++), cast wrappers (`f32(...)`,
-    `.astype(...)`) are transparent;
-  * the native backend is tokenized from C++ source with a small
-    translation layer: `std::pow` -> `**`, `std::min(std::max(x,a),b)`
-    -> `clip(a, b)`, ternaries drop their condition like `where`,
-    bool-to-float `(c ? 1.0f : 0.0f)` folds away like an implicit
-    cast, subscripts are stripped;
-  * the `spread` term is compared as a SET of core constants only —
-    its loop structure genuinely differs per backend (numpy
-    take_along_axis vs pallas select-sum vs scalar C++).
+The spec registry (`score_spec.TERMS`) is a pure literal read with
+`ast.literal_eval` — the analyzer never imports the solver.  Each
+entry names the reference term function, the fingerprint groups
+(group -> the assignment-target aliases backends may use), whether a
+group compares as a constant SET only (loop structure genuinely
+differs per backend), and exactly which backends must implement it.
+
+A term fingerprint is the multiset of float CONSTANTS plus the counts
+of arithmetic ops (+ - * / ** neg) in the group's assignments — leaf
+variable names, indexing and where/select CONDITIONS are excluded
+(they legitimately differ between vectorized numpy, pallas refs and
+scalar C++), cast wrappers (`f32(...)`, `.astype(...)`) are
+transparent.  The native backend is tokenized from C++ source with a
+small translation layer (`std::pow` -> `**`,
+`std::min(std::max(x,a),b)` -> `clip`, ternaries drop their condition
+like `where`, bool-to-float coercions fold away, subscripts are
+stripped).
 
 Rules
-  SCORE601  a registered backend's term fingerprint diverges from the
-            reference backend (first site in the registry)
-  SCORE602  scoring-shaped arithmetic outside the registered sites: an
-            assignment combining two or more registered score terms
-            (the "new term hand-added in one backend, or a fifth
-            ad-hoc scorer" shape) — register the site or move the
-            logic into a registered scorer
-  SCORE603  a registered site no longer resolves (registry rot after a
-            rename/refactor: the drift check would go silently blind)
-            (warn tier)
+  SCORE601  a backend's term fingerprint diverges from the SPEC
+            reference (or a spec-driven backend carries hand scoring
+            arithmetic — by construction that IS drift-vs-spec)
+  SCORE602  scoring-shaped arithmetic outside the spec and the
+            registered sites: an assignment combining two or more
+            registered score terms (the "new term hand-added in one
+            backend" shape) — move it into the spec / a registered
+            site
+  SCORE603  a registered site no longer resolves, or the spec registry
+            itself is missing/unparseable (registry rot: the
+            conformance check would go silently blind) (error tier;
+            baseline with a justification for intentional removals)
+  SCORE604  spec/backend coverage drift: a backend misses a spec term
+            it is registered for, implements a term it is NOT
+            registered for, a term names an unknown backend, or a
+            driven backend no longer calls the spec term loop
+
+Configs without a spec-kind site row (fixtures, older registries)
+fall back to the v2 behavior: the first registered site is the drift
+reference and terms are grouped by the built-in TERM_NAMES map.
 """
 from __future__ import annotations
 
@@ -58,25 +72,33 @@ from .core import AnalysisConfig, Finding, FuncInfo, PackageIndex, \
 # ---------------------------------------------------------- registry
 @dataclasses.dataclass(frozen=True)
 class ScorerSite:
-    backend: str          # "host" | "kernel" | "shortlist" | ...
-    kind: str             # "python" | "native"
-    site: str             # "module:qualname" fnmatch pattern, or a
-                          # package-relative source path for native
-    terms: Tuple[str, ...] = ()   # terms this backend must carry;
-                                  # empty = DEFAULT_TERMS
+    backend: str          # "spec" | "host" | "kernel" | ...
+    kind: str             # "spec" | "driven" | "python" | "native"
+    site: str             # spec: the spec MODULE name; python/driven:
+                          # "module:qualname" fnmatch pattern; native:
+                          # a package-relative source path
+    terms: Tuple[str, ...] = ()   # v2 path only: terms this backend
+                                  # must carry; empty = DEFAULT_TERMS
 
+
+#: the spec module every v3 registry row is verified against
+SPEC_MODULE = "nomad_tpu.solver.score_spec"
+#: the term-loop entry point every DRIVEN backend must call
+DRIVEN_ENTRY = "evaluate_wave"
 
 DEFAULT_TERMS = ("free", "binpack", "anti", "pen", "n_scorers",
                  "total", "spread")
 
-#: the scoring-site registry: ONE row per backend replica of the exact
-#: scorer. Adding a new backend scorer = adding a row here (and
-#: keeping its float ops term-identical); writing scoring arithmetic
-#: anywhere else trips SCORE602. The first row is the drift reference.
+#: the scoring-site registry: the spec row is the reference; "driven"
+#: rows must defer to it, "python"/"native" rows are hand replicas
+#: verified against it.  Adding a backend scorer = adding a row here
+#: AND listing the backend in the relevant score_spec.TERMS entries;
+#: writing scoring arithmetic anywhere else trips SCORE602.
 DEFAULT_SCORER_SITES: Tuple[ScorerSite, ...] = (
-    ScorerSite("host", "python",
+    ScorerSite("spec", "spec", SPEC_MODULE),
+    ScorerSite("host", "driven",
                "nomad_tpu.solver.host:host_solve_kernel.group_scores"),
-    ScorerSite("kernel", "python",
+    ScorerSite("kernel", "driven",
                "nomad_tpu.solver.kernel:solve_kernel.group_scores"),
     ScorerSite("shortlist", "python",
                "nomad_tpu.solver.kernel:solve_kernel._sl_eval"),
@@ -87,7 +109,8 @@ DEFAULT_SCORER_SITES: Tuple[ScorerSite, ...] = (
                             "host_solve.cc")),
 )
 
-# canonical term -> the assignment-target names that belong to it
+# v2 fallback: canonical term -> assignment-target names (the v3 path
+# derives this mapping from score_spec.TERMS instead)
 TERM_NAMES: Dict[str, Tuple[str, ...]] = {
     "free": ("free_cpu", "free_mem"),
     "binpack": ("raw", "binpack"),
@@ -124,6 +147,9 @@ class TermPrint:
     def describe(self) -> str:
         ops = ", ".join(f"{o}x{n}" for o, n in self.ops) or "-"
         return f"ops[{ops}] consts{list(self.consts)}"
+
+    def empty(self) -> bool:
+        return not self.consts and not self.ops
 
 
 # ====================================================== python extract
@@ -250,26 +276,84 @@ def _term_assignments(index: PackageIndex, fi: FuncInfo,
     return out
 
 
+def _print_nodes(nodes: Sequence[ast.AST]) -> TermPrint:
+    p = _PyPrinter()
+    for node in nodes:
+        p.feed(node.value)
+        if isinstance(node, ast.AugAssign):
+            p._op({ast.Add: "add", ast.Sub: "sub",
+                   ast.Mult: "mul", ast.Div: "div"}.get(
+                       type(node.op), "add"))
+    return TermPrint(consts=tuple(sorted(p.consts)),
+                     ops=tuple(sorted(p.ops.items())),
+                     const_set=tuple(sorted(set(p.consts))))
+
+
 def python_fingerprint(index: PackageIndex, fi: FuncInfo,
-                       terms: Sequence[str]) -> Dict[str, TermPrint]:
+                       terms: Sequence[str],
+                       names: Optional[Dict[str, Tuple[str, ...]]]
+                       = None) -> Dict[str, TermPrint]:
+    names = names or TERM_NAMES
     prints: Dict[str, TermPrint] = {}
     for term in terms:
-        nodes = _term_assignments(index, fi, TERM_NAMES[term])
+        nodes = _term_assignments(index, fi, tuple(names[term]))
         if not nodes:
             continue
-        p = _PyPrinter()
-        for node in nodes:
-            val = node.value
-            p.feed(val)
-            if isinstance(node, ast.AugAssign):
-                p._op({ast.Add: "add", ast.Sub: "sub",
-                       ast.Mult: "mul", ast.Div: "div"}.get(
-                           type(node.op), "add"))
-        prints[term] = TermPrint(
-            consts=tuple(sorted(p.consts)),
-            ops=tuple(sorted(p.ops.items())),
-            const_set=tuple(sorted(set(p.consts))))
+        prints[term] = _print_nodes(nodes)
     return prints
+
+
+# ====================================================== spec registry
+def load_spec_literal(index: PackageIndex, module: str, name: str):
+    """Evaluate a module-level pure-literal assignment (TERMS /
+    SPEC_VERSION) from the spec module's AST — never imports it."""
+    mi = index.modules.get(module)
+    if mi is None:
+        return None
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name:
+            try:
+                return ast.literal_eval(node.value)
+            except (ValueError, SyntaxError, TypeError):
+                return None
+    return None
+
+
+def spec_reference(index: PackageIndex, module: str = SPEC_MODULE):
+    """Compile the spec into its reference fingerprints.
+
+    Returns (terms_reg, prints, names_map, const_set_groups, errors):
+    `terms_reg` the parsed TERMS literal, `prints` group -> TermPrint
+    fingerprinted from the registered term functions, `names_map`
+    group -> backend assignment-target aliases, `errors` human
+    strings for anything that failed to resolve (registry rot)."""
+    errors: List[str] = []
+    terms_reg = load_spec_literal(index, module, "TERMS")
+    if not terms_reg:
+        return None, {}, {}, set(), [
+            f"spec registry `{module}.TERMS` missing or not a pure "
+            "literal"]
+    prints: Dict[str, TermPrint] = {}
+    names_map: Dict[str, Tuple[str, ...]] = {}
+    const_set_groups: Set[str] = set()
+    for entry in terms_reg:
+        fkey = f"{module}:{entry['fn']}"
+        fi = index.functions.get(fkey)
+        if fi is None and entry.get("groups"):
+            errors.append(
+                f"spec term `{entry['name']}` names function "
+                f"`{entry['fn']}` which does not exist in {module}")
+            continue
+        for group, aliases in (entry.get("groups") or {}).items():
+            names_map[group] = tuple(aliases)
+            if entry.get("const_set"):
+                const_set_groups.add(group)
+            nodes = _collect_assigns(index, fi, tuple(aliases),
+                                     nested=True)
+            prints[group] = _print_nodes(nodes)
+    return terms_reg, prints, names_map, const_set_groups, errors
 
 
 # ====================================================== native extract
@@ -354,8 +438,10 @@ def _c_term_print(stmts: List[Tuple[str, str, str]],
                      const_set=tuple(sorted(set(consts))))
 
 
-def native_fingerprint(path: str,
-                       terms: Sequence[str]) -> Dict[str, TermPrint]:
+def native_fingerprint(path: str, terms: Sequence[str],
+                       names: Optional[Dict[str, Tuple[str, ...]]]
+                       = None) -> Dict[str, TermPrint]:
+    names = names or TERM_NAMES
     with open(path, "r", encoding="utf-8") as f:
         src = f.read()
     # scope to the scoring region when the source carries the standard
@@ -368,7 +454,7 @@ def native_fingerprint(path: str,
     stmts = _c_statements(src)
     out: Dict[str, TermPrint] = {}
     for term in terms:
-        tp = _c_term_print(stmts, TERM_NAMES[term], term)
+        tp = _c_term_print(stmts, tuple(names[term]), term)
         if tp.consts or tp.ops:
             out[term] = tp
     return out
@@ -379,25 +465,221 @@ def run_score_pass(index: PackageIndex, cfg: AnalysisConfig,
                    package_dir: Optional[str] = None
                    ) -> List[Finding]:
     sites = getattr(cfg, "scorer_sites", None) or DEFAULT_SCORER_SITES
+    spec_sites = [s for s in sites if s.kind == "spec"]
+    findings: List[Finding] = []
+    site_fn_patterns: List[str] = []
+    for site in sites:
+        if site.kind == "spec":
+            site_fn_patterns.append(site.site + ":*")
+        elif site.kind in ("python", "driven"):
+            site_fn_patterns.append(site.site)
+
+    if spec_sites:
+        findings += _spec_conformance(index, sites, spec_sites[0],
+                                      package_dir)
+    else:
+        findings += _legacy_drift(index, sites, package_dir)
+
+    # ---- SCORE602: scoring-shaped arithmetic outside the registry
+    for fkey, fi in sorted(index.functions.items()):
+        base = fkey.split("#")[0]
+        if any(fnmatch.fnmatchcase(base, p) or
+               fnmatch.fnmatchcase(_parent_chain(index, fi), p)
+               for p in site_fn_patterns):
+            continue
+        if fi.module.startswith("nomad_tpu.analysis"):
+            continue
+        for node in index._own_nodes(fi):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            val = node.value
+            used: Set[str] = set()
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Name) \
+                        and sub.id in _COMPOSITE_NAMES:
+                    used.add(sub.id)
+                elif isinstance(sub, ast.Attribute) \
+                        and sub.attr in _COMPOSITE_NAMES:
+                    used.add(sub.attr)
+            if len(used) >= 2:
+                findings.append(Finding(
+                    "SCORE602", fi.module, fi.qual,
+                    "+".join(sorted(used)), fi.path, node.lineno,
+                    "scoring-shaped arithmetic (combines "
+                    f"{sorted(used)}) outside the registered scorer "
+                    "sites; a term added here exists in ONE backend "
+                    "only and the twins silently diverge",
+                    hint="move the logic into the scoring spec "
+                         "(solver/score_spec.py) or register the site "
+                         "in analysis/score_pass.py"))
+    return findings
+
+
+# ------------------------------------------------------ v3: spec path
+def _spec_conformance(index: PackageIndex, sites: Sequence[ScorerSite],
+                      spec_site: ScorerSite,
+                      package_dir: Optional[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    terms_reg, spec_prints, names_map, const_set_groups, errors = \
+        spec_reference(index, spec_site.site)
+    mi = index.modules.get(spec_site.site)
+    spec_path = mi.path if mi is not None else spec_site.site
+    for err in errors:
+        findings.append(Finding(
+            "SCORE603", "-", "-", spec_site.backend, spec_path, 0,
+            err + "; the spec-conformance check is blind",
+            hint="fix score_spec.TERMS (it must stay a pure literal "
+                 "naming existing term functions)"))
+    if not terms_reg:
+        return findings
+
+    known_backends = {s.backend for s in sites}
+    all_groups = tuple(names_map)
+    # group -> the term entry that owns it
+    group_term: Dict[str, dict] = {}
+    for entry in terms_reg:
+        for group in (entry.get("groups") or {}):
+            group_term[group] = entry
+        for b in entry.get("backends", ()):
+            if b not in known_backends:
+                findings.append(Finding(
+                    "SCORE604", "-", "spec", entry["name"], spec_path,
+                    0,
+                    f"spec term `{entry['name']}` names backend `{b}` "
+                    "which has no row in the scoring-site registry; "
+                    "its conformance is never checked",
+                    hint="add the ScorerSite row in "
+                         "analysis/score_pass.py or fix the term's "
+                         "backends tuple"))
+
+    for site in sites:
+        if site.kind == "spec":
+            continue
+        if site.kind in ("python", "driven"):
+            fkeys = index.match_funcs([site.site])
+            if not fkeys:
+                findings.append(_stale(site))
+                continue
+            fi = index.functions[fkeys[0]]
+            path, line = fi.path, fi.node.lineno
+            if site.kind == "driven":
+                findings += _check_driven(index, site, fi, all_groups,
+                                          names_map)
+                continue
+            fp = python_fingerprint(index, fi, all_groups, names_map)
+        else:
+            path = site.site if os.path.isabs(site.site) else \
+                os.path.join(package_dir or "", site.site)
+            if not os.path.exists(path):
+                findings.append(_stale(site, native=True))
+                continue
+            fp, line = native_fingerprint(path, all_groups,
+                                          names_map), 0
+        # ---- coverage (SCORE604) + drift (SCORE601) per group
+        for group in all_groups:
+            entry = group_term[group]
+            listed = site.backend in entry.get("backends", ())
+            tp = fp.get(group)
+            has = tp is not None and not tp.empty()
+            if listed and not has:
+                findings.append(Finding(
+                    "SCORE604", "-", site.backend, group, path, line,
+                    f"backend `{site.backend}` is registered for spec "
+                    f"term `{entry['name']}` but carries no `{group}` "
+                    "fingerprint (term missing in this backend)",
+                    hint="replicate the term float-order-exactly from "
+                         "score_spec, or drop the backend from the "
+                         "term's backends tuple"))
+                continue
+            if not listed:
+                if has:
+                    findings.append(Finding(
+                        "SCORE604", "-", site.backend, group, path,
+                        line,
+                        f"backend `{site.backend}` implements spec "
+                        f"term `{entry['name']}` (group `{group}`) "
+                        "but the term does not list it — coverage "
+                        "drift: the fingerprint is never verified",
+                        hint="add the backend to the term's backends "
+                             "tuple in score_spec.TERMS"))
+                continue
+            a = spec_prints.get(group)
+            if a is None:
+                continue
+            if group in const_set_groups:
+                if set(a.const_set) != set(tp.const_set):
+                    findings.append(_drift(site.backend, group, path,
+                                           line, a, tp, "spec",
+                                           consts_only=True))
+            elif (a.consts, a.ops) != (tp.consts, tp.ops):
+                findings.append(_drift(site.backend, group, path,
+                                       line, a, tp, "spec"))
+    return findings
+
+
+def _check_driven(index: PackageIndex, site: ScorerSite, fi: FuncInfo,
+                  all_groups: Tuple[str, ...],
+                  names_map: Dict[str, Tuple[str, ...]]
+                  ) -> List[Finding]:
+    """A driven backend must (a) call the spec term loop and (b) carry
+    ZERO scoring arithmetic of its own — any non-empty group
+    fingerprint here is drift-vs-spec by construction."""
+    findings: List[Finding] = []
+    calls_spec = any(
+        isinstance(n, ast.Call)
+        and (_dotted(n.func) or "").rsplit(".", 1)[-1] == DRIVEN_ENTRY
+        for n in ast.walk(fi.node))
+    if not calls_spec:
+        findings.append(Finding(
+            "SCORE604", "-", site.backend, DRIVEN_ENTRY, fi.path,
+            fi.node.lineno,
+            f"spec-driven backend `{site.backend}` no longer calls "
+            f"score_spec.{DRIVEN_ENTRY}; it is not evaluating the "
+            "spec's terms at all",
+            hint="drive the backend from score_spec.evaluate_wave "
+                 "(or re-register it as a hand backend and replicate "
+                 "every term)"))
+    fp = python_fingerprint(index, fi, all_groups, names_map)
+    for group, tp in sorted(fp.items()):
+        if tp.empty():
+            continue
+        findings.append(Finding(
+            "SCORE601", "-", site.backend, group, fi.path,
+            fi.node.lineno,
+            f"spec-driven backend `{site.backend}` carries hand "
+            f"scoring arithmetic for `{group}` ({tp.describe()}); "
+            "driven backends must defer every float op to score_spec "
+            "(hand edits here silently drift from the spec)",
+            hint="move the arithmetic into the term function in "
+                 "solver/score_spec.py (both driven backends pick it "
+                 "up) and delete it here"))
+    return findings
+
+
+def _stale(site: ScorerSite, native: bool = False) -> Finding:
+    what = ("registered native scorer source"
+            if native else "registered scorer site")
+    return Finding(
+        "SCORE603", "-", "-", site.backend, site.site, 0,
+        f"{what} `{site.site}` (backend {site.backend}) resolves to "
+        "nothing; the spec-conformance check is blind to this backend",
+        hint="update the registry entry in analysis/score_pass.py (or "
+             "AnalysisConfig.scorer_sites) after renaming the scorer; "
+             "baseline with a justification for intentional removals")
+
+
+# ------------------------------------------------- v2: legacy fallback
+def _legacy_drift(index: PackageIndex, sites: Sequence[ScorerSite],
+                  package_dir: Optional[str]) -> List[Finding]:
     findings: List[Finding] = []
     prints: List[Tuple[ScorerSite, str, Dict[str, TermPrint],
                        str, int]] = []
-    site_fn_patterns: List[str] = []
     for site in sites:
         terms = site.terms or DEFAULT_TERMS
         if site.kind == "python":
-            site_fn_patterns.append(site.site)
             fkeys = index.match_funcs([site.site])
             if not fkeys:
-                findings.append(Finding(
-                    "SCORE603", "-", "-", site.backend, site.site, 0,
-                    f"registered scorer site `{site.site}` "
-                    f"(backend {site.backend}) resolves to nothing; "
-                    "the cross-backend drift check is blind to this "
-                    "backend",
-                    hint="update the registry entry in "
-                         "analysis/score_pass.py (or AnalysisConfig."
-                         "scorer_sites) after renaming the scorer"))
+                findings.append(_stale(site))
                 continue
             fi = index.functions[fkeys[0]]
             fp = python_fingerprint(index, fi, terms)
@@ -407,12 +689,7 @@ def run_score_pass(index: PackageIndex, cfg: AnalysisConfig,
             path = site.site if os.path.isabs(site.site) else \
                 os.path.join(package_dir or "", site.site)
             if not os.path.exists(path):
-                findings.append(Finding(
-                    "SCORE603", "-", "-", site.backend, site.site, 0,
-                    f"registered native scorer source `{site.site}` "
-                    "not found; the drift check is blind to the "
-                    f"{site.backend} backend",
-                    hint="fix the path in the scoring-site registry"))
+                findings.append(_stale(site, native=True))
                 continue
             fp = native_fingerprint(path, terms)
             prints.append((site, site.backend, fp, site.site, 0))
@@ -445,40 +722,6 @@ def run_score_pass(index: PackageIndex, cfg: AnalysisConfig,
                 elif (a.consts, a.ops) != (b.consts, b.ops):
                     findings.append(_drift(backend, term, path, line,
                                            a, b, ref_name))
-
-    # ---- SCORE602: scoring-shaped arithmetic outside the registry
-    for fkey, fi in sorted(index.functions.items()):
-        base = fkey.split("#")[0]
-        if any(fnmatch.fnmatchcase(base, p) or
-               fnmatch.fnmatchcase(_parent_chain(index, fi), p)
-               for p in site_fn_patterns):
-            continue
-        if fi.module.startswith("nomad_tpu.analysis"):
-            continue
-        for node in index._own_nodes(fi):
-            if not isinstance(node, (ast.Assign, ast.AugAssign)):
-                continue
-            val = node.value
-            used: Set[str] = set()
-            for sub in ast.walk(val):
-                if isinstance(sub, ast.Name) \
-                        and sub.id in _COMPOSITE_NAMES:
-                    used.add(sub.id)
-                elif isinstance(sub, ast.Attribute) \
-                        and sub.attr in _COMPOSITE_NAMES:
-                    used.add(sub.attr)
-            if len(used) >= 2:
-                findings.append(Finding(
-                    "SCORE602", fi.module, fi.qual,
-                    "+".join(sorted(used)), fi.path, node.lineno,
-                    "scoring-shaped arithmetic (combines "
-                    f"{sorted(used)}) outside the registered scorer "
-                    "sites; a term added here exists in ONE backend "
-                    "only and the twins silently diverge",
-                    hint="move the logic into the registered scorer "
-                         "sites (all backends) and/or add the site to "
-                         "the scoring registry in "
-                         "analysis/score_pass.py"))
     return findings
 
 

@@ -27,11 +27,16 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import score_spec as _score_spec
 from .kernel import (EV_PRIORITY_DELTA, MAX_WAVES, MERGED_GP_MAX, NEG_INF,
                      TOP_K, WAVE_K, _APPROX_MIN_NP, _MERGED_W_CAP,
                      _SELECT_SUM_MAX_V, _WIDE_W_CAP, SolveResult)
 from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT,
                         OP_NE, OP_NOT_SET, R_CPU, R_MEM)
+
+#: spec-driver shim: every scoring float op this twin executes comes
+#: from solver/score_spec.py through these numpy ops
+_NP_OPS = _score_spec.NumpyOps()
 
 # dispatch gate defaults: the host path wins whenever the numpy wave
 # loop (microseconds per wave at these sizes) beats one transport
@@ -162,10 +167,17 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                       stack_commit=False,
                       static_cache=None, has_preempt=False,
                       ev_res=None, ev_prio=None,
-                      ask_prio=None) -> SolveResult:
+                      ask_prio=None, learned=None) -> SolveResult:
     """Numpy port of kernel.solve_kernel — see that docstring for the
     wave semantics.  Every formula, window size, and tie-break matches;
-    tests/test_host_solver.py asserts bitwise-equal placements."""
+    tests/test_host_solver.py asserts bitwise-equal placements.
+
+    Scoring is spec-DRIVEN: this twin assembles the plane context and
+    calls score_spec.evaluate_wave — the float ops live in ONE place
+    (solver/score_spec.py) shared with the jit kernel.  `learned` is
+    the optional precomputed [Gp, Np] learned-head plane (score_spec's
+    reserved slot); None leaves the scorer byte-identical to a
+    learned-free spec."""
     f32 = np.float32
     avail = np.asarray(avail, f32)
     reserved = np.asarray(reserved, f32)
@@ -196,8 +208,7 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         avail, valid, node_dc, attr_rank, dc_ok, host_ok,
         c_op, c_col, c_rank, a_op, a_col, a_rank, a_weight, a_host,
         sp_col, sp_desired, sp_implicit, has_spread, cache=static_cache)
-    pen_score = np.where(penalty, f32(-1.0), f32(0.0))
-    pen_counts = penalty
+    pen_score, pen_counts = _score_spec.static_terms(_NP_OPS, penalty)
 
     # tie-break jitter (kernel's uint32 hash, bit-exact)
     u32 = np.uint32
@@ -206,91 +217,25 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
              + (gs.astype(u32)[:, None] * u32(7919)
                 + u32(seed)) * u32(40503))
         h = (h ^ (h >> u32(16))) * u32(2246822519)
-    SCORE_BIN = 0.05
+    SCORE_BIN = _score_spec.SCORE_BIN
     jitter = (np.zeros((Gp, Np), f32) if seed == 0 else
               (h & u32(1023)).astype(f32) * f32(SCORE_BIN / 1023.0))
 
     def group_scores(used, dev_used, coll, sp_used, blocked):
-        after = used[None, :, :] + ask_res[:, None, :]
-        fit_dims = after <= avail[None, :, :]
-        fit = fit_dims.all(axis=-1)
-        dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
-                   <= dev_cap[None, :, :]).all(axis=-1)
-        feas_b = feas & ~blocked
-        placeable = feas_b & fit & dev_fit
-
-        denom_cpu = avail[None, :, R_CPU]
-        denom_mem = avail[None, :, R_MEM]
-        util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
-        util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
-        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
-        free_cpu = f32(1.0) - util_cpu / np.maximum(denom_cpu, f32(1.0))
-        free_mem = f32(1.0) - util_mem / np.maximum(denom_mem, f32(1.0))
-        raw = f32(20.0) - (f32(10.0) ** free_cpu + f32(10.0) ** free_mem)
-        binpack = np.where(ok_denoms,
-                           np.clip(raw, f32(0.0), f32(18.0)) / f32(18.0),
-                           f32(0.0))
-
-        anti = np.where(coll > 0,
-                        -(coll + f32(1.0)) / ask_desired[:, None],
-                        f32(0.0))
-        anti_counts = coll > 0
-
-        if has_spread:
-            spread_total = np.zeros((Gp, Np), f32)
-            for s in range(S):
-                col = sp_col[:, s]
-                has = col >= 0
-                v = sp_vnode[s]
-                has_v = v >= 0
-                used_vec = sp_used[:, s]
-                cur = np.where(v >= 0, np.take_along_axis(
-                    used_vec, np.clip(v, 0, V - 1), axis=1), f32(0.0))
-                desired = sp_des[s]
-                boost = ((desired - (cur + f32(1.0)))
-                         / np.maximum(desired, f32(1e-9))
-                         ) * np.asarray(sp_weight[:, s], f32)[:, None]
-                targeted = np.where(~has_v, f32(-1.0),
-                                    np.where(desired <= 0, f32(-1.0),
-                                             boost))
-                present = used_vec > 0
-                any_present = present.any(axis=1)[:, None]
-                minc = np.min(np.where(present, used_vec, np.inf),
-                              axis=1)[:, None].astype(f32)
-                maxc = np.max(np.where(present, used_vec, -np.inf),
-                              axis=1)[:, None].astype(f32)
-                # rows with NO present value carry minc=inf/maxc=-inf;
-                # their `even` term is masked to 0 by any_present below,
-                # but inf/inf through the divides raises RuntimeWarnings
-                # across the whole suite — pin the masked rows to finite
-                # values first (identical results, clean exact twin)
-                minc = np.where(any_present, minc, f32(0.0))
-                maxc = np.where(any_present, maxc, f32(0.0))
-                delta_boost = (minc - cur) / np.maximum(minc, f32(1e-9))
-                even = np.where(cur != minc, delta_boost,
-                                np.where(minc == maxc, f32(-1.0),
-                                         (maxc - minc)
-                                         / np.maximum(minc, f32(1e-9))))
-                even = np.where(~has_v, f32(-1.0), even)
-                even = np.where(any_present, even, f32(0.0))
-                contrib = np.where(sp_targeted[:, s][:, None], targeted,
-                                   even)
-                spread_total += np.where(has[:, None], contrib, f32(0.0))
-            spread_counts = spread_total != 0.0
-        else:
-            spread_total = f32(0.0)
-            spread_counts = False
-
-        aff_counts = aff_score != 0.0
-        n_scorers = (f32(1.0) + anti_counts + pen_counts + aff_counts
-                     + spread_counts).astype(f32)
-        total = (binpack + anti + pen_score + aff_score
-                 + spread_total) / n_scorers
-        if seed != 0:
-            total = np.floor(total / f32(SCORE_BIN)) * f32(SCORE_BIN)
-        total = total + jitter
-        score = np.where(placeable, total, f32(NEG_INF))
-        return score, placeable, feas_b, fit, fit_dims, dev_fit
+        """Spec-driven scoring: assembles the plane context and defers
+        every float op to score_spec.evaluate_wave (nomadlint SCORE6xx
+        flags scoring arithmetic hand-added back here)."""
+        ctx = dict(
+            used=used, dev_used=dev_used, coll=coll, sp_used=sp_used,
+            blocked=blocked, avail=avail, reserved=reserved,
+            ask_res=ask_res, ask_desired=ask_desired, dev_cap=dev_cap,
+            dev_ask=dev_ask, feas=feas, pen_score=pen_score,
+            pen_counts=pen_counts, aff_score=aff_score,
+            has_devices=True, has_spread=has_spread, sp_col=sp_col,
+            sp_weight=sp_weight, sp_targeted=sp_targeted,
+            vnode=sp_vnode, des=sp_des, S=S, V=V, shape=(Gp, Np),
+            seed=seed, jitter=jitter, learned=learned)
+        return _score_spec.evaluate_wave(_NP_OPS, ctx)
 
     # ---------- in-kernel preemption planes (kernel.py twin) ----------
     if has_preempt:
@@ -450,17 +395,22 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 des_s < 0, np.asarray(sp_implicit[:, s], f32)[:, None],
                 des_s)
             present = use_s > 0
-            maxc = np.max(np.where(present, use_s, f32(0.0)),
-                          axis=1)[:, None]
-            minc = np.min(np.where(present, use_s,
-                                   np.where(present.any(axis=1)[:, None],
-                                            np.inf, 0.0)),
-                          axis=1)[:, None]
-            minc = np.where(np.isfinite(minc), minc, 0.0).astype(f32)
+            # hi_cnt/lo_cnt: the occupancy band the quota levels
+            # against (NOT the spread scorer's minc/maxc — those live
+            # in score_spec.term_spread; alias-distinct names keep the
+            # driven-backend fingerprint empty)
+            hi_cnt = np.max(np.where(present, use_s, f32(0.0)),
+                            axis=1)[:, None]
+            lo_cnt = np.min(np.where(present, use_s,
+                                     np.where(present.any(axis=1)[:, None],
+                                              np.inf, 0.0)),
+                            axis=1)[:, None]
+            lo_cnt = np.where(np.isfinite(lo_cnt), lo_cnt,
+                              0.0).astype(f32)
             # even-spread quota for the first half of the wave budget
             # only (kernel.py quota block note)
             share = np.ceil(act_g.astype(f32) / V)[:, None]
-            level = np.maximum(maxc, minc + share)
+            level = np.maximum(hi_cnt, lo_cnt + share)
             even_q = (np.maximum(f32(1.0), level - use_s)
                       if wave < max(max_waves // 2, 1)
                       else np.full_like(use_s, np.inf))
@@ -553,20 +503,8 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                            & dev_fit_ev & want_g[:, None])
                 after = (used[None, :, :] + ask_res[:, None, :]
                          - freed)
-                denom_cpu = avail[None, :, R_CPU]
-                denom_mem = avail[None, :, R_MEM]
-                util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
-                util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
-                ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
-                free_cpu = f32(1.0) - util_cpu / np.maximum(denom_cpu,
-                                                            f32(1.0))
-                free_mem = f32(1.0) - util_mem / np.maximum(denom_mem,
-                                                            f32(1.0))
-                raw = f32(20.0) - (f32(10.0) ** free_cpu
-                                   + f32(10.0) ** free_mem)
-                binpack = np.where(ok_denoms,
-                                   np.clip(raw, f32(0.0), f32(18.0))
-                                   / f32(18.0), f32(0.0))
+                binpack = _score_spec.rescore_binpack(
+                    _NP_OPS, after, avail, reserved)
                 ev_score = np.where(ok_node, binpack, f32(NEG_INF))
                 wv_s, wv_i = _top_k(ev_score, 1)
                 win_s, win_i = wv_s[:, 0], wv_i[:, 0].astype(np.int32)

@@ -46,13 +46,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import score_spec as _score_spec
 from .tensorize import (OP_EQ, OP_GE, OP_GT, OP_IS_SET, OP_LE, OP_LT, OP_NE,
                         OP_NONE, OP_NOT_SET, R_CPU, R_MEM)
 
 TOP_K = 4
 WAVE_K = 32       # min per-group wave width; scales up with batch size
 MAX_WAVES = 12    # static wave budget per solve (see scan note below)
-NEG_INF = -1e30
+NEG_INF = _score_spec.NEG_INF
 # victim eligibility gate: ask priority must exceed the victim's by at
 # least this (scheduler/preemption.PRIORITY_DELTA — duplicated here so
 # the device module stays import-light; pinned equal by a test)
@@ -65,6 +66,10 @@ _APPROX_MIN_NP = 4096
 # value-vocabulary size up to which spread lookups unroll as select-sums
 # (gather-free); larger vocabularies fall back to take_along_axis
 _SELECT_SUM_MAX_V = 16
+# backend shim handing the spec-driven wave scorer its jnp ops (see
+# score_spec: this kernel is a DRIVEN backend — no scoring arithmetic
+# of its own)
+_JAX_OPS = _score_spec.JaxOps(select_sum_max_v=_SELECT_SUM_MAX_V)
 # group-count at or below which a batch is treated as "merged few-group"
 # (throughput-mode ask dedup): the wave-width cap widens since top-k
 # over so few rows is cheap. Shared by resident._group_count_hint and
@@ -274,8 +279,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  mesh_axis=None, mesh_shards=0,
                  has_preempt=False, ev_res=None, ev_prio=None,
                  ask_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
-                 node_gid=None, owner_map=None, slot_map=None
-                 ) -> SolveResult:
+                 node_gid=None, owner_map=None, slot_map=None,
+                 learned=None) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -511,7 +516,12 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # In mesh mode the shortlist is SHARD-LOCAL (resolved against the
     # local plane): triggers prove each shard's window contribution
     # exact, and escapes rescore only that shard's plane.
-    C = 0 if has_distinct else resolve_shortlist_c(Np, TKl, shortlist_c)
+    # the learned-head term flows through the spec-DRIVEN scorers only
+    # (host twin + this wave path); the hand-written shortlist twin and
+    # pallas tiles don't implement it, so both stay disabled while a
+    # learned plane is active (see score_spec.TERMS backends tuple)
+    C = (0 if (has_distinct or learned is not None)
+         else resolve_shortlist_c(Np, TKl, shortlist_c))
     use_sl = C > 0
     NE = C if use_sl else TKl       # full-wave extraction width
     ks = jnp.arange(K)
@@ -574,8 +584,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
         return (match * a_weight[g][None, :]).sum(axis=1)  # [Np]
 
     aff_score = jax.vmap(per_ask_aff)(gs) + a_host
-    pen_score = jnp.where(penalty, -1.0, 0.0)              # rank.go:532
-    pen_counts = penalty
+    pen_score, pen_counts = _score_spec.static_terms(_JAX_OPS, penalty)
 
     # ---------- hoisted spread lookups (wave-invariant) ----------
     # The per-(group, node) spread value and desired-count are functions
@@ -645,7 +654,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # max(2, log2 N) node sample (scheduler/stack.go:80-87) — selection
     # within a near-tied band is no further from its semantics than
     # exact argmax, and converges an order of magnitude faster.
-    SCORE_BIN = 0.05
+    SCORE_BIN = _score_spec.SCORE_BIN
     jitter = jnp.where(jnp.int32(seed) == 0, 0.0,
                        (h & jnp.uint32(1023)).astype(jnp.float32)
                        * (SCORE_BIN / 1023.0))             # [Gp, Np]
@@ -656,6 +665,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # HBM), "score" fuses the scoring chain into one pass and leaves
     # wide-window extraction to approx_max_k/top_k, "off" keeps the
     # unfused jnp path (the host twin's reference shape).
+    if learned is not None:
+        pallas_mode = "off"
     if pallas_mode == "auto":
         from . import pallas_kernel as _pk
         pallas_mode = _pk.resolve_mode(Np, Gp, TK, V, has_spread)
@@ -696,95 +707,21 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
 
     def group_scores(used, dev_used, coll, sp_used, blocked):
         """Batched scoring of every (group, node) pair against current
-        usage — one instance of the reference's rank pipeline, [Gp, Np]."""
-        after = used[None, :, :] + ask_res[:, None, :]     # [Gp, Np, R]
-        fit_dims = after <= avail[None, :, :]
-        fit = fit_dims.all(axis=-1)
-        if has_devices:
-            dev_fit = (dev_used[None, :, :] + dev_ask[:, None, :]
-                       <= dev_cap[None, :, :]).all(axis=-1)
-        else:
-            dev_fit = jnp.ones((Gp, Np), bool)
-        feas_b = feas & ~blocked
-        placeable = feas_b & fit & dev_fit
-
-        # -- binpack (funcs.go:155 ScoreFit, normalized rank.go:441) --
-        denom_cpu = avail[None, :, R_CPU]
-        denom_mem = avail[None, :, R_MEM]
-        util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
-        util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
-        ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
-        free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
-        free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
-        raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
-        binpack = jnp.where(ok_denoms,
-                            jnp.clip(raw, 0.0, 18.0) / 18.0, 0.0)
-
-        # -- job anti-affinity (rank.go:462) --
-        anti = jnp.where(coll > 0,
-                         -(coll + 1.0) / ask_desired[:, None], 0.0)
-        anti_counts = coll > 0
-
-        # -- spread (spread.go; append-if-nonzero) --
-        # gather-free in-wave path: the only per-wave dependence is
-        # sp_used; `cur` comes from a select-sum over the (small) value
-        # vocabulary against the hoisted sp_vnode
-        def one_spread(s):
-            col = sp_col[:, s]                             # [Gp]
-            has = col >= 0
-            v = sp_vnode[s]                                # [Gp, Np]
-            has_v = v >= 0
-            used_vec = sp_used[:, s]                       # [Gp, V]
-            if V <= _SELECT_SUM_MAX_V:
-                cur = jnp.zeros_like(v, jnp.float32)
-                for val in range(V):
-                    cur = cur + jnp.where(v == val,
-                                          used_vec[:, val][:, None], 0.0)
-            else:
-                cur = jnp.where(v >= 0, jnp.take_along_axis(
-                    used_vec, jnp.maximum(v, 0), axis=1), 0.0)
-            # targeted scoring (desired counts, +1 for this placement)
-            desired = sp_des[s]                            # [Gp, Np]
-            boost = ((desired - (cur + 1.0)) / jnp.maximum(desired, 1e-9)
-                     ) * sp_weight[:, s][:, None]
-            targeted = jnp.where(~has_v, -1.0,
-                                 jnp.where(desired <= 0, -1.0, boost))
-            # even-spread scoring (spread.go evenSpreadScoreBoost)
-            present = used_vec > 0                         # [Gp, V]
-            any_present = present.any(axis=1)[:, None]
-            minc = jnp.min(jnp.where(present, used_vec, jnp.inf),
-                           axis=1)[:, None]
-            maxc = jnp.max(jnp.where(present, used_vec, -jnp.inf),
-                           axis=1)[:, None]
-            delta_boost = (minc - cur) / jnp.maximum(minc, 1e-9)
-            even = jnp.where(cur != minc, delta_boost,
-                             jnp.where(minc == maxc, -1.0,
-                                       (maxc - minc) / jnp.maximum(minc,
-                                                                   1e-9)))
-            even = jnp.where(~has_v, -1.0, even)
-            even = jnp.where(any_present, even, 0.0)
-            contrib = jnp.where(sp_targeted[:, s][:, None], targeted, even)
-            return jnp.where(has[:, None], contrib, 0.0)
-
-        if has_spread:
-            sp_scores = jax.vmap(one_spread)(jnp.arange(S))  # [S, Gp, Np]
-            spread_total = sp_scores.sum(axis=0)
-            spread_counts = spread_total != 0.0
-        else:
-            spread_total = 0.0
-            spread_counts = False
-
-        aff_counts = aff_score != 0.0
-        # -- normalization: mean over appended scorers (rank.go:667) --
-        n_scorers = (1.0 + anti_counts + pen_counts + aff_counts
-                     + spread_counts)
-        total = (binpack + anti + pen_score + aff_score
-                 + spread_total) / n_scorers
-        total = jnp.where(jnp.int32(seed) == 0, total,
-                          jnp.floor(total / SCORE_BIN) * SCORE_BIN)
-        total = total + jitter
-        score = jnp.where(placeable, total, NEG_INF)
-        return score, placeable, feas_b, fit, fit_dims, dev_fit
+        usage — one instance of the reference's rank pipeline, [Gp, Np].
+        Spec-driven: assembles the plane context and defers every float
+        op to score_spec.evaluate_wave (nomadlint SCORE6xx flags
+        scoring arithmetic hand-added back here)."""
+        ctx = dict(
+            used=used, dev_used=dev_used, coll=coll, sp_used=sp_used,
+            blocked=blocked, avail=avail, reserved=reserved,
+            ask_res=ask_res, ask_desired=ask_desired, dev_cap=dev_cap,
+            dev_ask=dev_ask, feas=feas, pen_score=pen_score,
+            pen_counts=pen_counts, aff_score=aff_score,
+            has_devices=has_devices, has_spread=has_spread,
+            sp_col=sp_col, sp_weight=sp_weight, sp_targeted=sp_targeted,
+            vnode=sp_vnode, des=sp_des, S=S, V=V, shape=(Gp, Np),
+            seed=seed, jitter=jitter, learned=learned)
+        return _score_spec.evaluate_wave(_JAX_OPS, ctx)
 
     # ---------- shortlist scoring twin ----------
     def _lex_topk(score, idx, k):
@@ -1540,17 +1477,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                            & dev_fit_ev & want_g[:, None])
                 after = (used_x[None, :, :] + ask_res[:, None, :]
                          - freed)
-                denom_cpu = avail[None, :, R_CPU]
-                denom_mem = avail[None, :, R_MEM]
-                util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
-                util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
-                ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
-                free_cpu = 1.0 - util_cpu / jnp.maximum(denom_cpu, 1.0)
-                free_mem = 1.0 - util_mem / jnp.maximum(denom_mem, 1.0)
-                raw = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
-                binpack = jnp.where(ok_denoms,
-                                    jnp.clip(raw, 0.0, 18.0) / 18.0,
-                                    0.0)
+                binpack = _score_spec.rescore_binpack(
+                    _JAX_OPS, after, avail, reserved)
                 ev_score = jnp.where(ok_node, binpack, f32(NEG_INF))
                 ids = (g_of_local if in_mesh
                        else jnp.arange(Np, dtype=jnp.int32))

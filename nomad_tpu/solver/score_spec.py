@@ -1,0 +1,479 @@
+"""ONE scoring spec, N verified backends (ROADMAP item 5).
+
+The exact scorer used to live in five hand-replicated float-order-exact
+copies (host numpy twin, jit wave kernel, shortlist `_sl_eval`, pallas
+tile kernel, native C++), held identical only by nomadlint's
+backend-vs-backend drift fingerprints.  This module flips the
+relationship: it is the single declarative source of truth for every
+scoring term — its exact float-op sequence, constants, dtype/cast
+contract, and combine order — and the backends split into two classes:
+
+  * DRIVEN — the host twin (`host.host_solve_kernel.group_scores`) and
+    the jit wave scorer (`kernel.solve_kernel.group_scores`) call
+    `evaluate_wave` below; they contain NO scoring arithmetic of their
+    own.  Backend-specific structure (numpy vs traced jnp, spread
+    gather shape, seed-bin control flow) lives in the `NumpyOps` /
+    `JaxOps` shims; every float op and constant lives in ONE term
+    function here.  Driving both from the same term functions is what
+    makes them bit-identical by construction.
+  * HAND, SPEC-VERIFIED — the shortlist VMEM twin, the pallas fused
+    tile kernel, and the native C++ engine stay hand-written for
+    performance; nomadlint SCORE6xx v3 compiles this spec into
+    per-term reference fingerprints and statically proves each of them
+    implements the spec (SCORE601 = drift vs SPEC, SCORE604 = term
+    coverage).
+
+Adding a scoring term = adding ONE term function + ONE `TERMS` entry
+here (plus tests).  The driven backends pick it up via the term loop;
+SCORE604 then fails until every hand backend named in the entry's
+`backends` tuple carries a matching fingerprint.  The reserved
+`learned` slot (GDP-style placer head, PAPERS.md) is wired this way:
+a precomputed [Gp, Np] plane appended as one more scorer, flowing to
+the driven backends only.
+
+FINGERPRINT CONTRACT: the assignment-target names inside the term
+functions (`free_cpu`, `raw`, `binpack`, `anti`, ...) are the
+canonical names nomadlint groups float ops under — they must match the
+`groups` tuples declared in `TERMS`, and the bodies must keep the op
+structure the hand backends replicate.  `TERMS` itself is a pure
+literal: nomadlint parses it with `ast.literal_eval` and never imports
+this module.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensorize import R_CPU, R_MEM
+
+#: bump on ANY term/combine change; recorded in BENCH_DETAIL by
+#: bench.lint_summary and snapshotted by the golden fingerprint test
+SPEC_VERSION = "3.0"
+
+#: masked / sentinel score (shared by every backend; the kernel
+#: re-exports it)
+NEG_INF = -1e30
+
+#: seeded-mode score quantum: seed != 0 bins scores into SCORE_BIN
+#: steps and jitters within the bin (see kernel.solve_kernel for why)
+SCORE_BIN = 0.05
+
+
+# ============================================================ ops shims
+class NumpyOps:
+    """Backend shim for the numpy host twin.  Reproduces host.py's
+    pre-refactor structure exactly: constants wrapped `np.float32`,
+    gather-based spread `cur`, masked min/max pinned finite (identical
+    results to the unpinned kernel form, but RuntimeWarning-clean), and
+    python-level seed branching."""
+
+    f32 = np.float32
+
+    @staticmethod
+    def asf32(x):
+        return np.asarray(x, np.float32)
+
+    where = staticmethod(np.where)
+    maximum = staticmethod(np.maximum)
+    clip = staticmethod(np.clip)
+    floor = staticmethod(np.floor)
+
+    @staticmethod
+    def ones_bool(shape):
+        return np.ones(shape, bool)
+
+    @staticmethod
+    def counts_cast(x):
+        # host pins the scorer count to f32 explicitly
+        return x.astype(np.float32)
+
+    @staticmethod
+    def seed_select(seed, exact, binned):
+        # host branches at python level; seed is a host int here
+        return binned if seed != 0 else exact
+
+    @staticmethod
+    def spread_cur(used_vec, v, V):
+        f32 = np.float32
+        return np.where(v >= 0, np.take_along_axis(
+            used_vec, np.clip(v, 0, V - 1), axis=1), f32(0.0))
+
+    @staticmethod
+    def present_minmax(present, used_vec):
+        f32 = np.float32
+        any_present = present.any(axis=1)[:, None]
+        minc = np.min(np.where(present, used_vec, np.inf),
+                      axis=1)[:, None].astype(f32)
+        maxc = np.max(np.where(present, used_vec, -np.inf),
+                      axis=1)[:, None].astype(f32)
+        # rows with NO present value carry minc=inf/maxc=-inf; their
+        # `even` term is masked to 0 by any_present downstream, but
+        # inf/inf through the divides raises RuntimeWarnings across the
+        # whole suite — pin the masked rows to finite values first
+        # (identical results, clean exact twin)
+        minc = np.where(any_present, minc, f32(0.0))
+        maxc = np.where(any_present, maxc, f32(0.0))
+        return any_present, minc, maxc
+
+    @staticmethod
+    def spread_sum(S, fn, shape):
+        # sequential accumulation — bitwise equal to the kernel's
+        # vmap+sum (tests/test_shortlist.py pinned this equivalence for
+        # the shortlist twin long before the spec existed)
+        acc = np.zeros(shape, np.float32)
+        for s in range(S):
+            acc = acc + fn(s)
+        return acc
+
+
+class JaxOps:
+    """Backend shim for the jit wave scorer.  Reproduces kernel.py's
+    pre-refactor trace exactly: bare (weakly-typed) python float
+    constants, select-sum spread `cur` for small vocabularies,
+    unpinned masked min/max, vmap'd spread reduction, and traced
+    `jnp.where` seed branching."""
+
+    def __init__(self, select_sum_max_v: int = 16):
+        import jax
+        import jax.numpy as jnp
+        self._jax = jax
+        self._jnp = jnp
+        self.select_sum_max_v = select_sum_max_v
+
+    @staticmethod
+    def f32(c):
+        # jnp ops promote python floats weakly — bare constants keep
+        # the pre-refactor trace byte-identical
+        return c
+
+    @staticmethod
+    def asf32(x):
+        return x
+
+    def where(self, c, a, b):
+        return self._jnp.where(c, a, b)
+
+    def maximum(self, a, b):
+        return self._jnp.maximum(a, b)
+
+    def clip(self, x, lo, hi):
+        return self._jnp.clip(x, lo, hi)
+
+    def floor(self, x):
+        return self._jnp.floor(x)
+
+    def ones_bool(self, shape):
+        return self._jnp.ones(shape, bool)
+
+    @staticmethod
+    def counts_cast(x):
+        return x
+
+    def seed_select(self, seed, exact, binned):
+        jnp = self._jnp
+        return jnp.where(jnp.int32(seed) == 0, exact, binned)
+
+    def spread_cur(self, used_vec, v, V):
+        jnp = self._jnp
+        if V <= self.select_sum_max_v:
+            # gather-free select-sum over the (small) value vocabulary:
+            # a per-element gather of [Gp, Np] lowers to a near-scalar
+            # loop on TPU
+            cur = jnp.zeros_like(v, jnp.float32)
+            for val in range(V):
+                cur = cur + jnp.where(v == val,
+                                      used_vec[:, val][:, None], 0.0)
+            return cur
+        return jnp.where(v >= 0, jnp.take_along_axis(
+            used_vec, jnp.maximum(v, 0), axis=1), 0.0)
+
+    def present_minmax(self, present, used_vec):
+        jnp = self._jnp
+        any_present = present.any(axis=1)[:, None]
+        minc = jnp.min(jnp.where(present, used_vec, jnp.inf),
+                       axis=1)[:, None]
+        maxc = jnp.max(jnp.where(present, used_vec, -jnp.inf),
+                       axis=1)[:, None]
+        return any_present, minc, maxc
+
+    def spread_sum(self, S, fn, shape):
+        jnp = self._jnp
+        return self._jax.vmap(fn)(jnp.arange(S)).sum(axis=0)
+
+
+# ======================================================= term functions
+# Every float op and constant of the exact scorer lives in the bodies
+# below; nomadlint fingerprints them per assignment-target group and
+# verifies the hand backends against them.  Keep target names in sync
+# with the `groups` tuples in TERMS.
+
+def term_feasibility(ops, ctx):
+    """Hard placement masks (funcs.go checkers): resource fit per
+    dimension, device fit, static feasibility minus per-wave blocking.
+    Masks only — no float scoring ops, so this term carries no
+    fingerprint groups."""
+    after = ctx["used"][None, :, :] + ctx["ask_res"][:, None, :]
+    fit_dims = after <= ctx["avail"][None, :, :]
+    fit = fit_dims.all(axis=-1)
+    if ctx["has_devices"]:
+        dev_fit = (ctx["dev_used"][None, :, :] + ctx["dev_ask"][:, None, :]
+                   <= ctx["dev_cap"][None, :, :]).all(axis=-1)
+    else:
+        dev_fit = ops.ones_bool(ctx["shape"])
+    feas_b = ctx["feas"] & ~ctx["blocked"]
+    placeable = feas_b & fit & dev_fit
+    return after, fit_dims, fit, dev_fit, feas_b, placeable
+
+
+def term_binpack(ops, ctx):
+    """Bin-pack (funcs.go:155 ScoreFit, normalized rank.go:441): the
+    10**free exponential pressure on cpu+mem, clipped to [0, 18] and
+    normalized; 0 where either denominator is empty."""
+    f32 = ops.f32
+    free_cpu = f32(1.0) - ctx["util_cpu"] / ops.maximum(ctx["denom_cpu"],
+                                                        f32(1.0))
+    free_mem = f32(1.0) - ctx["util_mem"] / ops.maximum(ctx["denom_mem"],
+                                                        f32(1.0))
+    raw = f32(20.0) - (f32(10.0) ** free_cpu + f32(10.0) ** free_mem)
+    binpack = ops.where(ctx["ok_denoms"],
+                        ops.clip(raw, f32(0.0), f32(18.0)) / f32(18.0),
+                        f32(0.0))
+    return binpack
+
+
+def term_anti(ops, ctx):
+    """Job anti-affinity (rank.go:462): -(collisions+1)/desired on
+    nodes already carrying a sibling, appended only when colliding."""
+    f32 = ops.f32
+    coll = ctx["coll"]
+    anti = ops.where(coll > 0,
+                     -(coll + f32(1.0)) / ctx["ask_desired"][:, None],
+                     f32(0.0))
+    anti_counts = coll > 0
+    return anti, anti_counts
+
+
+def term_penalty(ops, ctx):
+    """Node penalty (rank.go:532): a flat -1 scorer on penalized
+    nodes.  Wave-invariant — evaluated once per solve via
+    `static_terms`, not per wave."""
+    f32 = ops.f32
+    pen_score = ops.where(ctx["penalty"], f32(-1.0), f32(0.0))
+    return pen_score
+
+
+def term_spread(ops, ctx, s):
+    """Spread scorer for ONE spread constraint `s` (spread.go):
+    targeted boost toward declared desired counts, or the even-spread
+    boost against the min/max occupancy band.  The per-backend gather
+    shape (take_along_axis vs select-sum) and min/max pinning live in
+    the ops shim; every float op is here."""
+    f32 = ops.f32
+    col = ctx["sp_col"][:, s]
+    has = col >= 0
+    v = ctx["vnode"][s]
+    has_v = v >= 0
+    used_vec = ctx["sp_used"][:, s]
+    cur = ops.spread_cur(used_vec, v, ctx["V"])
+    # targeted scoring (desired counts, +1 for this placement)
+    desired = ctx["des"][s]
+    boost = ((desired - (cur + f32(1.0)))
+             / ops.maximum(desired, f32(1e-9))
+             ) * ops.asf32(ctx["sp_weight"][:, s])[:, None]
+    targeted = ops.where(~has_v, f32(-1.0),
+                         ops.where(desired <= 0, f32(-1.0), boost))
+    # even-spread scoring (spread.go evenSpreadScoreBoost)
+    present = used_vec > 0
+    any_present, minc, maxc = ops.present_minmax(present, used_vec)
+    delta_boost = (minc - cur) / ops.maximum(minc, f32(1e-9))
+    even = ops.where(cur != minc, delta_boost,
+                     ops.where(minc == maxc, f32(-1.0),
+                               (maxc - minc) / ops.maximum(minc,
+                                                           f32(1e-9))))
+    even = ops.where(~has_v, f32(-1.0), even)
+    even = ops.where(any_present, even, f32(0.0))
+    contrib = ops.where(ctx["sp_targeted"][:, s][:, None], targeted,
+                        even)
+    return ops.where(has[:, None], contrib, f32(0.0))
+
+
+def term_learned(ops, ctx):
+    """Reserved learned-head slot (GDP-style placer, PAPERS.md): the
+    [Gp, Np] score plane arrives PRECOMPUTED in ctx["learned"] (model
+    inference happens outside the solve); the spec appends it as one
+    more scorer via `combine_learned`.  When no plane is supplied the
+    term is statically absent — the combine path and therefore the
+    traced program are byte-identical to a spec without it."""
+    learned = ctx["learned"]
+    return learned
+
+
+def combine(ops, ctx, parts):
+    """Append-then-average normalization (rank.go:667): the mean over
+    the appended scorers, seed-binned (kernel.solve_kernel documents
+    why) and tie-break-jittered.  This body is the canonical `total` /
+    `n_scorers` fingerprint every backend must match."""
+    f32 = ops.f32
+    n_scorers = ops.counts_cast(f32(1.0) + parts["anti_counts"]
+                                + parts["pen_counts"]
+                                + parts["aff_counts"]
+                                + parts["spread_counts"])
+    total = (parts["binpack"] + parts["anti"] + parts["pen_score"]
+             + parts["aff_score"] + parts["spread_total"]) / n_scorers
+    total = ops.seed_select(ctx["seed"], total,
+                            ops.floor(total / f32(SCORE_BIN))
+                            * f32(SCORE_BIN))
+    total = total + ctx["jitter"]
+    return total
+
+
+def combine_learned(ops, ctx, parts):
+    """`combine` with the learned plane appended as one more scorer
+    (same append semantics as anti/pen/aff/spread: counted when
+    nonzero).  A SEPARATE function so the canonical `total` fingerprint
+    in `combine` stays exactly what the learned-free hand backends
+    implement; nomadlint groups this body under the `learned` term."""
+    f32 = ops.f32
+    learned = parts["learned"]
+    n_scorers = ops.counts_cast(f32(1.0) + parts["anti_counts"]
+                                + parts["pen_counts"]
+                                + parts["aff_counts"]
+                                + parts["spread_counts"]
+                                + (learned != 0.0))
+    total = (parts["binpack"] + parts["anti"] + parts["pen_score"]
+             + parts["aff_score"] + parts["spread_total"]
+             + learned) / n_scorers
+    total = ops.seed_select(ctx["seed"], total,
+                            ops.floor(total / f32(SCORE_BIN))
+                            * f32(SCORE_BIN))
+    total = total + ctx["jitter"]
+    return total
+
+
+# ====================================================== term registry
+#: The declarative spec registry — ONE entry per scoring term.  Pure
+#: literal by contract: nomadlint reads it with `ast.literal_eval`
+#: (never importing this module) to learn each term's fingerprint
+#: groups (group name -> the assignment-target aliases backends may
+#: use), which function carries the reference float ops, which
+#: backends must implement it, and whether its groups compare as a
+#: constant SET only (loop structure genuinely differs per backend).
+#:
+#: Adding a term: write its term function above, list it here, run the
+#: suite — SCORE604 names every hand backend that still misses it, and
+#: the golden-fingerprint test surfaces the new reference prints as a
+#: reviewed diff.  Backends: "host" and "kernel" are spec-DRIVEN (the
+#: term loop picks the entry up automatically); "shortlist", "pallas"
+#: and "native" are hand-written and spec-verified.
+TERMS = (
+    {"name": "feasibility", "fn": "term_feasibility",
+     "groups": {}, "const_set": False,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "hard placement masks (no float ops; not fingerprinted)"},
+    {"name": "binpack", "fn": "term_binpack",
+     "groups": {"free": ("free_cpu", "free_mem"),
+                "binpack": ("raw", "binpack")},
+     "const_set": False,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "exponential cpu+mem bin-packing pressure"},
+    {"name": "anti", "fn": "term_anti",
+     "groups": {"anti": ("anti",)}, "const_set": False,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "job anti-affinity collision penalty"},
+    {"name": "pen", "fn": "term_penalty",
+     "groups": {"pen": ("pen", "pen_score", "pen_sc")},
+     "const_set": False,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "flat node penalty scorer"},
+    {"name": "spread", "fn": "term_spread",
+     "groups": {"spread": ("cur", "boost", "targeted", "delta_boost",
+                           "even", "contrib", "spread_total",
+                           "sp_total", "minc", "maxc", "desired")},
+     "const_set": True,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "targeted + even spread boosts (const-set compare)"},
+    {"name": "learned", "fn": "term_learned",
+     "groups": {"learned": ("learned",)}, "const_set": False,
+     "backends": ("host", "kernel"),
+     "doc": "reserved learned-head plane (driven backends only)"},
+    {"name": "combine", "fn": "combine",
+     "groups": {"n_scorers": ("n_scorers",), "total": ("total",)},
+     "const_set": False,
+     "backends": ("host", "kernel", "shortlist", "pallas", "native"),
+     "doc": "append-then-average normalization + binning + jitter"},
+)
+
+
+def term_names():
+    """Ordered term names (bench/BENCH_DETAIL provenance)."""
+    return tuple(t["name"] for t in TERMS)
+
+
+# ============================================================= drivers
+def static_terms(ops, penalty):
+    """Wave-invariant spec terms, evaluated once per solve:
+    (pen_score, pen_counts)."""
+    pen_score = term_penalty(ops, {"penalty": penalty})
+    return pen_score, penalty
+
+
+def rescore_binpack(ops, after, avail, reserved):
+    """Bin-pack for an arbitrary post-delta usage plane `after` —
+    shared by the wave scorer and the in-kernel preemption pass (which
+    rescores nodes at `used + ask - freed`)."""
+    denom_cpu = avail[None, :, R_CPU]
+    denom_mem = avail[None, :, R_MEM]
+    util_cpu = after[:, :, R_CPU] + reserved[None, :, R_CPU]
+    util_mem = after[:, :, R_MEM] + reserved[None, :, R_MEM]
+    ok_denoms = (denom_cpu > 0) & (denom_mem > 0)
+    return term_binpack(ops, {"util_cpu": util_cpu, "util_mem": util_mem,
+                              "denom_cpu": denom_cpu,
+                              "denom_mem": denom_mem,
+                              "ok_denoms": ok_denoms})
+
+
+def evaluate_wave(ops, ctx):
+    """The term-loop evaluation the driven backends call once per wave:
+    masks, every registered term, combine.  Returns the exact
+    `group_scores` contract: (score, placeable, feas_b, fit, fit_dims,
+    dev_fit).
+
+    ctx keys — wave state: used, dev_used, coll, sp_used, blocked;
+    static planes: avail, reserved, ask_res, ask_desired, dev_cap,
+    dev_ask, feas; hoisted terms: pen_score, pen_counts, aff_score,
+    jitter; spread statics: sp_col, sp_weight, sp_targeted, vnode, des,
+    S, V; shape=(Gp, Np), seed, has_devices, has_spread, and the
+    optional `learned` plane (None = term statically absent)."""
+    f32 = ops.f32
+    after, fit_dims, fit, dev_fit, feas_b, placeable = \
+        term_feasibility(ops, ctx)
+
+    binpack = rescore_binpack(ops, after, ctx["avail"], ctx["reserved"])
+    anti, anti_counts = term_anti(ops, ctx)
+
+    if ctx["has_spread"]:
+        spread_total = ops.spread_sum(
+            ctx["S"], lambda s: term_spread(ops, ctx, s), ctx["shape"])
+        spread_counts = spread_total != 0.0
+    else:
+        spread_total = f32(0.0)
+        spread_counts = False
+
+    aff_score = ctx["aff_score"]
+    parts = {"binpack": binpack, "anti": anti,
+             "anti_counts": anti_counts,
+             "pen_score": ctx["pen_score"],
+             "pen_counts": ctx["pen_counts"],
+             "aff_score": aff_score, "aff_counts": aff_score != 0.0,
+             "spread_total": spread_total,
+             "spread_counts": spread_counts}
+    if ctx.get("learned") is not None:
+        # static branch: with no learned plane the combine path (and
+        # the traced program / float behavior) is byte-identical to a
+        # spec without the term — appending an all-zeros plane would
+        # still flip -0.0 sums to +0.0
+        parts["learned"] = term_learned(ops, ctx)
+        total = combine_learned(ops, ctx, parts)
+    else:
+        total = combine(ops, ctx, parts)
+    score = ops.where(placeable, total, f32(NEG_INF))
+    return score, placeable, feas_b, fit, fit_dims, dev_fit

@@ -371,6 +371,13 @@ class MeshEventLog:
         with self._lock:
             return len(self._events)
 
+    def __bool__(self) -> bool:
+        # __len__ alone would make an EMPTY log falsy, so
+        # `if event_log:` presence checks silently skip recording on
+        # the first event of a fresh log; a log object is always
+        # truthy — emptiness is `len(log) == 0`
+        return True
+
 
 #: process-global recorder + mesh event log (the go-metrics-style
 #: global sink analog; servers and solvers share them so one HTTP

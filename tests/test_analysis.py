@@ -1013,40 +1013,57 @@ def test_repo_index_sanity():
 
 
 def test_repo_scorer_registry_resolves_all_backends():
-    """SCORE6xx fingerprints all registered scorer backends on the
-    real tree — the host twin, the kernel twin, the shortlist
-    _sl_eval, the pallas fused pass AND the native C++ source — and
-    the cross-backend drift check passes (guards the registry against
-    going silently blind after a rename)."""
+    """SCORE6xx v3 on the real tree: the spec registry parses, the
+    spec reference fingerprints every core term, every registered
+    backend resolves, the hand backends (shortlist / pallas / native)
+    match the SPEC fingerprints, and the spec-driven backends (host /
+    kernel twins) fingerprint EMPTY — all their float ops live in
+    score_spec (guards the registry against going silently blind)."""
     import nomad_tpu
     from nomad_tpu.analysis.score_pass import (
-        native_fingerprint, python_fingerprint, DEFAULT_TERMS)
+        native_fingerprint, python_fingerprint, spec_reference)
     pkg_dir = os.path.dirname(os.path.dirname(
         os.path.abspath(nomad_tpu.__file__)))
     idx = PackageIndex.build(pkg_dir, "nomad_tpu")
-    prints = {}
-    for site in DEFAULT_SCORER_SITES:
+    terms_reg, spec_prints, names_map, const_set_groups, errors = \
+        spec_reference(idx)
+    assert terms_reg and not errors, errors
+    core = ("free", "binpack", "anti", "pen", "n_scorers", "total")
+    for group in core + ("spread", "learned"):
+        assert group in spec_prints, group
+    assert "spread" in const_set_groups
+    by_backend = {s.backend: s for s in DEFAULT_SCORER_SITES}
+    assert set(by_backend) == {"spec", "host", "kernel", "shortlist",
+                               "pallas", "native"}
+    all_groups = tuple(names_map)
+    for backend in ("shortlist", "pallas", "native"):
+        site = by_backend[backend]
         if site.kind == "python":
             fkeys = idx.match_funcs([site.site])
             assert fkeys, f"scorer site gone: {site.site}"
-            prints[site.backend] = python_fingerprint(
-                idx, idx.functions[fkeys[0]], DEFAULT_TERMS)
+            fp = python_fingerprint(idx, idx.functions[fkeys[0]],
+                                    all_groups, names_map)
         else:
             path = os.path.join(pkg_dir, site.site)
             assert os.path.exists(path), path
-            prints[site.backend] = native_fingerprint(
-                path, DEFAULT_TERMS)
-    assert set(prints) == {"host", "kernel", "shortlist", "pallas",
-                           "native"}
-    ref = prints["host"]
-    # every backend carries the core terms and agrees with the host
-    for term in ("free", "binpack", "anti", "pen", "n_scorers",
-                 "total"):
-        assert term in ref, term
-        for backend, fp in prints.items():
-            assert term in fp, (backend, term)
-            assert (fp[term].consts, fp[term].ops) == \
-                (ref[term].consts, ref[term].ops), (backend, term)
+            fp = native_fingerprint(path, all_groups, names_map)
+        for group in core:
+            assert group in fp, (backend, group)
+            assert (fp[group].consts, fp[group].ops) == \
+                (spec_prints[group].consts,
+                 spec_prints[group].ops), (backend, group)
+        assert set(fp["spread"].const_set) == \
+            set(spec_prints["spread"].const_set), backend
+        # the learned term flows to the driven backends only
+        assert "learned" not in fp, backend
+    for backend in ("host", "kernel"):
+        site = by_backend[backend]
+        assert site.kind == "driven"
+        fkeys = idx.match_funcs([site.site])
+        assert fkeys, f"driven site gone: {site.site}"
+        fp = python_fingerprint(idx, idx.functions[fkeys[0]],
+                                all_groups, names_map)
+        assert all(tp.empty() for tp in fp.values()), (backend, fp)
 
 
 def test_repo_new_passes_have_no_unsuppressed_findings():
@@ -1117,3 +1134,49 @@ def test_cli_no_baseline_json_reports_but_does_not_fail(capsys):
     assert listed and all(f["baselined"] for f in listed)
     assert all(f["severity"] in ("error", "warn") for f in listed)
     assert all("pass" in f for f in listed)
+
+
+def test_cli_paths_incremental_mode(capsys):
+    """--paths (pre-commit mode) scopes REPORTING to the named files
+    while still indexing the whole package — kernel.py's collectives
+    are only JIT205-clean because their mesh-root callers in OTHER
+    files are visible, so a partial index would manufacture findings.
+    SCORE603/SCORE604 (whole-package judgments) are muted, and
+    --prune-stale is refused outright."""
+    from nomad_tpu.analysis.__main__ import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    kern = os.path.join(repo, "nomad_tpu", "solver", "kernel.py")
+    assert main(["--paths", kern]) == 0
+    out = capsys.readouterr()
+    assert "JIT205" not in out.out            # full-index reachability
+    assert "stale baseline" not in out.err    # stale warnings muted
+    assert main(["--paths", kern, "--prune-stale"]) == 2
+    assert "whole-package view" in capsys.readouterr().err
+
+
+def test_paths_mode_drops_whole_package_rules(tmp_path):
+    """analyze(paths=...) scoping: a drifted shortlist twin keeps its
+    per-file SCORE601, while whole-package judgments (SCORE603 for the
+    registry rows the partial file set can't see, SCORE604) and
+    findings in unlisted files are dropped."""
+    root = write_fixture(tmp_path, {
+        "score_sl.py": FIX_SCORE_SL.replace("/ 18.0", "/ 16.0"),
+        "score_host.py": FIX_SCORE_HOST,
+        "native_score.cc": FIX_SCORE_CC})
+    rep = analyze(package_dir=root, package_name="fixpkg",
+                  use_baseline=False, config=FIX_CFG,
+                  paths=[os.path.join(root, "fixpkg", "score_sl.py")])
+    assert rep.findings                    # the SL drift still reported
+    assert all(f.rule not in ("SCORE603", "SCORE604")
+               for f in rep.findings)
+    assert all(os.path.normpath(f.path).endswith(
+        os.path.join("fixpkg", "score_sl.py")) for f in rep.findings)
+
+
+def test_nomadlint_console_script_declared():
+    """The packaged entry point must keep pointing at the CLI main —
+    `nomadlint` from a shell is the documented pre-commit invocation."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "pyproject.toml")) as f:
+        toml = f.read()
+    assert 'nomadlint = "nomad_tpu.analysis.__main__:main"' in toml

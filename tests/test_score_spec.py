@@ -1,0 +1,427 @@
+"""ISSUE 12: one scoring spec, N verified backends.
+
+Part 1 — the shared property harness: every spec term evaluated through
+NumpyOps and JaxOps on randomized planes must agree at the BIT level.
+That is literal for terms built from IEEE-exact ops (add / sub / mul /
+div / where / min / max / floor); the two places a gap is legitimate
+are pinned to a few ulp: binpack's `10.0 ** x` (libm vs XLA pow) and
+the select-sum spread accumulation order.  The solver's 0.05 score
+binning absorbs those, which is why end-to-end placements still
+compare bitwise (tests/test_host_solver.py).
+
+Part 2 — the reserved `learned` slot: a precomputed [Gp, Np] plane
+flows through BOTH spec-driven backends (host twin + jit wave scorer)
+with identical placements, forces the hand-written backends
+(shortlist, pallas) off, and an all-zeros plane places identically to
+no plane at all (the term really is a no-op until a model feeds it).
+
+Part 3 — the spec as a verified artifact: the committed golden
+fingerprint snapshot, placement identity across execution modes, and
+one-float-op perturbation proofs that nomadlint reports a drifted
+backend as SCORE601 — in all five backends, including the native C++
+scorer — and a driven backend that stops deferring to the spec as
+SCORE601/SCORE604.
+"""
+import ast
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nomad_tpu.solver import score_spec as ss
+from test_host_solver import assert_same, make_asks, make_nodes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden",
+                      "score_spec_fingerprints.json")
+
+# ================================================= part 1: the harness
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _to_jax(ctx):
+    jnp = _jnp()
+    return {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+            for k, v in ctx.items()}
+
+
+def _rand_planes(seed, Gp=6, Np=33, S=3, V=8, R=4, D=2):
+    """One randomized scoring context (numpy side)."""
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    return dict(
+        used=rng.uniform(0, 3000, (Np, R)).astype(f32),
+        dev_used=rng.uniform(0, 2, (Np, D)).astype(f32).round(),
+        coll=rng.integers(0, 3, (Gp, Np)).astype(f32),
+        sp_used=rng.uniform(0, 6, (Gp, S, V)).astype(f32).round(),
+        blocked=rng.random((Gp, Np)) < 0.1,
+        avail=rng.uniform(100, 8000, (Np, R)).astype(f32),
+        reserved=rng.uniform(0, 500, (Np, R)).astype(f32),
+        ask_res=rng.uniform(0, 1000, (Gp, R)).astype(f32),
+        ask_desired=rng.integers(1, 9, Gp).astype(f32),
+        dev_cap=rng.uniform(0, 4, (Np, D)).astype(f32).round(),
+        dev_ask=rng.uniform(0, 1, (Gp, D)).astype(f32).round(),
+        feas=rng.random((Gp, Np)) < 0.9,
+        aff_score=rng.uniform(-1, 1, (Gp, Np)).astype(f32),
+        jitter=(f32(1e-6) * rng.uniform(0, 1, (Gp, Np))).astype(f32),
+        sp_col=rng.integers(-1, 5, (Gp, S)).astype(np.int32),
+        sp_weight=rng.uniform(0, 1, (Gp, S)).astype(f32),
+        sp_targeted=rng.random((Gp, S)) < 0.5,
+        vnode=rng.integers(-1, V, (S, Gp, Np)).astype(np.int32),
+        des=rng.uniform(-1, 5, (S, Gp, Np)).astype(f32).round(),
+        penalty=rng.random(Np) < 0.3,
+        learned=rng.uniform(-1, 1, (Gp, Np)).astype(f32),
+    )
+
+
+def _rand_parts(rng, Gp, Np):
+    f32 = np.float32
+    parts = dict(
+        binpack=rng.uniform(0, 1, (Gp, Np)).astype(f32),
+        anti=rng.uniform(-1, 0, (Gp, Np)).astype(f32),
+        anti_counts=rng.random((Gp, Np)) < 0.5,
+        pen_score=rng.uniform(-1, 0, (1, Np)).astype(f32),
+        pen_counts=rng.random(Np) < 0.2,
+        aff_score=rng.uniform(-1, 1, (Gp, Np)).astype(f32),
+        spread_total=rng.uniform(-1, 1, (Gp, Np)).astype(f32),
+    )
+    parts["aff_counts"] = parts["aff_score"] != 0.0
+    parts["spread_counts"] = parts["spread_total"] != 0.0
+    return parts
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exact_terms_bit_identical(seed):
+    """anti / pen / combine are IEEE-exact op chains: both backends
+    must agree to the last bit, no tolerance."""
+    ctx = _rand_planes(seed)
+    nops, jops = ss.NumpyOps(), ss.JaxOps()
+
+    an, anc = ss.term_anti(nops, ctx)
+    aj, ajc = ss.term_anti(jops, _to_jax(ctx))
+    np.testing.assert_array_equal(an, np.asarray(aj))
+    np.testing.assert_array_equal(anc, np.asarray(ajc))
+
+    pn = ss.term_penalty(nops, {"penalty": ctx["penalty"]})
+    pj = ss.term_penalty(jops, {"penalty": ctx["penalty"]})
+    np.testing.assert_array_equal(pn, np.asarray(pj))
+
+    rng = np.random.default_rng(seed + 100)
+    parts = _rand_parts(rng, 6, 33)
+    for s in (0, 3):
+        cctx = {"seed": s, "jitter": ctx["jitter"]}
+        cn = ss.combine(nops, cctx, parts)
+        cj = ss.combine(jops, _to_jax(cctx), _to_jax(parts))
+        np.testing.assert_array_equal(cn, np.asarray(cj))
+        lparts = dict(parts, learned=ctx["learned"])
+        ln = ss.combine_learned(nops, cctx, lparts)
+        lj = ss.combine_learned(jops, _to_jax(cctx), _to_jax(lparts))
+        np.testing.assert_array_equal(ln, np.asarray(lj))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_binpack_within_pow_ulp(seed):
+    """binpack carries the one genuinely libm-dependent op (10**x);
+    the backends may differ there by a few ulp (measured <= 3 on
+    these planes; bound pinned at 4)."""
+    ctx = _rand_planes(seed)
+    after = (ctx["used"][None, :, :] + ctx["ask_res"][:, None, :])
+    bn = ss.rescore_binpack(ss.NumpyOps(), after, ctx["avail"],
+                            ctx["reserved"])
+    bj = ss.rescore_binpack(ss.JaxOps(), _jnp().asarray(after),
+                            ctx["avail"], ctx["reserved"])
+    np.testing.assert_array_max_ulp(bn, np.asarray(bj), maxulp=4)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("V", [8, 32])
+def test_spread_both_gather_regimes(seed, V):
+    """V=8 exercises JaxOps' select-sum `cur` (vs numpy's gather) —
+    a different accumulation ORDER, so <= 2 ulp; V=32 exercises the
+    gather path, which matches numpy exactly."""
+    Gp, Np, S = 6, 33, 3
+    ctx = _rand_planes(seed, V=V)
+    nops, jops = ss.NumpyOps(), ss.JaxOps()
+    cj = _to_jax(ctx)
+    ctx["V"] = cj["V"] = V
+    outn = nops.spread_sum(S, lambda s: ss.term_spread(nops, ctx, s),
+                           (Gp, Np))
+    outj = jops.spread_sum(S, lambda s: ss.term_spread(jops, cj, s),
+                           (Gp, Np))
+    np.testing.assert_array_max_ulp(outn, np.asarray(outj), maxulp=2)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("has_spread", [True, False])
+@pytest.mark.parametrize("with_learned", [False, True])
+def test_evaluate_wave_cross_backend(seed, has_spread, with_learned):
+    """The full driven term loop: all masks bit-equal, the NEG_INF
+    placeability mask bit-equal.  The composed score SUM can cancel
+    toward zero, where a relative-ulp bound is meaningless — finite
+    scores compare under a tight allclose instead; bit-level placement
+    identity end-to-end is what test_mode_matrix / test_host_solver
+    assert."""
+    Gp, Np, S, V = 6, 33, 3, 8
+    planes = _rand_planes(seed, Gp=Gp, Np=Np, S=S, V=V)
+    learned = planes.pop("learned")
+    pen = planes.pop("penalty")
+    outs = []
+    for ops, conv in ((ss.NumpyOps(), np.asarray),
+                      (ss.JaxOps(), _jnp().asarray)):
+        ctx = {k: conv(v) if isinstance(v, np.ndarray) else v
+               for k, v in planes.items()}
+        pen_score, pen_counts = ss.static_terms(ops, conv(pen))
+        ctx.update(pen_score=pen_score, pen_counts=pen_counts,
+                   S=S, V=V, shape=(Gp, Np), seed=seed,
+                   has_devices=True, has_spread=has_spread,
+                   learned=conv(learned) if with_learned else None)
+        outs.append([np.asarray(o)
+                     for o in ss.evaluate_wave(ops, ctx)])
+    (score_n, *masks_n), (score_j, *masks_j) = outs
+    for mn, mj in zip(masks_n, masks_j):
+        np.testing.assert_array_equal(mn, mj)
+    finite_n = score_n > ss.NEG_INF / 2
+    finite_j = score_j > ss.NEG_INF / 2
+    np.testing.assert_array_equal(finite_n, finite_j)
+    np.testing.assert_allclose(score_n[finite_n], score_j[finite_j],
+                               rtol=2e-5, atol=2e-6)
+
+
+# ====================================== part 2: the reserved slot
+
+
+def _pack(style="binpack", n_nodes=30, count=6):
+    from nomad_tpu.solver.solve import _kernel_args
+    from nomad_tpu.solver.tensorize import Tensorizer
+    pb = Tensorizer().pack(make_nodes(n_nodes), make_asks(style,
+                                                          count=count))
+    has_spread = bool((pb.sp_col[:, 0] >= 0).any())
+    return _kernel_args(pb), has_spread
+
+
+def test_learned_plane_host_matches_kernel():
+    from nomad_tpu.solver.host import host_solve_kernel
+    from nomad_tpu.solver.kernel import solve_kernel
+    args, has_spread = _pack()
+    Np, Gp = args[0].shape[0], args[6].shape[0]
+    rng = np.random.default_rng(7)
+    learned = (0.5 * rng.standard_normal((Gp, Np))).astype(np.float32)
+    res_dev = solve_kernel(*args, 3, has_spread=has_spread,
+                           learned=learned)
+    res_host = host_solve_kernel(*args, 3, has_spread=has_spread,
+                                 learned=learned)
+    assert_same(res_dev, res_host)
+    # a learned plane MUST shift placements relative to the base spec
+    # on this scenario — otherwise this test proves nothing
+    base = host_solve_kernel(*args, 3, has_spread=has_spread)
+    assert not np.array_equal(
+        np.where(res_host.choice_ok, res_host.choice, -1),
+        np.where(base.choice_ok, base.choice, -1))
+
+
+def test_learned_forces_hand_backends_off():
+    """shortlist and pallas don't implement the learned term (see
+    score_spec.TERMS backends tuple); requesting them alongside a
+    learned plane must silently fall back to the driven full-wave path
+    and produce the identical solve."""
+    from nomad_tpu.solver.kernel import solve_kernel
+    args, has_spread = _pack()
+    Np, Gp = args[0].shape[0], args[6].shape[0]
+    rng = np.random.default_rng(8)
+    learned = (0.5 * rng.standard_normal((Gp, Np))).astype(np.float32)
+    plain = solve_kernel(*args, 0, has_spread=has_spread,
+                         learned=learned)
+    forced = solve_kernel(*args, 0, has_spread=has_spread,
+                          learned=learned, shortlist_c=40,
+                          pallas_mode="score")
+    np.testing.assert_array_equal(np.asarray(plain.choice_ok),
+                                  np.asarray(forced.choice_ok))
+    np.testing.assert_array_equal(np.asarray(plain.choice),
+                                  np.asarray(forced.choice))
+    np.testing.assert_array_equal(np.asarray(plain.score),
+                                  np.asarray(forced.score))
+
+
+def test_learned_zero_plane_is_noop():
+    """An all-zeros learned plane counts as zero appended scorers and
+    adds zero to the sum — placements identical to no plane at all.
+    This is the acceptance demo: registering the term changed NOTHING
+    for learned-free solves."""
+    from nomad_tpu.solver.host import host_solve_kernel
+    from nomad_tpu.solver.kernel import solve_kernel
+    args, has_spread = _pack()
+    Np, Gp = args[0].shape[0], args[6].shape[0]
+    zeros = np.zeros((Gp, Np), np.float32)
+    for fn in (host_solve_kernel, solve_kernel):
+        base = fn(*args, 3, has_spread=has_spread)
+        zp = fn(*args, 3, has_spread=has_spread, learned=zeros)
+        np.testing.assert_array_equal(np.asarray(base.choice_ok),
+                                      np.asarray(zp.choice_ok))
+        np.testing.assert_array_equal(np.asarray(base.choice),
+                                      np.asarray(zp.choice))
+        np.testing.assert_array_equal(np.asarray(base.score),
+                                      np.asarray(zp.score))
+
+
+@pytest.mark.parametrize("pallas_mode,shortlist_c",
+                         [("off", 0), ("score", 0), ("topk", 0),
+                          ("off", 40)])
+def test_mode_matrix_placements_identical(pallas_mode, shortlist_c):
+    """Every execution mode of the kernel (full wave, pallas score,
+    pallas fused topk, shortlist rescore) defers to or is verified
+    against the ONE spec — placements must be bit-identical to the
+    host twin in all of them."""
+    from nomad_tpu.solver.host import host_solve_kernel
+    from nomad_tpu.solver.kernel import solve_kernel
+    args, has_spread = _pack("constrained", n_nodes=40, count=6)
+    res_host = host_solve_kernel(*args, 0, has_spread=has_spread)
+    res_dev = solve_kernel(*args, 0, has_spread=has_spread,
+                           pallas_mode=pallas_mode,
+                           shortlist_c=shortlist_c)
+    assert_same(res_dev, res_host)
+
+
+# ============================== part 3: the spec as an artifact
+
+
+def _build_index(root):
+    from nomad_tpu.analysis.core import PackageIndex
+    return PackageIndex.build(root, "nomad_tpu")
+
+
+def test_golden_fingerprints_match():
+    """The committed snapshot IS the scoring contract: any change to a
+    term body shows up here as a reviewable diff (and in SCORE601 for
+    every hand backend that didn't follow)."""
+    from nomad_tpu.analysis.score_pass import spec_reference
+    terms_reg, prints, _names, const_set, errors = spec_reference(
+        _build_index(REPO))
+    assert errors == []
+    payload = {
+        "spec_version": ss.SPEC_VERSION,
+        "terms": [t["name"] for t in terms_reg],
+        "const_set_groups": sorted(const_set),
+        "fingerprints": {
+            g: {"consts": list(tp.consts),
+                "ops": [list(o) for o in tp.ops],
+                "const_set": list(tp.const_set)}
+            for g, tp in sorted(prints.items())},
+    }
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    golden.pop("_note", None)
+    if payload != golden:
+        pytest.fail(
+            "spec fingerprints diverge from the committed golden "
+            "snapshot. If the scoring-semantics change is deliberate, "
+            "update tests/golden/score_spec_fingerprints.json to:\n"
+            + json.dumps(payload, indent=1))
+
+
+# ---- one-float-op perturbation proofs --------------------------------
+
+_MUT_FILES = (
+    "nomad_tpu/__init__.py",
+    "nomad_tpu/solver/__init__.py",
+    "nomad_tpu/solver/score_spec.py",
+    "nomad_tpu/solver/host.py",
+    "nomad_tpu/solver/kernel.py",
+    "nomad_tpu/solver/pallas_kernel.py",
+    "nomad_tpu/solver/native/host_solve.cc",
+)
+
+
+def _replace_in_func(src, func, old, new):
+    """Apply old->new exactly once, scoped to the named (possibly
+    nested) def's line span."""
+    tree = ast.parse(src)
+    span = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func:
+            span = (node.lineno, node.end_lineno)
+    assert span, f"function {func} not found"
+    lines = src.splitlines(keepends=True)
+    body = "".join(lines[span[0] - 1:span[1]])
+    assert old in body, f"{old!r} not in {func}"
+    body = body.replace(old, new, 1)
+    return ("".join(lines[:span[0] - 1]) + body
+            + "".join(lines[span[1]:]))
+
+
+def _run_pass_on_copy(tmp_path, mutations):
+    """Copy the scorer-backend files into a throwaway package root,
+    apply `mutations` {relpath: src -> src}, run ONLY the score pass
+    (pure AST — the copies are never imported)."""
+    from nomad_tpu.analysis.core import AnalysisConfig
+    from nomad_tpu.analysis.score_pass import run_score_pass
+    root = tmp_path / "mut"
+    for rel in _MUT_FILES:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+            src = f.read()
+        if rel in mutations:
+            src = mutations[rel](src)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return run_score_pass(_build_index(str(root)), AnalysisConfig(),
+                          package_dir=str(root))
+
+
+def test_unmutated_copy_is_score_clean(tmp_path):
+    assert _run_pass_on_copy(tmp_path, {}) == []
+
+
+# one float-op mutation per backend; every one must surface as
+# SCORE601 attributed to exactly that backend
+_PERTURBATIONS = [
+    ("shortlist", "nomad_tpu/solver/kernel.py",
+     lambda s: _replace_in_func(s, "_sl_eval", "/ 18.0", "/ 17.0")),
+    ("pallas", "nomad_tpu/solver/pallas_kernel.py",
+     lambda s: _replace_in_func(s, "_wave_tile_kernel",
+                                "f32(18.0)", "f32(17.5)")),
+    ("native", "nomad_tpu/solver/native/host_solve.cc",
+     lambda s: s.replace("raw / 18.0f", "raw / 17.0f", 1)),
+    # driven backends carry NO scoring arithmetic — hand-editing any
+    # back in (here: a stray total rescale) is the drift
+    ("host", "nomad_tpu/solver/host.py",
+     lambda s: s.replace(
+         "        return _score_spec.evaluate_wave(_NP_OPS, ctx)",
+         '        total = ctx["aff_score"] * 0.5\n'
+         "        return _score_spec.evaluate_wave(_NP_OPS, ctx)", 1)),
+    ("kernel", "nomad_tpu/solver/kernel.py",
+     lambda s: s.replace(
+         "        return _score_spec.evaluate_wave(_JAX_OPS, ctx)",
+         '        n_scorers = 2.0 + ctx["seed"]\n'
+         "        return _score_spec.evaluate_wave(_JAX_OPS, ctx)", 1)),
+]
+
+
+@pytest.mark.parametrize("backend,rel,mut", _PERTURBATIONS,
+                         ids=[p[0] for p in _PERTURBATIONS])
+def test_one_float_op_perturbation_trips_score601(tmp_path, backend,
+                                                  rel, mut):
+    findings = _run_pass_on_copy(tmp_path, {rel: mut})
+    hits = [f for f in findings
+            if f.rule == "SCORE601" and f.func == backend]
+    assert hits, (f"mutated {backend} not reported as SCORE601: "
+                  f"{[(f.rule, f.func, f.symbol) for f in findings]}")
+    others = {f.func for f in findings if f.rule == "SCORE601"}
+    assert others == {backend}, (
+        f"SCORE601 bled onto unmutated backends: {others}")
+
+
+def test_driven_backend_must_call_the_spec(tmp_path):
+    """A driven site that stops deferring to evaluate_wave is coverage
+    drift (SCORE604), even if it adds no arithmetic of its own."""
+    findings = _run_pass_on_copy(tmp_path, {
+        "nomad_tpu/solver/kernel.py": lambda s: s.replace(
+            "        return _score_spec.evaluate_wave(_JAX_OPS, ctx)",
+            "        return ctx", 1)})
+    hits = [f for f in findings
+            if f.rule == "SCORE604" and f.func == "kernel"]
+    assert hits, [(f.rule, f.func, f.symbol) for f in findings]

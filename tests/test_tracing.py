@@ -475,3 +475,20 @@ def test_mesh_event_log_jsonl_sink(tmp_path):
     rows = [json.loads(ln) for ln in sink.read_text().splitlines()]
     assert [r["kind"] for r in rows] == ["grow", "fail"]
     assert rows[0]["bytes"] == 128
+
+
+def test_mesh_event_log_truthiness_regression():
+    """A FRESH (empty) log must still be truthy: with only __len__
+    defined, `if event_log:` presence guards were False exactly until
+    the first event was recorded — so the first transition of every
+    solve was silently dropped.  Emptiness is spelled len(log) == 0."""
+    log = MeshEventLog(depth=8)
+    assert len(log) == 0
+    assert bool(log)                 # empty but present
+    recorded = []
+    for _ in range(2):
+        # the exact call-site shape the bug broke: guard, then record
+        if log:
+            recorded.append(log.record("grow", tiles=[1]))
+    assert len(recorded) == 2        # first event NOT skipped
+    assert len(log) == 2 and bool(log)
