@@ -891,6 +891,256 @@ def run_multichip(n_devices=8, sizes=None, n_evals=16, count=64,
     return out
 
 
+# --------------- multi-region WAN federation phase (ISSUE 13) -------
+
+def _region_queue_sim(arrivals, regions, svc, router=None,
+                      watermark=None):
+    """Deterministic FIFO queue simulation shared by the multiregion
+    legs.  arrivals: [(t, home_region)] ascending; each region is one
+    server with fixed per-eval service time `svc` (the measured
+    device rate).  With a SpilloverRouter the router picks the region
+    per arrival (backlogs fed via note_ready, shed lane drained as
+    capacity returns); without one every eval runs in its home region
+    and `watermark` backlogs are recorded as brownouts.  Returns
+    (latencies, browned_regions, completed)."""
+    import collections
+    comp = {r: collections.deque() for r in regions}
+    last = {r: 0.0 for r in regions}
+    lat, browned = [], set()
+
+    def depth(r, t):
+        dq = comp[r]
+        while dq and dq[0] <= t:
+            dq.popleft()
+        return len(dq)
+
+    def enqueue(r, t, t_arr):
+        done = max(last[r], t) + svc
+        last[r] = done
+        comp[r].append(done)
+        lat.append(done - t_arr)
+
+    for t, home in arrivals:
+        if router is None:
+            if depth(home, t) >= watermark:
+                browned.add(home)
+            enqueue(home, t, t)
+            continue
+        for r in regions:
+            router.region(r).note_ready(depth(r, t))
+        for ev, r in router.drain_shed():
+            enqueue(r, t, ev[0])
+        reg, _cause = router.route((t, home), home=home)
+        if reg is not None:
+            enqueue(reg, t, t)
+    # park-drain: anything the router shed completes once capacity
+    # returns (never dropped)
+    t = max(last.values())
+    for _ in range(100_000):
+        if router is None or not router.shed_depth():
+            break
+        t += svc
+        for r in regions:
+            router.region(r).note_ready(depth(r, t))
+        for ev, r in router.drain_shed():
+            enqueue(r, t, ev[0])
+    return lat, browned, len(lat)
+
+
+def run_multiregion(n_devices=8, n_regions=4, n_nodes=None, n_evals=16,
+                    count=64, evals_per_call=8, write_detail=True):
+    """Multi-region WAN federation phase (ISSUE 13).
+
+    Two legs.  (a) WAN exchange: CrossRegionResidentSolver places the
+    same eval stream as a flat ShardedResidentSolver over the union
+    fleet — placements must match exactly (the hierarchical candidate
+    exchange is a transport optimisation, not a semantic change) —
+    and wave_traffic's wan block reports the three-tier byte model
+    with the `wan_cut_vs_flat <= 1/4` acceptance figure at bench
+    scale.  (b) SLO spillover: a deterministic queue simulation
+    parameterised by the measured device solve rate, driving skewed
+    regional load (one hot region at ~1.4x its capacity) through
+    three routing policies — region-isolated (stock semantics: the
+    hot region browns out), SpilloverRouter (overflow to the
+    cheapest sibling at SLO), and a balanced-load reference.  The
+    acceptance bar: spillover's global p99 stays within 2x the
+    balanced p99 while the isolated leg browns out, with zero evals
+    lost and the shed-lane accounting intact.
+
+    Self-provisions the virtual device platform like run_multichip;
+    sizes default to 50k union nodes (NOMAD_TPU_MULTIREGION_NODES
+    overrides).  The record merges into MULTICHIP_DETAIL.json under
+    "multiregion"."""
+    import importlib
+    graft = importlib.import_module("__graft_entry__")
+    n_devices, n_regions = graft._ensure_devices(n_devices, n_regions)
+    import random
+
+    import jax
+    import numpy as np
+    from nomad_tpu.parallel.federated import CrossRegionResidentSolver
+    from nomad_tpu.parallel.sharded import ShardedResidentSolver
+    from nomad_tpu.server.serving import SpilloverRouter
+    from nomad_tpu.solver.tensorize import Tensorizer
+    from nomad_tpu.utils.compile_cache import cache_entries
+
+    if n_nodes is None:
+        n_nodes = int(os.environ.get("NOMAD_TPU_MULTIREGION_NODES",
+                                     "50000"))
+    per_region = n_nodes // n_regions
+    nodes = make_nodes(per_region * n_regions)
+    region_nodes = [nodes[r * per_region:(r + 1) * per_region]
+                    for r in range(n_regions)]
+    probe_job = make_job(2, 0, count)
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    gp = 1 << max(0, (gp_need - 1).bit_length())
+    kp = 1 << max(0, (count - 1).bit_length())
+    epc = min(evals_per_call, n_evals)
+    NB = -(-n_evals // epc)
+    out = {"phase": "multiregion", "n_devices": int(n_devices),
+           "n_regions": int(n_regions), "skipped": False,
+           "backend": jax.default_backend()}
+
+    # ---- WAN leg: cross-region scheduling vs the flat union mesh ---
+    cache0 = cache_entries()
+    cr = CrossRegionResidentSolver(
+        region_nodes, asks_for(probe_job), n_devices=n_devices,
+        gp=gp, kp=kp, max_waves=18, pallas="off")
+    jobs = [make_job(2, e, count) for e in range(n_evals)]
+    batches = [cr.pack_batch(asks_for(j)) for j in jobs]
+    assert all(pb is not None for pb in batches)
+    t_wan = None
+    for _round in range(2):                      # round 0 compiles
+        cr.reset_usage()
+        t0 = time.perf_counter()
+        outs = [cr.solve_stream_async(batches[b * epc:(b + 1) * epc])
+                for b in range(NB)]
+        jax.block_until_ready(outs[-1])
+        t_wan = time.perf_counter() - t0
+    cache_rep = _cache_report(cache0)
+
+    rs = ShardedResidentSolver(nodes, asks_for(probe_job),
+                               n_devices=n_devices, gp=gp, kp=kp,
+                               max_waves=18, pallas="off")
+    bf = [rs.pack_batch(asks_for(j)) for j in jobs]
+    t_flat = None
+    for _round in range(2):
+        rs.reset_usage()
+        t0 = time.perf_counter()
+        outs = [rs.solve_stream_async(bf[b * epc:(b + 1) * epc])
+                for b in range(NB)]
+        jax.block_until_ready(outs[-1])
+        t_flat = time.perf_counter() - t0
+    # placement parity spot check: the WAN exchange must be invisible
+    cr.reset_usage()
+    rs.reset_usage()
+    c1, o1, _, st1 = cr.solve_stream(batches[:epc])
+    c2, o2, _, st2 = rs.solve_stream(bf[:epc])
+    parity = bool(np.array_equal(o1, o2)
+                  and np.array_equal(st1, st2)
+                  and np.array_equal(np.where(o1, c1, -1),
+                                     np.where(o2, c2, -1)))
+    wt = cr.wave_traffic(batches[:epc])
+    wan = wt["wan"]
+    measured = wt["measured"]
+    out["wan"] = {
+        "n_nodes": int(n_nodes),
+        "np_padded": int(cr.template.avail.shape[0]),
+        "shards_per_region": wan["shards_per_region"],
+        "wan_resident_s": round(t_wan, 4),
+        "flat_resident_s": round(t_flat, 4),
+        "placements_match_flat": parity,
+        "bytes_wan_per_wave": wan["bytes_wan_total_per_wave"],
+        "flat_wan_per_wave": wan["flat_wan_total_per_wave"],
+        "wan_cut_vs_flat": round(wan["wan_cut_vs_flat"], 4),
+        "wan_within_quarter": bool(wan["wan_cut_vs_flat"] <= 0.25),
+        "model": wan,
+        "measured": measured,
+        "compile_cache": cache_rep,
+    }
+
+    # ---- spillover leg: skewed load through three routing policies -
+    # measured per-eval device rate parameterises the queue sim; the
+    # p99 RATIOS are scale-free (all times are multiples of svc), so
+    # the acceptance figure is deterministic under the fixed seed
+    svc = max(t_wan / max(n_evals, 1), 1e-6)
+    regions = [f"r{i}" for i in range(n_regions)]
+    rng = random.Random(13)
+    n_arr = 400
+    lam = 2.0 / svc                      # total load = 50% of fleet
+    t_a, arrivals = 0.0, []
+    for _ in range(n_arr):
+        t_a += rng.expovariate(lam)
+        hot = rng.random() < 0.7         # ~1.4x the hot region's rate
+        arrivals.append((t_a, regions[0] if hot
+                         else regions[1 + rng.randrange(
+                             n_regions - 1)]))
+    balanced = [(t, regions[i % n_regions])
+                for i, (t, _h) in enumerate(arrivals)]
+    mp_small = 64                        # smoke-scale watermark
+    lat_iso, browned, done_iso = _region_queue_sim(
+        arrivals, regions, svc, watermark=int(0.75 * mp_small))
+
+    def _router():
+        r = SpilloverRouter(
+            regions={name: 1.0 + 0.1 * i
+                     for i, name in enumerate(regions)},
+            overrides={"slo_budget_s": 2.5 * svc, "spill_margin": 1.0,
+                       "max_pending": mp_small})
+        for name in regions:
+            for b in (1, 2, 4, 8, 16, 32, 64):
+                r.note_solve(name, b, b * svc)
+        return r
+
+    router = _router()
+    lat_sp, _b, done_sp = _region_queue_sim(arrivals, regions, svc,
+                                            router=router)
+    router_bal = _router()
+    lat_bal, _b, done_bal = _region_queue_sim(balanced, regions, svc,
+                                              router=router_bal)
+    p99_iso = pct(sorted(lat_iso), 0.99)
+    p99_sp = pct(sorted(lat_sp), 0.99)
+    p99_bal = pct(sorted(lat_bal), 0.99)
+    stats = router.stats()
+    out["spillover"] = {
+        "n_arrivals": n_arr,
+        "svc_per_eval_s": round(svc, 6),
+        "hot_region_share": 0.7,
+        "isolated_browned_regions": sorted(browned),
+        "p99_isolated_s": round(p99_iso, 4),
+        "p99_spillover_s": round(p99_sp, 4),
+        "p99_balanced_s": round(p99_bal, 4),
+        "p99_vs_balanced": round(p99_sp / max(p99_bal, 1e-9), 3),
+        "evals_lost": (n_arr - done_sp) + (n_arr - done_iso)
+        + (n_arr - done_bal),
+        "shed_lane_depth_end": router.shed_depth(),
+        "routed": stats["routed"],
+        "shed_accounting_intact": (
+            stats["routed"]["shed"] == stats["routed"]["readmitted"]
+            and router.shed_depth() == 0),
+        "spill_ok": bool(p99_sp <= 2 * p99_bal and browned
+                         and done_sp == n_arr),
+    }
+    out["ok"] = bool(out["wan"]["wan_within_quarter"] and parity
+                     and out["spillover"]["spill_ok"]
+                     and out["spillover"]["evals_lost"] == 0
+                     and out["spillover"]["shed_accounting_intact"])
+    if write_detail:
+        path = os.path.join(REPO, "MULTICHIP_DETAIL.json")
+        detail = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    detail = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                detail = {}
+        detail["multiregion"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+    return out
+
+
 # ---------------- open-loop serving phase (ISSUE 6) -----------------
 
 def poisson_arrivals(rate, duration_s, rng):
@@ -2092,6 +2342,12 @@ def main():
         out = run_multichip()
         print("\x1e" + json.dumps(out))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--multiregion":
+        # subprocess mode: the WAN federation phase (ISSUE 13) —
+        # merges its record into MULTICHIP_DETAIL.json, prints it
+        out = run_multiregion()
+        print("\x1e" + json.dumps(out))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--open-loop":
         # subprocess mode: the open-loop serving phase (ISSUE 6) —
         # merges its record into BENCH_DETAIL.json under "open_loop"
@@ -2203,6 +2459,26 @@ def main():
         sys.stderr.write(
             f"multichip phase failed rc={mp.returncode}:\n"
             f"{(mp.stderr or '')[-1500:]}\n")
+    # multi-region WAN federation phase (ISSUE 13): same forced
+    # 8-device virtual platform as multichip, run AFTER it so the
+    # record merges into the MULTICHIP_DETAIL.json it just wrote
+    multiregion = None
+    mr = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multiregion"],
+        capture_output=True, text=True, env=mp_env)
+    for line in mr.stdout.splitlines():
+        if line.startswith("\x1e"):
+            try:
+                multiregion = json.loads(line[1:])
+            except json.JSONDecodeError:
+                multiregion = None
+    if multiregion is None:
+        multiregion = {"phase": "multiregion", "skipped": True,
+                       "rc": mr.returncode,
+                       "tail": (mr.stderr or mr.stdout)[-1500:]}
+        sys.stderr.write(
+            f"multiregion phase failed rc={mr.returncode}:\n"
+            f"{(mr.stderr or '')[-1500:]}\n")
     # open-loop serving phase (ISSUE 6) in its own subprocess: it
     # drives threads + a large broker population and must not perturb
     # the configs' device state; the record is also self-merged into
@@ -2267,6 +2543,7 @@ def main():
     detail = {"configs": results,
               "transport_rtt_ms": round(1000 * rtt, 1),
               "multichip": multichip,
+              "multiregion": multiregion,
               "open_loop": open_loop,
               "overcommit": overcommit,
               "tracing_overhead": tracing,

@@ -27,7 +27,13 @@ Rules
           function is not reachable from any shard_map/pmap root, so
           the axis name cannot be bound and the call raises (or, in a
           refactor that drops the shard_map wrapper, turns the mesh-
-          resident solve into a latent trace error)
+          resident solve into a latent trace error).  Also covers
+          wrong-axis collectives under statically-known meshes —
+          including three-level ("regions", "hosts", "chips") tuples,
+          meshes built by an internal helper (make_three_tier_mesh
+          style: one return-level deep), and axes bound only by an
+          INNER nested context while the body is also reachable from
+          an outer mesh (ISSUE 13)
 """
 from __future__ import annotations
 
@@ -136,6 +142,55 @@ def _mesh_ctor_axes(index: PackageIndex, fi, aliases: Dict[str, str],
     return axes or None
 
 
+def _helper_mesh_axes(index: PackageIndex,
+                      fkey: Optional[str]) -> Optional[Set[str]]:
+    """Axis names bound by a Mesh an internal helper constructs and
+    returns (the make_three_tier_mesh shape: `mesh=make_mesh(...)` at
+    the shard_map call site).  Follows ONE level: every return path
+    must be a visible `Mesh(devs, (...))` ctor (or a local bound to
+    one) with statically resolvable names; multiple return paths keep
+    only the axes bound on EVERY path.  None = not provable."""
+    fi = index.functions.get(fkey) if fkey else None
+    if fi is None:
+        return None
+    aliases = dict(index.modules[fi.module].aliases)
+    aliases.update(index._local_imports(fi))
+
+    def _full(node) -> str:
+        d = _dotted(node)
+        if not d:
+            return ""
+        head = d.split(".")[0]
+        resolved = aliases.get(head)
+        return (resolved + d[len(head):]) if resolved else d
+
+    mesh_locals: Dict[str, Optional[Set[str]]] = {}
+    for node in index._own_nodes(fi):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and _full(node.value.func).endswith("Mesh"):
+            mesh_locals[node.targets[0].id] = _mesh_ctor_axes(
+                index, fi, aliases, node.value)
+    axes: Optional[Set[str]] = None
+    saw_return = False
+    for node in index._own_nodes(fi):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        saw_return = True
+        v = node.value
+        if isinstance(v, ast.Call) and _full(v.func).endswith("Mesh"):
+            a = _mesh_ctor_axes(index, fi, aliases, v)
+        elif isinstance(v, ast.Name):
+            a = mesh_locals.get(v.id)
+        else:
+            a = None
+        if a is None:
+            return None
+        axes = a if axes is None else (axes & a)
+    return axes if saw_return and axes else None
+
+
 def find_mesh_roots(index: PackageIndex) -> List[str]:
     """Functions handed to shard_map/pmap — the roots under which a
     collective primitive has a bound axis name (see
@@ -196,6 +251,13 @@ def find_mesh_roots_with_axes(
                     mesh_locals[node.targets[0].id] = _mesh_ctor_axes(
                         index, fi, aliases, node.value)
                     continue
+                # `m = make_three_tier_mesh(...)`: an internal helper
+                # returning a Mesh binds axes just as a local ctor does
+                hk = index.resolve_call(fi, node.value, la, lt)
+                hx = _helper_mesh_axes(index, hk)
+                if hx is not None:
+                    mesh_locals[node.targets[0].id] = hx
+                    continue
                 tgt = _target_of(node.value)
                 if tgt:
                     partial_locals[node.targets[0].id] = tgt
@@ -208,10 +270,13 @@ def find_mesh_roots_with_axes(
                     s = _axis_str(index, fi, aliases, kw.value)
                     return {s} if s is not None else None
                 if kw.arg == "mesh":
-                    if isinstance(kw.value, ast.Call) and \
-                            _full(kw.value.func).endswith("Mesh"):
-                        return _mesh_ctor_axes(index, fi, aliases,
-                                               kw.value)
+                    if isinstance(kw.value, ast.Call):
+                        if _full(kw.value.func).endswith("Mesh"):
+                            return _mesh_ctor_axes(index, fi, aliases,
+                                                   kw.value)
+                        return _helper_mesh_axes(
+                            index, index.resolve_call(fi, kw.value,
+                                                      la, lt))
                     if isinstance(kw.value, ast.Name):
                         return mesh_locals.get(kw.value.id)
                     return None
@@ -231,10 +296,14 @@ def find_mesh_roots_with_axes(
             if tgt:
                 axes = _axes_of_call(node)
                 if tgt in roots:
-                    # several contexts wrap the same body: an axis is
-                    # only provably unbound if EVERY context is known
+                    # several contexts wrap the same body: only axes
+                    # EVERY known context binds are provably safe — an
+                    # axis bound only by an inner three-tier context
+                    # still trace-fails when the body runs under the
+                    # outer mesh (ISSUE 13's nested-region hazard);
+                    # any unresolvable context still silences the check
                     prev = roots[tgt]
-                    roots[tgt] = (prev | axes
+                    roots[tgt] = (prev & axes
                                   if prev is not None and axes is not None
                                   else None)
                 else:
@@ -484,17 +553,19 @@ def run_jit_pass(index: PackageIndex, cfg: AnalysisConfig
     # ---- JIT205: collectives outside a mesh/shard_map context
     mesh_roots = find_mesh_roots_with_axes(index)
     mesh_ok = index.reachable(mesh_roots)
-    # per-function union of the axis names every enclosing mesh
-    # context provably binds; None = some context is statically
-    # unresolvable, so the axis-binding check stays silent (ISSUE 8:
-    # nested ("hosts", "chips") axes make wrong-axis psums a hazard
-    # the reachability check alone cannot see)
+    # per-function INTERSECTION of the axis names the enclosing mesh
+    # contexts provably bind: an axis bound only by an inner nested
+    # context (a "regions" psum in a helper also reachable from the
+    # two-tier mesh) is a latent trace error on the outer path, so
+    # only every-context axes count as bound; None = some context is
+    # statically unresolvable, so the axis-binding check stays silent
+    # (ISSUE 8 two-tier, ISSUE 13 three-tier)
     fn_axes: Dict[str, Optional[Set[str]]] = {}
     for root, axes in mesh_roots.items():
         for fkey in index.reachable([root]):
             if fkey in fn_axes:
                 prev = fn_axes[fkey]
-                fn_axes[fkey] = (prev | axes
+                fn_axes[fkey] = (prev & axes
                                  if prev is not None and axes is not None
                                  else None)
             else:

@@ -166,6 +166,10 @@ MESH_NODE_AXIS = "nodes"
 #: host-winner keys cross the DCN between hosts
 MESH_HOST_AXIS = "hosts"
 MESH_CHIP_AXIS = "chips"
+#: three-tier hierarchy axis (ISSUE 13): the node axis splits over
+#: ("regions", "hosts", "chips") — candidate keys merge per host over
+#: ICI and per region over DCN; only region-winner keys cross the WAN
+MESH_REGION_AXIS = "regions"
 
 
 def make_node_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -197,6 +201,25 @@ def env_mesh_hosts() -> Optional[int]:
     return h
 
 
+def env_mesh_regions() -> Optional[int]:
+    """NOMAD_TPU_MESH_REGIONS: region count for the three-tier mesh
+    (unset/empty/0 -> None: no WAN tier)."""
+    import os
+    raw = os.environ.get("NOMAD_TPU_MESH_REGIONS", "").strip()
+    if not raw or raw == "0":
+        return None
+    try:
+        r = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"NOMAD_TPU_MESH_REGIONS={raw!r} invalid: use a positive "
+            "region count (0/unset = no WAN tier)") from None
+    if r <= 0:
+        raise ValueError(
+            f"NOMAD_TPU_MESH_REGIONS={r} invalid: must be positive")
+    return r
+
+
 def make_two_tier_mesh(n_hosts: Optional[int] = None,
                        n_devices: Optional[int] = None) -> Mesh:
     """A ("hosts", "chips") mesh: the device list factored into
@@ -218,11 +241,38 @@ def make_two_tier_mesh(n_hosts: Optional[int] = None,
     return Mesh(grid, (MESH_HOST_AXIS, MESH_CHIP_AXIS))
 
 
+def make_three_tier_mesh(n_regions: Optional[int] = None,
+                         n_hosts: Optional[int] = None,
+                         n_devices: Optional[int] = None) -> Mesh:
+    """A ("regions", "hosts", "chips") mesh (ISSUE 13): the device
+    list factored into n_regions contiguous region groups of n_hosts
+    hosts each (n_hosts is hosts PER REGION).  Defaults come from
+    NOMAD_TPU_MESH_REGIONS / NOMAD_TPU_MESH_HOSTS."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n_regions is None:
+        n_regions = env_mesh_regions() or 1
+    if n_hosts is None:
+        n_hosts = env_mesh_hosts() or 1
+    if (n_regions <= 0 or n_hosts <= 0 or n % n_regions
+            or (n // n_regions) % n_hosts):
+        raise ValueError(
+            f"{n} devices do not factor into {n_regions} regions x "
+            f"{n_hosts} hosts x chips; pick counts whose product "
+            "divides the device count")
+    grid = np.array(devices).reshape(
+        n_regions, n_hosts, n // (n_regions * n_hosts))
+    return Mesh(grid, (MESH_REGION_AXIS, MESH_HOST_AXIS,
+                       MESH_CHIP_AXIS))
+
+
 def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
                          dev_cap, used0, dev_used0, stacked, n_places,
                          seeds, ev_res, ev_prio, node_gid, owner_map,
                          slot_map, *, n_shards, mesh_axes, mesh_hosts,
-                         mesh_nt, tile_np,
+                         mesh_regions, mesh_nt, tile_np,
                          has_spread, group_count_hint, max_waves,
                          wave_mode, has_distinct, has_devices,
                          stack_commit, compact, pallas_mode,
@@ -247,6 +297,7 @@ def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
                          mesh_axis=mesh_axes, mesh_shards=n_shards,
                          has_preempt=has_preempt, ev_res=ev_res,
                          ev_prio=ev_prio, mesh_hosts=mesh_hosts,
+                         mesh_regions=mesh_regions,
                          mesh_nt=mesh_nt, tile_np=tile_np,
                          node_gid=node_gid, owner_map=owner_map,
                          slot_map=slot_map)
@@ -271,18 +322,31 @@ def _sharded_stream_body(avail, reserved, valid, node_dc, attr_rank,
 
 def mesh_node_axes(mesh: Mesh):
     """The node-axis split of a solver mesh: the flat "nodes" axis
-    (PR 5) or the two-tier ("hosts", "chips") hierarchy (ISSUE 8).
+    (PR 5), the two-tier ("hosts", "chips") hierarchy (ISSUE 8), or
+    the three-tier ("regions", "hosts", "chips") hierarchy (ISSUE 13).
     Returns (axes, n_hosts) where axes is the solve_kernel mesh_axis
-    value AND the PartitionSpec element splitting the node dim."""
+    value AND the PartitionSpec element splitting the node dim;
+    n_hosts is hosts PER REGION in the three-tier case (use
+    mesh_region_count for the region fan-out)."""
     names = mesh.axis_names
     if MESH_HOST_AXIS in names and MESH_CHIP_AXIS in names:
+        if MESH_REGION_AXIS in names:
+            return ((MESH_REGION_AXIS, MESH_HOST_AXIS,
+                     MESH_CHIP_AXIS), int(mesh.shape[MESH_HOST_AXIS]))
         return ((MESH_HOST_AXIS, MESH_CHIP_AXIS),
                 int(mesh.shape[MESH_HOST_AXIS]))
     if MESH_NODE_AXIS in names:
         return MESH_NODE_AXIS, 1
     raise ValueError(
         f"mesh must carry a '{MESH_NODE_AXIS}' axis or the "
-        f"('{MESH_HOST_AXIS}', '{MESH_CHIP_AXIS}') pair, got {names}")
+        f"('{MESH_HOST_AXIS}', '{MESH_CHIP_AXIS}') pair "
+        f"(optionally under '{MESH_REGION_AXIS}'), got {names}")
+
+
+def mesh_region_count(mesh: Mesh) -> int:
+    """Region fan-out of a solver mesh (1 when no WAN tier)."""
+    return (int(mesh.shape[MESH_REGION_AXIS])
+            if MESH_REGION_AXIS in mesh.axis_names else 1)
 
 
 def _build_sharded_stream_kernel(mesh: Mesh):
@@ -292,6 +356,7 @@ def _build_sharded_stream_kernel(mesh: Mesh):
     or the two-tier ("hosts", "chips") pair — the kernel's merge and
     psum tiering follows the axis structure."""
     axis, n_hosts = mesh_node_axes(mesh)
+    n_regions = mesh_region_count(mesh)
     n_shards = int(np.prod([mesh.shape[a] for a in
                             (axis if isinstance(axis, tuple)
                              else (axis,))]))
@@ -322,7 +387,8 @@ def _build_sharded_stream_kernel(mesh: Mesh):
         gid1 = P(axis) if tile_np else P()
         body = functools.partial(
             _sharded_stream_body, n_shards=n_shards,
-            mesh_axes=axis, mesh_hosts=n_hosts, mesh_nt=mesh_nt,
+            mesh_axes=axis, mesh_hosts=n_hosts,
+            mesh_regions=n_regions, mesh_nt=mesh_nt,
             tile_np=tile_np,
             has_spread=has_spread, group_count_hint=group_count_hint,
             max_waves=max_waves, wave_mode=wave_mode,
@@ -452,6 +518,90 @@ def model_ici_dcn_bytes(Gp: int, K: int, A: int, R: int, TK: int,
     }
 
 
+def model_ici_dcn_wan_bytes(Gp: int, K: int, A: int, R: int, TK: int,
+                            TKl: int, n_shards: int, n_regions: int,
+                            n_hosts: int, want_tables: bool, V: int,
+                            TKv: int, TW: int,
+                            has_spread: bool) -> Dict:
+    """Three-tier per-wave interconnect byte model (ISSUE 13): the WAN
+    generalization of model_ici_dcn_bytes.  `n_hosts` is hosts PER
+    REGION; shards split n_regions x n_hosts x chips.
+
+    Same import-volume convention as the DCN model.  Within a region
+    the two-tier ICI/DCN exchange runs unchanged (restated here per
+    region); across regions only region-winner candidate-key windows
+    travel — one region window per WAN traversal, in log2(Rg)
+    recursive-doubling rounds (pow2 region counts; one sliced
+    all-gather otherwise) — and ONE commit vector crosses the WAN per
+    region per psum (reduce-scatter over the chip x host slice, WAN
+    psum, in-region reassembly), not one per host or chip.
+
+    `wan_cut_vs_flat` is the acceptance figure: modeled WAN bytes/wave
+    of the tiered exchange over the flat single-tier exchange's
+    cross-REGION bytes."""
+    key_bytes = 8
+    Rg = max(n_regions, 1)
+    SPR = n_shards // Rg                # shards per region
+    base = model_ici_dcn_bytes(Gp, K, A, R, TK, TKl, SPR, n_hosts,
+                               want_tables, V, TKv, TW, has_spread)
+    H = max(n_hosts, 1)
+    CPH = SPR // H
+    tk_local = base["tk_local"]
+    ck = Gp * tk_local * key_bytes
+    # region-merged window chunk after the ICI + DCN tiers
+    tk_region = (min(TK, TKl * SPR)
+                 + ((V + 1) * min(TKv, TW * SPR) if want_tables
+                    else 0))
+    cr = Gp * tk_region * key_bytes
+    cc = (2 * K * 4
+          + (K * A * 4 if has_spread else 0)
+          + (3 * Gp + Gp * R) * 4)
+    # ---- flat single-tier exchange, charged per-chip import ----
+    # every chip imports every chunk outside its own region
+    flat_wan_window = n_shards * (n_shards - SPR) * ck
+    flat_wan_commit = (2 * n_shards * (n_shards - SPR) * cc
+                       // max(n_shards, 1))
+    # ---- tiered exchange ----
+    if Rg > 1 and Rg & (Rg - 1) == 0:
+        rounds = Rg.bit_length() - 1
+        wan_window = Rg * rounds * cr
+    elif Rg > 1:
+        rounds = 1
+        wan_window = Rg * (Rg - 1) * cr
+    else:
+        rounds = 0
+        wan_window = 0
+    # the WAN rounds' chip-sliced reassembly gathers ride the
+    # in-region links: (SPR-1)/SPR of each round's region window
+    # re-gathers over ICI+DCN inside every region
+    intra_reassembly = (Rg * SPR * rounds * cr * (SPR - 1)
+                        // max(SPR, 1))
+    wan_commit = (2 * (Rg - 1) * cc) if Rg > 1 else 0
+    wan_total = wan_window + wan_commit
+    flat_wan_total = flat_wan_window + flat_wan_commit
+    out = {
+        "key_bytes": key_bytes, "n_regions": int(Rg),
+        "shards_per_region": int(SPR), "n_hosts": int(H),
+        "chips_per_host": int(CPH),
+        "tk_local": int(tk_local), "tk_host": base["tk_host"],
+        "tk_region": int(tk_region),
+        # per-region two-tier exchange restated fleet-wide, plus the
+        # WAN reassembly riding the in-region links
+        "bytes_ici_per_wave": int(
+            Rg * base["bytes_ici_per_wave"] + intra_reassembly),
+        "bytes_dcn_total_per_wave": int(
+            Rg * base["bytes_dcn_total_per_wave"]),
+        "bytes_wan_window_per_wave": int(wan_window),
+        "bytes_wan_commit_per_wave": int(wan_commit),
+        "bytes_wan_total_per_wave": int(wan_total),
+        "flat_wan_window_per_wave": int(flat_wan_window),
+        "flat_wan_total_per_wave": int(flat_wan_total),
+        "wan_cut_vs_flat": (float(wan_total) / float(flat_wan_total)
+                            if flat_wan_total else 0.0),
+    }
+    return out
+
+
 class ShardedResidentSolver(ResidentSolver):
     """ResidentSolver whose node planes live SHARDED across a TPU mesh.
 
@@ -481,12 +631,18 @@ class ShardedResidentSolver(ResidentSolver):
                  mesh: Optional[Mesh] = None,
                  n_devices: Optional[int] = None, **kw):
         if mesh is None:
-            # NOMAD_TPU_MESH_HOSTS > 1 defaults new solvers onto the
-            # two-tier hierarchy; unset keeps the flat PR-5 mesh
+            # NOMAD_TPU_MESH_REGIONS > 1 defaults new solvers onto the
+            # three-tier hierarchy, NOMAD_TPU_MESH_HOSTS > 1 onto the
+            # two-tier one; unset keeps the flat PR-5 mesh
+            regions = env_mesh_regions()
             hosts = env_mesh_hosts()
-            mesh = (make_two_tier_mesh(hosts, n_devices)
-                    if hosts and hosts > 1 else make_node_mesh(
-                        n_devices))
+            if regions and regions > 1:
+                mesh = make_three_tier_mesh(regions, hosts or 1,
+                                            n_devices)
+            elif hosts and hosts > 1:
+                mesh = make_two_tier_mesh(hosts, n_devices)
+            else:
+                mesh = make_node_mesh(n_devices)
         self._set_mesh(mesh)
         super().__init__(nodes, probe_asks, *args, **kw)
         Np = self.template.avail.shape[0]
@@ -507,12 +663,15 @@ class ShardedResidentSolver(ResidentSolver):
         self._mesh = mesh
         axes, n_hosts = mesh_node_axes(mesh)
         self._axis = axes            # P element splitting the node dim
-        self.n_hosts = n_hosts
+        self.n_hosts = n_hosts       # hosts PER REGION (three-tier)
+        self.n_regions = mesh_region_count(mesh)
         self.n_shards = int(np.prod(
             [mesh.shape[a] for a in (axes if isinstance(axes, tuple)
                                      else (axes,))]))
-        self.chips_per_host = self.n_shards // max(n_hosts, 1)
+        self.shards_per_region = self.n_shards // max(self.n_regions, 1)
+        self.chips_per_host = self.shards_per_region // max(n_hosts, 1)
         self.two_tier = isinstance(axes, tuple)
+        self.three_tier = self.two_tier and len(axes) == 3
         self._kern = _build_sharded_stream_kernel(mesh)
         self._scatter_kerns: Dict = {}
 
@@ -552,10 +711,15 @@ class ShardedResidentSolver(ResidentSolver):
             spec = P(self._axis, *([None] * (np.ndim(arr) - 1)))
             axes = self._axis
             cph = self.chips_per_host
+            spr = self.shards_per_region
 
             def body(a_l, idx_, rows_, _op=op):
                 Npl = a_l.shape[0]
-                if isinstance(axes, tuple):
+                if isinstance(axes, tuple) and len(axes) == 3:
+                    lin = (jax.lax.axis_index(axes[0]) * spr
+                           + jax.lax.axis_index(axes[1]) * cph
+                           + jax.lax.axis_index(axes[2]))
+                elif isinstance(axes, tuple):
                     lin = (jax.lax.axis_index(axes[0]) * cph
                            + jax.lax.axis_index(axes[1]))
                 else:
@@ -667,15 +831,26 @@ class ShardedResidentSolver(ResidentSolver):
         out["ici"] = model_ici_bytes(Gp, K, A, R, TKl, self.n_shards,
                                      want_tables, V, TW, has_spread)
         out["bytes_ici_per_wave"] = out["ici"]["bytes_ici_per_wave"]
+        n_reg = getattr(self, "n_regions", 1)
         if self.two_tier or self._elastic:
             # ISSUE 8: the DCN tier next to ICI — and the flat
-            # exchange's cross-host exposure it is measured against
+            # exchange's cross-host exposure it is measured against.
+            # Per REGION on a three-tier mesh (the WAN block below
+            # restates the fleet-wide totals).
             out["dcn"] = model_ici_dcn_bytes(
-                Gp, K, A, R, TK, TKl, self.n_shards,
+                Gp, K, A, R, TK, TKl, self.n_shards // max(n_reg, 1),
                 self.n_hosts if self.two_tier else 1,
                 want_tables, V, TKv, TW, has_spread)
             out["bytes_dcn_per_wave"] = \
                 out["dcn"]["bytes_dcn_total_per_wave"]
+        if getattr(self, "three_tier", False) and n_reg > 1:
+            # ISSUE 13: the WAN tier — and the flat exchange's
+            # cross-region exposure it is measured against
+            out["wan"] = model_ici_dcn_wan_bytes(
+                Gp, K, A, R, TK, TKl, self.n_shards, n_reg,
+                self.n_hosts, want_tables, V, TKv, TW, has_spread)
+            out["bytes_wan_per_wave"] = \
+                out["wan"]["bytes_wan_total_per_wave"]
         b1, brw, passes = model_wave_bytes(
             Npl, Gp, K, S, R, has_spread, mode, TKl, C)
         out["per_shard"] = {"np_local": int(Npl),
@@ -700,6 +875,13 @@ class ShardedResidentSolver(ResidentSolver):
                     * m["waves_total"])
                 m["modeled_bytes_dcn_flat_total"] = int(
                     out["dcn"]["flat_dcn_total_per_wave"]
+                    * m["waves_total"])
+            if "wan" in out:
+                m["modeled_bytes_wan_total"] = int(
+                    out["wan"]["bytes_wan_total_per_wave"]
+                    * m["waves_total"])
+                m["modeled_bytes_wan_flat_total"] = int(
+                    out["wan"]["flat_wan_total_per_wave"]
                     * m["waves_total"])
         return out
 

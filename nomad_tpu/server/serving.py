@@ -394,3 +394,269 @@ class ServingTier:
             "model_observations": self.solve_model.observations(),
             "admission": self.admission.stats(),
         }
+
+
+# ===================================================================
+# Cross-region admission spillover (ISSUE 13)
+# ===================================================================
+
+#: spillover SLO margin: a region "meets SLO" when its predicted
+#: backlog-clear time fits inside slo_budget_s * margin
+DEFAULT_SPILL_MARGIN = 0.8
+#: relative cost of placing one eval in a region (WAN egress, energy,
+#: $/chip-hour); the router prefers cheaper regions at equal health
+DEFAULT_REGION_COST = 1.0
+
+
+class RegionServingState:
+    """One region's serving-tier view for the spillover router: its
+    own EWMA solve model (regions differ in mesh width and load) and
+    admission controller, plus the last reported ready-queue depth."""
+
+    def __init__(self, name: str, cost: float = DEFAULT_REGION_COST,
+                 model: Optional[EwmaSolveModel] = None,
+                 admission: Optional[AdmissionController] = None):
+        self.name = str(name)
+        self.cost = float(cost)
+        self.model = model if model is not None else EwmaSolveModel()
+        self.admission = (admission if admission is not None
+                          else AdmissionController())
+        self._lock = threading.Lock()
+        self._ready = 0
+        self.live = True
+
+    def note_ready(self, n: int) -> None:
+        with self._lock:
+            self._ready = max(int(n), 0)
+
+    def ready(self) -> int:
+        with self._lock:
+            return self._ready
+
+    def browned_out(self) -> bool:
+        """Brownout watermark view: the controller's latched state OR
+        the instantaneous high watermark (the router must not keep
+        feeding a region in the `brownout_after_s` grace window)."""
+        a = self.admission
+        return (a.brownout_active()
+                or self.ready() >= a.brownout_high * a.max_pending)
+
+    def meets_slo(self, n_evals: int, budget_s: float) -> bool:
+        return self.model.predict(self.ready() + max(n_evals, 1)) \
+            <= budget_s
+
+
+class SpilloverRouter:
+    """Admission-tier cross-region spillover (ISSUE 13).
+
+    Stock Nomad's region forwarding (nomad/rpc.go `forward`) ships an
+    RPC to the job's HOME region and stops there — a browned-out home
+    region just queues deeper.  This router places NEW work across the
+    federation: the home region keeps the job while it is healthy and
+    meets SLO (per-region EWMA solve model over the reported backlog),
+    overflow goes to the cheapest sibling region meeting SLO when the
+    home brownout watermark trips, and only when EVERY live region is
+    browned out does the eval land in the router's shed lane — parked,
+    never dropped, readmitted by `drain_shed` once any region drains.
+
+    Region membership is gossip-driven: plug `on_join` / `on_fail`
+    into the serf WAN pool (membership.gossip.GossipAgent); they feed
+    the optional RegionDirectory (the federation membership table) and
+    flip region liveness here.  Knobs follow the ServingTier pattern:
+    overrides > NOMAD_TPU_* env > defaults."""
+
+    #: knob -> (env var, type, default)
+    KNOBS = {
+        "slo_budget_s": ("NOMAD_TPU_SLO_BUDGET_S", float,
+                         DEFAULT_SLO_BUDGET_S),
+        "spill_margin": ("NOMAD_TPU_SPILL_MARGIN", float,
+                         DEFAULT_SPILL_MARGIN),
+        "region_cost": ("NOMAD_TPU_REGION_COST", float,
+                        DEFAULT_REGION_COST),
+        "max_pending": ("NOMAD_TPU_MAX_PENDING", int,
+                        DEFAULT_MAX_PENDING),
+    }
+
+    def __init__(self, regions: Optional[Dict[str, float]] = None,
+                 overrides: Optional[dict] = None,
+                 directory=None, event_log=None):
+        o = overrides or {}
+        k = {}
+        for name, (env, typ, default) in self.KNOBS.items():
+            if name in o:
+                k[name] = typ(o[name])
+            elif env in os.environ:
+                k[name] = (_env_int(env, default) if typ is int
+                           else _env_float(env, default))
+            else:
+                k[name] = default
+        self.slo_budget_s = k["slo_budget_s"]
+        self.spill_margin = k["spill_margin"]
+        self.default_cost = k["region_cost"]
+        self.max_pending = k["max_pending"]
+        self.directory = directory
+        if event_log is None:
+            from ..utils.tracing import global_mesh_events
+            event_log = global_mesh_events
+        self.event_log = event_log
+        self._lock = threading.Lock()
+        self._regions: Dict[str, RegionServingState] = {}
+        self._shed_lane: List = []
+        self._counts = {"home": 0, "cheapest": 0, "spillover": 0,
+                        "slo_miss": 0, "shed": 0, "readmitted": 0}
+        for name, cost in (regions or {}).items():
+            self.add_region(name, cost)
+
+    # ------------------------------------------------------ membership
+    def add_region(self, name: str,
+                   cost: Optional[float] = None) -> RegionServingState:
+        with self._lock:
+            rs = self._regions.get(name)
+            if rs is None:
+                rs = RegionServingState(
+                    name, self.default_cost if cost is None else cost,
+                    admission=AdmissionController(
+                        max_pending=self.max_pending))
+                self._regions[name] = rs
+            elif cost is not None:
+                rs.cost = float(cost)
+            rs.live = True
+            return rs
+
+    def region(self, name: str) -> RegionServingState:
+        with self._lock:
+            return self._regions[name]
+
+    def regions(self) -> List[str]:
+        with self._lock:
+            return sorted(r for r, rs in self._regions.items()
+                          if rs.live)
+
+    def on_join(self, member) -> None:
+        """Serf WAN-gossip join: a member of region X comes up — the
+        region (re)enters the routing table."""
+        region = getattr(member, "region", None) or "global"
+        if self.directory is not None:
+            self.directory.on_join(member)
+        self.add_region(str(region))
+
+    def on_fail(self, member) -> None:
+        """Serf WAN-gossip fail: when a region's LAST member dies the
+        region leaves the routing table (individual member loss keeps
+        it live — the mesh supervisor handles shard recovery)."""
+        region = str(getattr(member, "region", None) or "global")
+        if self.directory is not None:
+            self.directory.on_fail(member)
+            gone = region not in self.directory.regions()
+        else:
+            gone = True                # no membership view: fail fast
+        if gone:
+            with self._lock:
+                rs = self._regions.get(region)
+                if rs is not None:
+                    rs.live = False
+
+    # --------------------------------------------------------- routing
+    def route(self, ev, home: Optional[str] = None,
+              n_evals: int = 1) -> Tuple[Optional[str], str]:
+        """Pick the region for one arriving eval.  Returns
+        (region_name, cause); cause is "home" (healthy home region),
+        "cheapest" (no home given), "spillover" (home browned out or
+        past SLO — cheapest sibling meeting SLO), "slo_miss" (no
+        region meets SLO but one is un-browned: admit late rather
+        than park), or "shed" with region None (every live region
+        browned out: the eval is in the shed lane — never dropped)."""
+        budget = self.slo_budget_s * self.spill_margin
+        with self._lock:
+            live = sorted((rs for rs in self._regions.values()
+                           if rs.live),
+                          key=lambda rs: (rs.cost, rs.name))
+        if not live:
+            with self._lock:
+                self._shed_lane.append(ev)
+                self._counts["shed"] += 1
+            return None, "shed"
+        home_rs = next((rs for rs in live if rs.name == home), None)
+        if home_rs is not None and not home_rs.browned_out() \
+                and home_rs.meets_slo(n_evals, budget):
+            return self._picked(home_rs, "home")
+        fits = [rs for rs in live if not rs.browned_out()
+                and rs.meets_slo(n_evals, budget)]
+        if fits:
+            cause = "cheapest" if home_rs is None else "spillover"
+            return self._picked(fits[0], cause)
+        unbrowned = [rs for rs in live if not rs.browned_out()]
+        if unbrowned:
+            # admit late at the least-loaded un-browned region: an
+            # SLO miss beats parking the eval behind a drain
+            pick = min(unbrowned,
+                       key=lambda rs: (rs.model.predict(
+                           rs.ready() + max(n_evals, 1)), rs.cost,
+                           rs.name))
+            return self._picked(pick, "slo_miss")
+        with self._lock:
+            self._shed_lane.append(ev)
+            self._counts["shed"] += 1
+        self.event_log.record("region.shed",
+                              home=home or "", depth=len(
+                                  self._shed_lane))
+        return None, "shed"
+
+    def _picked(self, rs: RegionServingState,
+                cause: str) -> Tuple[str, str]:
+        with self._lock:
+            self._counts[cause] = self._counts.get(cause, 0) + 1
+        if cause == "spillover":
+            self.event_log.record("region.spill", region=rs.name)
+        return rs.name, cause
+
+    # ----------------------------------------------------------- drain
+    def drain_shed(self, max_n: int = DEFAULT_MAX_BATCH
+                   ) -> List[Tuple[object, str]]:
+        """Readmit parked evals once any region has drained: returns
+        up to max_n (eval, region) pairs routed to un-browned regions
+        meeting SLO (the shed lane keeps the rest — still never
+        dropped)."""
+        out: List[Tuple[object, str]] = []
+        budget = self.slo_budget_s * self.spill_margin
+        while len(out) < max_n:
+            with self._lock:
+                if not self._shed_lane:
+                    break
+                live = sorted(
+                    (rs for rs in self._regions.values()
+                     if rs.live and not rs.browned_out()),
+                    key=lambda rs: (rs.cost, rs.name))
+                fits = [rs for rs in live
+                        if rs.meets_slo(1, budget)] or live
+                if not fits:
+                    break
+                ev = self._shed_lane.pop(0)
+                self._counts["readmitted"] += 1
+            out.append((ev, fits[0].name))
+        return out
+
+    def shed_depth(self) -> int:
+        with self._lock:
+            return len(self._shed_lane)
+
+    def note_solve(self, region: str, n_evals: int,
+                   wall_s: float) -> None:
+        """Feed one region's observed solve into its EWMA model."""
+        self._regions[region].model.observe(n_evals, wall_s)
+
+    def stats(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+            shed_depth = len(self._shed_lane)
+            regions = {
+                name: {"cost": rs.cost, "live": rs.live,
+                       "ready": rs.ready(),
+                       "browned_out": rs.browned_out(),
+                       "model_observations":
+                           rs.model.observations()}
+                for name, rs in self._regions.items()}
+        return {"slo_budget_s": self.slo_budget_s,
+                "spill_margin": self.spill_margin,
+                "routed": counts, "shed_lane_depth": shed_depth,
+                "regions": regions}

@@ -167,7 +167,8 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                       stack_commit=False,
                       static_cache=None, has_preempt=False,
                       ev_res=None, ev_prio=None,
-                      ask_prio=None, learned=None) -> SolveResult:
+                      ask_prio=None, learned=None,
+                      region_bias=None) -> SolveResult:
     """Numpy port of kernel.solve_kernel — see that docstring for the
     wave semantics.  Every formula, window size, and tie-break matches;
     tests/test_host_solver.py asserts bitwise-equal placements.
@@ -176,8 +177,9 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     calls score_spec.evaluate_wave — the float ops live in ONE place
     (solver/score_spec.py) shared with the jit kernel.  `learned` is
     the optional precomputed [Gp, Np] learned-head plane (score_spec's
-    reserved slot); None leaves the scorer byte-identical to a
-    learned-free spec."""
+    reserved slot) and `region_bias` the cross-region placement
+    affinity plane (ISSUE 13); None leaves the scorer byte-identical
+    to a spec without the term."""
     f32 = np.float32
     avail = np.asarray(avail, f32)
     reserved = np.asarray(reserved, f32)
@@ -234,7 +236,8 @@ def host_solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             has_devices=True, has_spread=has_spread, sp_col=sp_col,
             sp_weight=sp_weight, sp_targeted=sp_targeted,
             vnode=sp_vnode, des=sp_des, S=S, V=V, shape=(Gp, Np),
-            seed=seed, jitter=jitter, learned=learned)
+            seed=seed, jitter=jitter, learned=learned,
+            region_bias=region_bias)
         return _score_spec.evaluate_wave(_NP_OPS, ctx)
 
     # ---------- in-kernel preemption planes (kernel.py twin) ----------

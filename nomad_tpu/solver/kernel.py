@@ -264,7 +264,8 @@ def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
                                     "stack_commit", "pallas_mode",
                                     "shortlist_c", "mesh_axis",
                                     "mesh_shards", "has_preempt",
-                                    "mesh_hosts", "mesh_nt", "tile_np"))
+                                    "mesh_hosts", "mesh_nt", "tile_np",
+                                    "mesh_regions"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -280,7 +281,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  has_preempt=False, ev_res=None, ev_prio=None,
                  ask_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
                  node_gid=None, owner_map=None, slot_map=None,
-                 learned=None) -> SolveResult:
+                 learned=None, mesh_regions=0,
+                 region_bias=None) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -309,26 +311,57 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # tier the same way (ICI reduce, then host-level reduce).  Both
     # tiers merge in the exact (score desc, global id asc) lex order of
     # the single-device tournament, so placements stay bit-identical.
+    # ISSUE 13 adds a THIRD tier: ("regions", "hosts", "chips").  Each
+    # region runs the two-tier exchange above locally (the named-axis
+    # collectives over host/chip axes stay within the fixed region
+    # coordinate), and only the region-merged top-K window — sliced
+    # across the region's shards — crosses the (WAN-modeled) region
+    # axis per wave.  mesh_hosts then counts hosts PER REGION.  The
+    # final lex merge of the union is tier-structure-independent and
+    # the commit psums are integer, so placements and every counter
+    # stay bit-identical to the flat and two-tier meshes.
     in_mesh = mesh_axis is not None
     two_tier = in_mesh and isinstance(mesh_axis, tuple)
+    three_tier = two_tier and len(mesh_axis) == 3
     if in_mesh:
         assert mesh_shards >= 1, \
             "mesh_axis requires the static mesh_shards axis size"
-        if two_tier:
+        if three_tier:
+            assert mesh_regions >= 1 \
+                and mesh_shards % mesh_regions == 0, (
+                    "three-tier mesh_axis needs (region_axis, "
+                    "host_axis, chip_axis) and mesh_regions dividing "
+                    f"mesh_shards; got {mesh_axis!r} "
+                    f"regions={mesh_regions} shards={mesh_shards}")
+            region_ax, host_ax, chip_ax = mesh_axis
+            SPR = mesh_shards // mesh_regions
+            assert mesh_hosts >= 1 and SPR % mesh_hosts == 0, (
+                "mesh_hosts (hosts PER REGION) must divide the "
+                f"per-region shard count; got hosts={mesh_hosts} "
+                f"shards_per_region={SPR}")
+            CPH = SPR // mesh_hosts
+            my_lin = (lax.axis_index(region_ax).astype(jnp.int32)
+                      * jnp.int32(SPR)
+                      + lax.axis_index(host_ax).astype(jnp.int32)
+                      * jnp.int32(CPH)
+                      + lax.axis_index(chip_ax).astype(jnp.int32))
+        elif two_tier:
             assert len(mesh_axis) == 2 and mesh_hosts >= 1 \
                 and mesh_shards % mesh_hosts == 0, (
                     "two-tier mesh_axis needs (host_axis, chip_axis) "
                     "and mesh_hosts dividing mesh_shards; got "
                     f"{mesh_axis!r} hosts={mesh_hosts} "
                     f"shards={mesh_shards}")
+            region_ax = None
             host_ax, chip_ax = mesh_axis
+            SPR = mesh_shards
             CPH = mesh_shards // mesh_hosts
             my_lin = (lax.axis_index(host_ax).astype(jnp.int32)
                       * jnp.int32(CPH)
                       + lax.axis_index(chip_ax).astype(jnp.int32))
         else:
-            host_ax = chip_ax = None
-            CPH = mesh_shards
+            region_ax = host_ax = chip_ax = None
+            SPR = CPH = mesh_shards
             my_lin = lax.axis_index(mesh_axis).astype(jnp.int32)
     # elastic tile layout (ISSUE 8): tile_np > 0 means the node axis is
     # owned in TILES of tile_np slots routed by an owner remap table
@@ -383,63 +416,69 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 loc = jnp.where(own, loc_, Np)
                 return own, loc, jnp.clip(loc, 0, Np - 1)
 
+    def _sliced_psum(x, n_slices, my_slice, over_ax, inner_axes):
+        """Reduce x over `over_ax` shipping only a 1/n_slices chunk
+        per shard: x is replicated across the `inner_axes` group (whose
+        linear index is `my_slice`), so the reduce-scatter degrades to
+        a slice (dynamic_slice keeps it collective-free on the inner
+        tiers); the reduced chunks reassemble by tiled all-gathers,
+        innermost axis first (matching the slice index order)."""
+        shp = x.shape
+        n = 1
+        for d in shp:
+            n *= d
+        np_ = -(-n // n_slices) * n_slices
+        flat = jnp.ravel(x)
+        if np_ != n:
+            flat = jnp.pad(flat, (0, np_ - n))
+        wl = np_ // n_slices
+        sl = lax.dynamic_slice_in_dim(flat, my_slice * wl, wl, axis=0)
+        sl = lax.psum(sl, over_ax)
+        for ax in inner_axes:
+            sl = lax.all_gather(sl, ax, axis=0, tiled=True)
+        return sl[:n].reshape(shp)
+
     def _psum_mesh(x):
         """Tiered reduction: ICI (chips) first, then a CHIP-SLICED
         host tier — each chip ships only its 1/CPH slice of the
         host-reduced vector across DCN (reduce-scatter over ICI, host
         psum on the slice, reassembled over ICI), so a commit vector
-        crosses DCN once per host, not once per chip.  Integer
-        operands everywhere, so the tiering is order-exact."""
+        crosses DCN once per host, not once per chip — then (three
+        tiers) a region tier sliced the same way across ALL of the
+        region's shards, so one commit vector crosses the WAN per
+        region, not once per host.  Integer operands everywhere, so
+        the tiering is order-exact."""
         if not two_tier:
             return lax.psum(x, mesh_axis)
         x = lax.psum(x, chip_ax)
-        if mesh_hosts == 1:
+        if mesh_hosts > 1:
+            if CPH == 1:
+                x = lax.psum(x, host_ax)
+            else:
+                x = _sliced_psum(x, CPH, lax.axis_index(chip_ax),
+                                 host_ax, (chip_ax,))
+        if not three_tier or mesh_regions == 1:
             return x
-        if CPH == 1:
-            return lax.psum(x, host_ax)
-        shp = x.shape
-        n = 1
-        for d in shp:
-            n *= d
-        np_ = -(-n // CPH) * CPH
-        flat = jnp.ravel(x)
-        if np_ != n:
-            flat = jnp.pad(flat, (0, np_ - n))
-        # x is already chip-replicated, so the reduce-scatter degrades
-        # to a slice: dynamic_slice keeps it collective-free on ICI
-        wl = np_ // CPH
-        sl = lax.dynamic_slice_in_dim(
-            flat, lax.axis_index(chip_ax) * wl, wl, axis=0)
-        sl = lax.psum(sl, host_ax)
-        flat = lax.all_gather(sl, chip_ax, axis=0, tiled=True)
-        return flat[:n].reshape(shp)
+        if SPR == 1:
+            return lax.psum(x, region_ax)
+        wli = (lax.axis_index(host_ax) * jnp.int32(CPH)
+               + lax.axis_index(chip_ax))
+        return _sliced_psum(x, SPR, wli, region_ax,
+                            (chip_ax, host_ax))
 
-    def _merge_mesh(s, i, k):
-        """Hierarchical candidate-key merge: returns the top-k of the
-        union of every shard's (score, global id) keys in the exact
-        (score desc, id asc) lex order, replicated on all shards.
-
-        Flat mesh: one all-gather + merge (the PR-5 exchange).  Two
-        tiers: all-gather + merge within the host over ICI; then a
-        chip-SLICED exchange over DCN — each chip ships 1/CPH of its
-        host's window to the partner host and the slices reassemble
-        over ICI, so one host window crosses DCN once per transfer,
-        not once per chip.  Power-of-two host counts run a
-        recursive-doubling tournament (every host ships log2(H)
-        windows); other counts fall back to one sliced all-gather."""
+    def _tier_merge(s, i, k, over_ax, n_peers, n_slices, my_slice,
+                    inner_axes):
+        """One hierarchy level of the candidate-key exchange: merge
+        the n_peers windows along `over_ax` into the top-k of their
+        union, each transfer SLICED 1/n_slices across the inner-tier
+        group (linear index `my_slice`) so one window crosses the
+        slow tier once, not once per inner shard.  Power-of-two peer
+        counts run a recursive-doubling tournament (every peer ships
+        log2(n) windows); other counts fall back to one sliced
+        all-gather + single merge (order-free — the lex sort restores
+        the tournament order)."""
         ax_last = s.ndim - 1
-        if not two_tier:
-            gs_ = lax.all_gather(s, mesh_axis, axis=ax_last, tiled=True)
-            gi_ = lax.all_gather(i, mesh_axis, axis=ax_last, tiled=True)
-            return _lex_topk(gs_, gi_, k)
-        if CPH > 1:                      # ICI tier: merge the host
-            gs_ = lax.all_gather(s, chip_ax, axis=ax_last, tiled=True)
-            gi_ = lax.all_gather(i, chip_ax, axis=ax_last, tiled=True)
-            s, i = _lex_topk(gs_, gi_, min(k, gs_.shape[ax_last]))
-        H = mesh_hosts
-        if H == 1:
-            return _lex_topk(s, i, k)
-        pad_c = lambda w: -(-w // CPH) * CPH     # noqa: E731
+        pad_c = lambda w: -(-w // n_slices) * n_slices   # noqa: E731
 
         def _padw(s, i, w):
             d = w - s.shape[ax_last]
@@ -451,41 +490,75 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                             constant_values=jnp.int32(2 ** 30)))
 
         def _slice(x):
-            wl = x.shape[ax_last] // CPH
-            ci = lax.axis_index(chip_ax)
-            return lax.dynamic_slice_in_dim(x, ci * wl, wl, axis=ax_last)
+            wl = x.shape[ax_last] // n_slices
+            return lax.dynamic_slice_in_dim(x, my_slice * wl, wl,
+                                            axis=ax_last)
+
+        def _reassemble(x):
+            for ax in inner_axes:
+                x = lax.all_gather(x, ax, axis=ax_last, tiled=True)
+            return x
 
         kp = pad_c(min(k, NT))
         s, i = _padw(s, i, pad_c(s.shape[ax_last]))
-        if H & (H - 1) == 0:
-            # DCN tournament: round r exchanges with the host at
-            # distance 2^r; widths grow toward kp so no candidate that
-            # could reach the global top-k is ever truncated
-            for r in range(H.bit_length() - 1):
+        if n_peers & (n_peers - 1) == 0:
+            # tournament: round r exchanges with the peer at distance
+            # 2^r; widths grow toward kp so no candidate that could
+            # reach the global top-k is ever truncated
+            for r in range(n_peers.bit_length() - 1):
                 d = 1 << r
-                perm = [(x, x ^ d) for x in range(H)]
-                ps = lax.ppermute(_slice(s), host_ax, perm)
-                pi = lax.ppermute(_slice(i), host_ax, perm)
-                fs = lax.all_gather(ps, chip_ax, axis=ax_last,
-                                    tiled=True)
-                fi = lax.all_gather(pi, chip_ax, axis=ax_last,
-                                    tiled=True)
+                perm = [(x, x ^ d) for x in range(n_peers)]
+                ps = lax.ppermute(_slice(s), over_ax, perm)
+                pi = lax.ppermute(_slice(i), over_ax, perm)
+                fs = _reassemble(ps)
+                fi = _reassemble(pi)
                 w = min(kp, 2 * s.shape[ax_last])
                 s, i = _lex_topk(jnp.concatenate([s, fs], axis=ax_last),
                                  jnp.concatenate([i, fi], axis=ax_last),
                                  w)
                 s, i = _padw(s, i, pad_c(w))
             return _lex_topk(s, i, k)
-        # non-pow2 host count: one sliced all-gather over DCN, slices
-        # reassembled over ICI, single merge (order-free — the lex sort
-        # below restores the tournament order)
-        gs_ = lax.all_gather(_slice(s), host_ax, axis=ax_last,
+        gs_ = lax.all_gather(_slice(s), over_ax, axis=ax_last,
                              tiled=True)
-        gi_ = lax.all_gather(_slice(i), host_ax, axis=ax_last,
+        gi_ = lax.all_gather(_slice(i), over_ax, axis=ax_last,
                              tiled=True)
-        fs = lax.all_gather(gs_, chip_ax, axis=ax_last, tiled=True)
-        fi = lax.all_gather(gi_, chip_ax, axis=ax_last, tiled=True)
-        return _lex_topk(fs, fi, k)
+        return _lex_topk(_reassemble(gs_), _reassemble(gi_), k)
+
+    def _merge_mesh(s, i, k):
+        """Hierarchical candidate-key merge: returns the top-k of the
+        union of every shard's (score, global id) keys in the exact
+        (score desc, id asc) lex order, replicated on all shards.
+
+        Flat mesh: one all-gather + merge (the PR-5 exchange).  Two
+        tiers: all-gather + merge within the host over ICI; then a
+        chip-SLICED exchange over DCN — each chip ships 1/CPH of its
+        host's window to the partner host and the slices reassemble
+        over ICI, so one host window crosses DCN once per transfer,
+        not once per chip.  Three tiers (ISSUE 13) repeat the same
+        move one level up: the region-merged window — sliced across
+        ALL of the region's shards — crosses the WAN once per region
+        per transfer, never once per host."""
+        ax_last = s.ndim - 1
+        if not two_tier:
+            gs_ = lax.all_gather(s, mesh_axis, axis=ax_last, tiled=True)
+            gi_ = lax.all_gather(i, mesh_axis, axis=ax_last, tiled=True)
+            return _lex_topk(gs_, gi_, k)
+        if CPH > 1:                      # ICI tier: merge the host
+            gs_ = lax.all_gather(s, chip_ax, axis=ax_last, tiled=True)
+            gi_ = lax.all_gather(i, chip_ax, axis=ax_last, tiled=True)
+            s, i = _lex_topk(gs_, gi_, min(k, gs_.shape[ax_last]))
+        if mesh_hosts > 1:               # DCN tier: merge the region
+            s, i = _tier_merge(s, i, k, host_ax, mesh_hosts, CPH,
+                               lax.axis_index(chip_ax), (chip_ax,))
+        if not three_tier or mesh_regions == 1:
+            return _lex_topk(s, i, k) if mesh_hosts == 1 else (s, i)
+        # WAN tier: merge the fleet — slices span the region's full
+        # (host, chip) shard grid, reassembled chips-then-hosts to
+        # match the within-region linear index
+        wli = (lax.axis_index(host_ax) * jnp.int32(CPH)
+               + lax.axis_index(chip_ax))
+        return _tier_merge(s, i, k, region_ax, mesh_regions, SPR,
+                           wli, (chip_ax, host_ax))
     # wider waves for bigger batches: a group may commit up to W
     # placements per wave, so a K-placement batch converges in O(K / W)
     # fused-wave iterations. Size W to ~2x the LARGEST per-group
@@ -516,11 +589,13 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # In mesh mode the shortlist is SHARD-LOCAL (resolved against the
     # local plane): triggers prove each shard's window contribution
     # exact, and escapes rescore only that shard's plane.
-    # the learned-head term flows through the spec-DRIVEN scorers only
-    # (host twin + this wave path); the hand-written shortlist twin and
-    # pallas tiles don't implement it, so both stay disabled while a
-    # learned plane is active (see score_spec.TERMS backends tuple)
-    C = (0 if (has_distinct or learned is not None)
+    # the learned-head and region-affinity terms flow through the
+    # spec-DRIVEN scorers only (host twin + this wave path); the
+    # hand-written shortlist twin and pallas tiles don't implement
+    # them, so both stay disabled while either plane is active (see
+    # score_spec.TERMS backends tuples)
+    C = (0 if (has_distinct or learned is not None
+               or region_bias is not None)
          else resolve_shortlist_c(Np, TKl, shortlist_c))
     use_sl = C > 0
     NE = C if use_sl else TKl       # full-wave extraction width
@@ -665,7 +740,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
     # HBM), "score" fuses the scoring chain into one pass and leaves
     # wide-window extraction to approx_max_k/top_k, "off" keeps the
     # unfused jnp path (the host twin's reference shape).
-    if learned is not None:
+    if learned is not None or region_bias is not None:
         pallas_mode = "off"
     if pallas_mode == "auto":
         from . import pallas_kernel as _pk
@@ -720,7 +795,8 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
             has_devices=has_devices, has_spread=has_spread,
             sp_col=sp_col, sp_weight=sp_weight, sp_targeted=sp_targeted,
             vnode=sp_vnode, des=sp_des, S=S, V=V, shape=(Gp, Np),
-            seed=seed, jitter=jitter, learned=learned)
+            seed=seed, jitter=jitter, learned=learned,
+            region_bias=region_bias)
         return _score_spec.evaluate_wave(_JAX_OPS, ctx)
 
     # ---------- shortlist scoring twin ----------

@@ -200,7 +200,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                pallas_mode="off", shortlist_c=0, mesh_axis=None,
                mesh_shards=0, has_preempt=False, ev_res=None,
                ev_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
-               node_gid=None, owner_map=None, slot_map=None):
+               node_gid=None, owner_map=None, slot_map=None,
+               mesh_regions=0):
     # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
     # lanes, 1/8th the transport bytes of the dense bool planes);
     # unpack on device — dtype is static, so either form compiles once
@@ -236,7 +237,8 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         shortlist_c=shortlist_c, mesh_axis=mesh_axis,
         mesh_shards=mesh_shards, mesh_hosts=mesh_hosts,
         mesh_nt=mesh_nt, tile_np=tile_np, node_gid=node_gid,
-        owner_map=owner_map, slot_map=slot_map, **ev_kw)
+        owner_map=owner_map, slot_map=slot_map,
+        mesh_regions=mesh_regions, **ev_kw)
 
 
 @functools.partial(jax.jit,

@@ -29,7 +29,11 @@ SCORE604 then fails until every hand backend named in the entry's
 `backends` tuple carries a matching fingerprint.  The reserved
 `learned` slot (GDP-style placer head, PAPERS.md) is wired this way:
 a precomputed [Gp, Np] plane appended as one more scorer, flowing to
-the driven backends only.
+the driven backends only.  The `region` term (ISSUE 13 cross-region
+scheduling) follows the same template: a precomputed [Gp, Np]
+region-affinity plane — built host-side from each node's region and
+the job's home region — appended as one more scorer, driven backends
+only.
 
 FINGERPRINT CONTRACT: the assignment-target names inside the term
 functions (`free_cpu`, `raw`, `binpack`, `anti`, ...) are the
@@ -47,7 +51,7 @@ from .tensorize import R_CPU, R_MEM
 
 #: bump on ANY term/combine change; recorded in BENCH_DETAIL by
 #: bench.lint_summary and snapshotted by the golden fingerprint test
-SPEC_VERSION = "3.0"
+SPEC_VERSION = "3.1"
 
 #: masked / sentinel score (shared by every backend; the kernel
 #: re-exports it)
@@ -307,6 +311,19 @@ def term_learned(ops, ctx):
     return learned
 
 
+def term_region(ops, ctx):
+    """Cross-region placement affinity (ISSUE 13): the [Gp, Np] plane
+    arrives PRECOMPUTED in ctx["region_bias"] — built host-side from
+    each node's region id and the asking job's home region (home
+    region > sibling > remote, scaled by the spillover policy) — and
+    the spec appends it as one more scorer via `combine_region`.  When
+    no plane is supplied the term is statically absent: the combine
+    path and the traced program are byte-identical to a spec without
+    it (appending an all-zeros plane would still flip -0.0 to +0.0)."""
+    region_bias = ctx["region_bias"]
+    return region_bias
+
+
 def combine(ops, ctx, parts):
     """Append-then-average normalization (rank.go:667): the mean over
     the appended scorers, seed-binned (kernel.solve_kernel documents
@@ -342,6 +359,53 @@ def combine_learned(ops, ctx, parts):
     total = (parts["binpack"] + parts["anti"] + parts["pen_score"]
              + parts["aff_score"] + parts["spread_total"]
              + learned) / n_scorers
+    total = ops.seed_select(ctx["seed"], total,
+                            ops.floor(total / f32(SCORE_BIN))
+                            * f32(SCORE_BIN))
+    total = total + ctx["jitter"]
+    return total
+
+
+def combine_region(ops, ctx, parts):
+    """`combine` with the region-affinity plane appended as one more
+    scorer (same append semantics as anti/pen/aff/spread: counted when
+    nonzero).  A SEPARATE function, like `combine_learned`, so the
+    canonical `total` fingerprint in `combine` stays exactly what the
+    region-free hand backends implement; nomadlint groups this body
+    under the `region` term."""
+    f32 = ops.f32
+    region_bias = parts["region"]
+    n_scorers = ops.counts_cast(f32(1.0) + parts["anti_counts"]
+                                + parts["pen_counts"]
+                                + parts["aff_counts"]
+                                + parts["spread_counts"]
+                                + (region_bias != 0.0))
+    total = (parts["binpack"] + parts["anti"] + parts["pen_score"]
+             + parts["aff_score"] + parts["spread_total"]
+             + region_bias) / n_scorers
+    total = ops.seed_select(ctx["seed"], total,
+                            ops.floor(total / f32(SCORE_BIN))
+                            * f32(SCORE_BIN))
+    total = total + ctx["jitter"]
+    return total
+
+
+def combine_learned_region(ops, ctx, parts):
+    """Both optional planes active at once (a learned head on a
+    federated mesh): learned AND region each append as one more
+    scorer.  Grouped under the `region` term like `combine_region`."""
+    f32 = ops.f32
+    learned = parts["learned"]
+    region_bias = parts["region"]
+    n_scorers = ops.counts_cast(f32(1.0) + parts["anti_counts"]
+                                + parts["pen_counts"]
+                                + parts["aff_counts"]
+                                + parts["spread_counts"]
+                                + (learned != 0.0)
+                                + (region_bias != 0.0))
+    total = (parts["binpack"] + parts["anti"] + parts["pen_score"]
+             + parts["aff_score"] + parts["spread_total"]
+             + learned + region_bias) / n_scorers
     total = ops.seed_select(ctx["seed"], total,
                             ops.floor(total / f32(SCORE_BIN))
                             * f32(SCORE_BIN))
@@ -395,6 +459,11 @@ TERMS = (
      "groups": {"learned": ("learned",)}, "const_set": False,
      "backends": ("host", "kernel"),
      "doc": "reserved learned-head plane (driven backends only)"},
+    {"name": "region", "fn": "term_region",
+     "groups": {"region": ("region_bias",)}, "const_set": False,
+     "backends": ("host", "kernel"),
+     "doc": "cross-region placement affinity plane (ISSUE 13; "
+            "driven backends only)"},
     {"name": "combine", "fn": "combine",
      "groups": {"n_scorers": ("n_scorers",), "total": ("total",)},
      "const_set": False,
@@ -442,7 +511,8 @@ def evaluate_wave(ops, ctx):
     dev_ask, feas; hoisted terms: pen_score, pen_counts, aff_score,
     jitter; spread statics: sp_col, sp_weight, sp_targeted, vnode, des,
     S, V; shape=(Gp, Np), seed, has_devices, has_spread, and the
-    optional `learned` plane (None = term statically absent)."""
+    optional `learned` / `region_bias` planes (None = term statically
+    absent)."""
     f32 = ops.f32
     after, fit_dims, fit, dev_fit, feas_b, placeable = \
         term_feasibility(ops, ctx)
@@ -466,13 +536,22 @@ def evaluate_wave(ops, ctx):
              "aff_score": aff_score, "aff_counts": aff_score != 0.0,
              "spread_total": spread_total,
              "spread_counts": spread_counts}
-    if ctx.get("learned") is not None:
-        # static branch: with no learned plane the combine path (and
-        # the traced program / float behavior) is byte-identical to a
-        # spec without the term — appending an all-zeros plane would
-        # still flip -0.0 sums to +0.0
+    # static branches: with no learned/region plane the combine path
+    # (and the traced program / float behavior) is byte-identical to a
+    # spec without the term — appending an all-zeros plane would still
+    # flip -0.0 sums to +0.0
+    has_learned = ctx.get("learned") is not None
+    has_region = ctx.get("region_bias") is not None
+    if has_learned:
         parts["learned"] = term_learned(ops, ctx)
+    if has_region:
+        parts["region"] = term_region(ops, ctx)
+    if has_learned and has_region:
+        total = combine_learned_region(ops, ctx, parts)
+    elif has_learned:
         total = combine_learned(ops, ctx, parts)
+    elif has_region:
+        total = combine_region(ops, ctx, parts)
     else:
         total = combine(ops, ctx, parts)
     score = ops.where(placeable, total, f32(NEG_INF))

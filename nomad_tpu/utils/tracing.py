@@ -319,7 +319,8 @@ class FlightRecorder:
 class MeshEventLog:
     """Persistent log of elastic-mesh transitions (ISSUE 8's
     grow/shrink/move/fail/recover) with measured reshard/recovery bytes
-    and durations — the /v1/agent/events surface.  Bounded ring;
+    and durations, plus the region.* federation events (ISSUE 13; see
+    region_table) — the /v1/agent/events surface.  Bounded ring;
     optional JSONL sink (NOMAD_TPU_MESH_EVENT_LOG) makes it durable."""
 
     def __init__(self, depth: int = DEFAULT_MESH_EVENTS,
@@ -366,6 +367,47 @@ class MeshEventLog:
         if kind:
             evs = [e for e in evs if e["kind"] == kind]
         return evs[-max(int(limit), 1):]
+
+    def region_table(self) -> dict:
+        """Federation membership replayed from the region.* events
+        (ISSUE 13): region -> {"members": [...], "state": "up"|"left"
+        |"degraded"}.  region.join adds (member joins when the event
+        names one; node-universe joins from CrossRegionResidentSolver
+        carry none), region.fail removes a member, region.leave marks
+        the region gone, region.degraded/.recovered flip the mesh
+        health — the WAN-gossip view a /v1/regions surface serves."""
+        with self._lock:
+            evs = list(self._events)
+        table: dict = {}
+        degraded: Optional[str] = None
+        for ev in evs:
+            kind = ev.get("kind", "")
+            if not kind.startswith("region."):
+                continue
+            region = ev.get("region")
+            if kind == "region.recovered":
+                if degraded is not None and degraded in table:
+                    table[degraded]["state"] = "up"
+                degraded = None
+                continue
+            if region is None:
+                continue
+            row = table.setdefault(
+                region, {"members": set(), "state": "up"})
+            if kind == "region.join":
+                row["state"] = "up"
+                if ev.get("member"):
+                    row["members"].add(ev["member"])
+            elif kind == "region.fail":
+                row["members"].discard(ev.get("member"))
+            elif kind == "region.leave":
+                row["state"] = "left"
+            elif kind == "region.degraded":
+                row["state"] = "degraded"
+                degraded = region
+        return {r: {"members": sorted(row["members"]),
+                    "state": row["state"]}
+                for r, row in table.items()}
 
     def __len__(self) -> int:
         with self._lock:

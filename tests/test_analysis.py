@@ -237,6 +237,52 @@ FIX_JIT = """
         g = shard_map(wrong_axis_body, mesh=mesh, in_specs=None,
                       out_specs=None)
         return f(x) + g(x)
+
+
+    REGION_AX = "regions"
+
+
+    def make_region_mesh(devices):
+        # internal helper returning a three-tier Mesh: axes must
+        # resolve through ONE return level (ISSUE 13)
+        import numpy as np
+        from jax.sharding import Mesh
+        grid = np.array(devices).reshape(2, 2, 2)
+        return Mesh(grid, (REGION_AX, HOST_AX, "chips"))
+
+
+    def three_tier_body(x):
+        # all three axes bound by the helper-built mesh: fine
+        s = jax.lax.psum(x, "chips")
+        s = jax.lax.psum(s, HOST_AX)
+        return jax.lax.psum(s, REGION_AX)
+
+
+    def inner_only_body(x):
+        # also wrapped by the two-tier context in run_nested below,
+        # where "regions" is NOT bound -> latent trace error there
+        return jax.lax.psum(x, REGION_AX)                  # JIT205
+
+
+    def run_three_tier(devices, x):
+        from jax.experimental.shard_map import shard_map
+        f = shard_map(three_tier_body, mesh=make_region_mesh(devices),
+                      in_specs=None, out_specs=None)
+        return f(x)
+
+
+    def run_nested(devices, x):
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh
+        inner = make_region_mesh(devices)
+        outer = Mesh(np.array(devices).reshape(2, 4),
+                     (HOST_AX, "chips"))
+        f = shard_map(inner_only_body, mesh=inner, in_specs=None,
+                      out_specs=None)
+        g = shard_map(inner_only_body, mesh=outer, in_specs=None,
+                      out_specs=None)
+        return f(x) + g(x)
 """
 
 FIX_LOCKS = """
@@ -763,6 +809,26 @@ def test_jit_collective_axis_not_bound_by_mesh_detected(fixture_report):
     keys = _keys(fixture_report, "JIT205")
     assert any(":wrong_axis_body:" in k for k in keys)
     assert all(":two_tier_body:" not in k for k in keys)
+
+
+def test_jit_three_tier_helper_mesh_axes_resolved(fixture_report):
+    """ISSUE 13: a mesh built by an internal helper
+    (make_three_tier_mesh style — `mesh=make_region_mesh(devs)`)
+    resolves one return level deep, so all three
+    ("regions", "hosts", "chips") axes count as bound and the
+    three-tier body stays quiet."""
+    keys = _keys(fixture_report, "JIT205")
+    assert all(":three_tier_body:" not in k for k in keys)
+    assert all(":run_three_tier:" not in k for k in keys)
+
+
+def test_jit_inner_only_axis_flagged(fixture_report):
+    """ISSUE 13: a body wrapped by BOTH a three-tier context and a
+    two-tier context only provably binds the intersection of their
+    axes — its "regions" psum trace-fails on the outer path and is
+    flagged even though the inner context binds it."""
+    keys = _keys(fixture_report, "JIT205")
+    assert any(":inner_only_body:" in k for k in keys)
 
 
 def test_jit_donated_carry_subscript_detected(fixture_report):
