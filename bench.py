@@ -902,7 +902,10 @@ def _region_queue_sim(arrivals, regions, svc, router=None,
     per arrival (backlogs fed via note_ready, shed lane drained as
     capacity returns); without one every eval runs in its home region
     and `watermark` backlogs are recorded as brownouts.  Returns
-    (latencies, browned_regions, completed)."""
+    (latencies, browned_regions, completed).  A router carrying a
+    WanLatencyModel charges every cross-region hop its modeled
+    (seeded, jittered) WAN delay before the eval reaches the remote
+    queue — spillover is never free."""
     import collections
     comp = {r: collections.deque() for r in regions}
     last = {r: 0.0 for r in regions}
@@ -929,10 +932,10 @@ def _region_queue_sim(arrivals, regions, svc, router=None,
         for r in regions:
             router.region(r).note_ready(depth(r, t))
         for ev, r in router.drain_shed():
-            enqueue(r, t, ev[0])
+            enqueue(r, t + router.wan_delay(ev[1], r), ev[0])
         reg, _cause = router.route((t, home), home=home)
         if reg is not None:
-            enqueue(reg, t, t)
+            enqueue(reg, t + router.wan_delay(home, reg), t)
     # park-drain: anything the router shed completes once capacity
     # returns (never dropped)
     t = max(last.values())
@@ -943,7 +946,7 @@ def _region_queue_sim(arrivals, regions, svc, router=None,
         for r in regions:
             router.region(r).note_ready(depth(r, t))
         for ev, r in router.drain_shed():
-            enqueue(r, t, ev[0])
+            enqueue(r, t + router.wan_delay(ev[1], r), ev[0])
     return lat, browned, len(lat)
 
 
@@ -980,7 +983,7 @@ def run_multiregion(n_devices=8, n_regions=4, n_nodes=None, n_evals=16,
     import numpy as np
     from nomad_tpu.parallel.federated import CrossRegionResidentSolver
     from nomad_tpu.parallel.sharded import ShardedResidentSolver
-    from nomad_tpu.server.serving import SpilloverRouter
+    from nomad_tpu.server.serving import SpilloverRouter, WanLatencyModel
     from nomad_tpu.solver.tensorize import Tensorizer
     from nomad_tpu.utils.compile_cache import cache_entries
 
@@ -1082,12 +1085,22 @@ def run_multiregion(n_devices=8, n_regions=4, n_nodes=None, n_evals=16,
     lat_iso, browned, done_iso = _region_queue_sim(
         arrivals, regions, svc, watermark=int(0.75 * mp_small))
 
+    # modeled WAN latency (ISSUE 14): every cross-region hop costs a
+    # per-pair base (here 0.5 svc — the scale-free knob) with seeded
+    # jitter; routing math subtracts the jitter-free expectation from
+    # the SLO budget so remote capacity is never judged free
+    wan_base = 0.5 * svc
+
+    def _wan_model():
+        return WanLatencyModel(default_s=wan_base, jitter=0.25)
+
     def _router():
         r = SpilloverRouter(
             regions={name: 1.0 + 0.1 * i
                      for i, name in enumerate(regions)},
             overrides={"slo_budget_s": 2.5 * svc, "spill_margin": 1.0,
-                       "max_pending": mp_small})
+                       "max_pending": mp_small},
+            wan_model=_wan_model())
         for name in regions:
             for b in (1, 2, 4, 8, 16, 32, 64):
                 r.note_solve(name, b, b * svc)
@@ -1116,6 +1129,9 @@ def run_multiregion(n_devices=8, n_regions=4, n_nodes=None, n_evals=16,
         + (n_arr - done_bal),
         "shed_lane_depth_end": router.shed_depth(),
         "routed": stats["routed"],
+        "wan": {"base_s": round(wan_base, 6),
+                "base_vs_svc": 0.5, "jitter": 0.25,
+                **stats.get("wan", {})},
         "shed_accounting_intact": (
             stats["routed"]["shed"] == stats["routed"]["readmitted"]
             and router.shed_depth() == 0),
@@ -1136,6 +1152,327 @@ def run_multiregion(n_devices=8, n_regions=4, n_nodes=None, n_evals=16,
             except (OSError, json.JSONDecodeError):
                 detail = {}
         detail["multiregion"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+    return out
+
+
+# ------------------- chaos storm phase (ISSUE 14) -------------------
+
+def run_chaos(n_devices=8, n_regions=4, write_detail=True, seed=14):
+    """Chaos plane phase (ISSUE 14): a seeded compound fault storm —
+    shard kills + region partitions + gossip flaps + stuck/slow/
+    poisoned device solves — replayed through the real recovery hooks
+    at config-3 load, with the invariant harness running continuously.
+
+    Three sub-records:
+
+      * ``watchdog`` — the acceptance failover arc: a stuck device
+        solve (injected sleep past the deadline) answers from the
+        bit-identical host twin with PLACEMENT-IDENTICAL results,
+        quarantines the device, keeps answering from the twin while
+        the backoff pends, and recovers to the device fast path on a
+        clean probe — all visible in the mesh event log;
+      * ``corruption`` — a delta-row corruption (device planes diverge
+        from the raft-fed host template) is caught by the plane
+        checksum invariant and healed by a clean re-apply;
+      * ``storm`` — a fault-free leg vs the storm leg over identical
+        eval streams: per-step latencies (p50/p99), zero lost evals,
+        zero invariant violations, post-storm placements bit-identical
+        to the fault-free reference, recovery times, and the
+        watchdog-lane fast-path retention.
+
+    Acceptance: zero violations, zero lost evals, storm p99 <= 3x the
+    fault-free p99, and the watchdog failover demonstrated.  Merges
+    into BENCH_DETAIL.json under "chaos"."""
+    import importlib
+    graft = importlib.import_module("__graft_entry__")
+    n_devices, n_regions = graft._ensure_devices(n_devices, n_regions)
+    import numpy as np
+    from nomad_tpu import mock
+    from nomad_tpu.chaos import (ChaosSupervisor, FaultPlan,
+                                 InvariantHarness, global_injections)
+    from nomad_tpu.parallel.federated import CrossRegionResidentSolver
+    from nomad_tpu.parallel.sharded import ElasticMeshSupervisor
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.server.serving import AdmissionController
+    from nomad_tpu.solver.solve import _run_kernel
+    from nomad_tpu.solver.tensorize import ClusterDelta, Tensorizer
+    from nomad_tpu.solver.watchdog import global_watchdog
+    from nomad_tpu.utils.metrics import global_metrics as _m
+    from nomad_tpu.utils.tracing import global_mesh_events
+
+    p3 = CONFIGS[3]
+    n_nodes = int(os.environ.get("NOMAD_TPU_CHAOS_NODES",
+                                 p3["n_nodes"]))
+    resident = int(os.environ.get(
+        "NOMAD_TPU_CHAOS_RESIDENT",
+        p3["resident"] * n_nodes // p3["n_nodes"]))
+    count = p3["count"]
+    horizon = int(os.environ.get("NOMAD_TPU_CHAOS_HORIZON", "36"))
+    per_region = n_nodes // n_regions
+    nodes = make_nodes(per_region * n_regions)
+    region_nodes = [nodes[r * per_region:(r + 1) * per_region]
+                    for r in range(n_regions)]
+    probe_job = make_job(3, 0, count)
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    gp = 1 << max(0, (gp_need - 1).bit_length())
+    kp = 1 << max(0, (count - 1).bit_length())
+    cr = CrossRegionResidentSolver(
+        region_nodes, asks_for(probe_job), n_devices=n_devices,
+        gp=gp, kp=kp, max_waves=18, pallas="off")
+    used0 = resident_used0(cr.template, per_region * n_regions,
+                           resident)
+    msup = ElasticMeshSupervisor(cr.solver)
+    msup.register_host("host-r1", 1)
+    jobs = [make_job(3, e, count) for e in range(8)]
+    batches = [cr.pack_batch(asks_for(j)) for j in jobs]
+    # the watchdog device-dispatch lane: a standalone full pack (node
+    # planes included — resident batches carry only the eval tensors)
+    # over a modest node subset, so the host twin answers fast when
+    # the watchdog fails over
+    pb_wd = Tensorizer().pack(nodes[:256], asks_for(jobs[0]))
+    import jax
+    out = {"phase": "chaos", "seed": int(seed),
+           "n_nodes": int(per_region * n_regions),
+           "n_regions": int(n_regions), "resident": int(resident),
+           "horizon": int(horizon),
+           "backend": jax.default_backend()}
+
+    # the storm schedule is generated up front (it is the experiment's
+    # seed-addressable identity), which also lets the warmup below
+    # compile every degraded-width variant the storm will actually
+    # drive — the storm leg's p99 then measures fault HANDLING
+    # (re-ship, failover, rebuild), not first-call compilation
+    rates = {"shard_kill": 0.06, "region_kill": 0.06,
+             "gossip_flap": 0.08, "stuck_solve": 0.05,
+             "slow_solve": 0.08, "poison_solve": 0.05}
+    plan = FaultPlan.generate(seed, horizon, rates,
+                              shards=cr.solver.n_shards,
+                              regions=cr.region_names,
+                              members=["host-r1"])
+
+    cr.reset_usage(used0=used0)
+    cr.solve_stream([batches[0]])
+    warm_kills = [("shard", 1)]         # the gossip-flap member's shard
+    for ev in plan.events:
+        if ev.kind == "shard_kill":
+            warm_kills.append(
+                ("shard", int(ev.target or 0) % cr.solver.n_shards))
+        elif ev.kind == "region_kill":
+            warm_kills.append(("region", ev.target))
+    for wkind, wtgt in dict.fromkeys(warm_kills):
+        if wkind == "shard":
+            cr.solver.fail_shard(wtgt)
+        else:
+            cr.fail_region_shard(wtgt)
+        cr.reset_usage(used0=used0)
+        cr.solve_stream([batches[0]])
+        cr.solver.recover()
+        cr.reset_usage(used0=used0)
+        cr.solve_stream([batches[0]])
+    _run_kernel(pb_wd, host_mode="never")
+
+    # ---- watchdog failover arc (the acceptance demo) ----
+    deadline = float(os.environ.get("NOMAD_TPU_SOLVE_DEADLINE_S",
+                                    "0.5"))
+    global_watchdog.deadline_s = deadline
+    global_watchdog.quarantined = False
+    global_watchdog._failures = 0
+    base_choice = np.asarray(
+        _run_kernel(pb_wd, host_mode="never").choice)
+    global_injections.arm("device_solve", "sleep", budget=1,
+                          sleep_s=4.0 * deadline)
+    t0 = time.perf_counter()
+    stuck = np.asarray(_run_kernel(pb_wd, host_mode="never").choice)
+    failover_s = time.perf_counter() - t0
+    quarantined = bool(global_watchdog.quarantined)
+    twin = np.asarray(_run_kernel(pb_wd, host_mode="never").choice)
+    global_watchdog._probe_at = 0.0            # backoff elapsed
+    probed = np.asarray(_run_kernel(pb_wd, host_mode="never").choice)
+    out["watchdog"] = {
+        "deadline_s": deadline,
+        "failover_s": round(failover_s, 4),
+        "failover_placements_identical": bool(
+            np.array_equal(stuck, base_choice)),
+        "quarantined_after_failover": quarantined,
+        "quarantine_twin_identical": bool(
+            np.array_equal(twin, base_choice)),
+        "recovered_to_device": bool(not global_watchdog.quarantined),
+        "probe_placements_identical": bool(
+            np.array_equal(probed, base_choice)),
+        "failover_in_event_log": bool(global_mesh_events.events(
+            kind="watchdog.failover", limit=4096)),
+        "recovery_in_event_log": bool(global_mesh_events.events(
+            kind="watchdog.recovered", limit=4096)),
+    }
+    out["watchdog"]["ok"] = all(
+        v for k, v in out["watchdog"].items()
+        if isinstance(v, bool))
+
+    # ---- delta-row corruption: detected, then healed ----
+    hc = InvariantHarness()
+    clean_before = hc.check_plane_checksums(cr.solver)
+    victim = nodes[7]
+    victim.node_resources.cpu += 1
+    victim.compute_class()
+    d = ClusterDelta()
+    d.upsert_nodes.append(victim)
+    global_injections.arm("delta_row", "mutate", budget=1, rows=2)
+    corr_path = cr.apply_delta(d)
+    detected = not hc.check_plane_checksums(cr.solver)
+    d2 = ClusterDelta()
+    d2.upsert_nodes.append(victim)         # clean re-apply heals
+    cr.apply_delta(d2)
+    healed = InvariantHarness().check_plane_checksums(cr.solver)
+    out["corruption"] = {"apply_path": corr_path,
+                         "clean_before": bool(clean_before),
+                         "detected": bool(detected),
+                         "healed_by_reapply": bool(healed)}
+
+    # ---- fault-free leg vs the compound storm leg ----
+    # each step serves SPS fleet batches + the watchdog lane + an
+    # eval-broker burst: the per-step cost a client sees at config-3
+    # load, against which a transition's one-time re-ship/failover
+    # cost amortizes (exactly how a real serving tier absorbs it)
+    SPS = 4                             # fleet solves per step
+
+    def run_leg(supervisor):
+        broker = EvalBroker(initial_nack_delay_s=0.01)
+        broker.set_enabled(True)
+        adm = AdmissionController(max_pending=4096,
+                                  protect_priority=101,
+                                  brownout_high=0.9,
+                                  brownout_low=0.5,
+                                  brownout_after_s=0.001,
+                                  ns_rate=1e9, ns_burst=1e9)
+        harness = InvariantHarness()
+        dbg = os.environ.get("NOMAD_TPU_CHAOS_DEBUG")
+        lat, recovery_s = [], []
+        t_kill = None
+        for step in range(horizon):
+            t0 = time.perf_counter()
+            if supervisor is not None:
+                for e in supervisor.advance(step):
+                    if e.kind in ("shard_kill", "region_kill"):
+                        t_kill = time.perf_counter()
+            t_adv = time.perf_counter()
+            for i in range(SPS):
+                ev = mock.eval_(job_id=f"job-{step}-{i}")
+                harness.note_enqueued(ev.id)
+                if adm.offer(ev, broker.ready_count()):
+                    broker.enqueue(ev)
+                else:
+                    harness.note_outcome(ev.id, "shed")
+            t_ev = time.perf_counter()
+            for b in range(SPS):
+                pb = batches[(step * SPS + b) % len(batches)]
+                cr.reset_usage(used0=used0)
+                choice, ok, _sc, _st = cr.solve_stream([pb])
+            t_solve = time.perf_counter()
+            res = _run_kernel(pb_wd, host_mode="never")
+            t_lane = time.perf_counter()
+            wd_choice = np.asarray(res.choice)
+            for pi in range(min(4, pb_wd.n_place)):
+                harness.note_placement(
+                    f"s{step}-p{pi}", str(int(wd_choice[pi, 0])))
+            while True:
+                got, tok = broker.dequeue(["service"], 0.0)
+                if got is None:
+                    break
+                broker.ack(got.id, tok)
+                harness.note_outcome(got.id, "acked")
+            if supervisor is not None and t_kill is not None \
+                    and cr.mesh_state == "healthy":
+                # the storm (or a gossip rejoin) recovered the mesh
+                recovery_s.append(time.perf_counter() - t_kill)
+                t_kill = None
+            t_drain = time.perf_counter()
+            lat.append(time.perf_counter() - t0)
+            # the continuously-running invariant harness
+            harness.check_eval_conservation(broker)
+            harness.check_no_double_placement()
+            harness.check_plane_checksums(cr.solver)
+            harness.check_shed_accounting(admission=adm)
+            if dbg:
+                print(f"step {step:2d} total {lat[-1]:.3f} "
+                      f"adv {t_adv - t0:.3f} "
+                      f"evq {t_ev - t_adv:.3f} "
+                      f"solve {t_solve - t_ev:.3f} "
+                      f"lane {t_lane - t_solve:.3f} "
+                      f"drain {t_drain - t_lane:.3f} "
+                      f"chk {time.perf_counter() - t_drain:.3f}",
+                      file=sys.stderr)
+        if cr.mesh_state == "degraded":       # final quiesce
+            t0 = time.perf_counter()
+            cr.solver.recover()
+            recovery_s.append(time.perf_counter()
+                              - (t_kill or t0))
+        harness.check_plane_checksums(cr.solver)
+        cr.reset_usage(used0=used0)
+        c, o, _s, st = cr.solve_stream([batches[0]])
+        final = (np.where(o, c, -1).copy(), np.asarray(st).copy())
+        return lat, harness, recovery_s, final
+
+    c0 = _m.dump()["counters"]
+    wd_host0 = (c0.get("watchdog.host_failover", 0)
+                + c0.get("watchdog.host_quarantine", 0))
+    lat_ff, h_ff, _rec, final_ff = run_leg(None)
+    sup = ChaosSupervisor(plan, federated=cr, mesh_supervisor=msup,
+                          injections=global_injections,
+                          watchdog_deadline_s=deadline)
+    lat_st, h_st, recovery_s, final_st = run_leg(sup)
+    c1 = _m.dump()["counters"]
+    wd_host1 = (c1.get("watchdog.host_failover", 0)
+                + c1.get("watchdog.host_quarantine", 0))
+    host_answers = wd_host1 - wd_host0
+    p99_ff = pct(sorted(lat_ff), 0.99)
+    p99_st = pct(sorted(lat_st), 0.99)
+    rep = sup.report()
+    out["storm"] = {
+        "plan": rep,
+        "evals_per_step": SPS,
+        "solves_per_step": SPS,
+        "p50_fault_free_s": round(pct(sorted(lat_ff), 0.50), 4),
+        "p99_fault_free_s": round(p99_ff, 4),
+        "p50_storm_s": round(pct(sorted(lat_st), 0.50), 4),
+        "p99_storm_s": round(p99_st, 4),
+        "p99_ratio": round(p99_st / max(p99_ff, 1e-9), 3),
+        "evals_lost": 0 if (h_ff.ok and h_st.ok) else -1,
+        "invariants_fault_free": h_ff.report(),
+        "invariants_storm": h_st.report(),
+        "recovery_s": [round(r, 4) for r in recovery_s],
+        "step_lat_fault_free_s": [round(v, 3) for v in lat_ff],
+        "step_lat_storm_s": [round(v, 3) for v in lat_st],
+        "watchdog_host_answers": int(host_answers),
+        "fast_path_retention": round(
+            1.0 - host_answers / (2.0 * horizon), 4),
+        "post_storm_placements_match_fault_free": bool(
+            np.array_equal(final_st[0], final_ff[0])
+            and np.array_equal(final_st[1], final_ff[1])),
+        "chaos_events_logged": len(global_mesh_events.events(
+            limit=4096, kind=None)),
+    }
+    global_injections.reset()
+    global_watchdog.deadline_s = None
+    out["ok"] = bool(
+        out["watchdog"]["ok"]
+        and out["corruption"]["detected"]
+        and out["corruption"]["healed_by_reapply"]
+        and h_ff.ok and h_st.ok
+        and out["storm"]["p99_ratio"] <= 3.0
+        and out["storm"]["post_storm_placements_match_fault_free"])
+    if write_detail:
+        path = os.path.join(REPO, "BENCH_DETAIL.json")
+        detail = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    detail = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                detail = {}
+        detail["chaos"] = out
         with open(path, "w") as f:
             json.dump(detail, f, indent=1)
     return out
@@ -2346,6 +2683,14 @@ def main():
         # subprocess mode: the WAN federation phase (ISSUE 13) —
         # merges its record into MULTICHIP_DETAIL.json, prints it
         out = run_multiregion()
+        print("\x1e" + json.dumps(out))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--chaos":
+        # subprocess mode: the chaos storm phase (ISSUE 14) — merges
+        # its record into BENCH_DETAIL.json under "chaos"; isolated
+        # because it self-provisions virtual devices and arms
+        # process-wide injection/watchdog state
+        out = run_chaos()
         print("\x1e" + json.dumps(out))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--open-loop":

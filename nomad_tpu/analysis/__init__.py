@@ -1,6 +1,6 @@
 """nomadlint: static invariant analyzer for the nomad_tpu package.
 
-Six passes over a module-level call graph plus a dataflow layer
+Seven passes over a module-level call graph plus a dataflow layer
 (def-use chains, buffer-identity provenance, interprocedural
 summaries — see dataflow.py). No analyzed module is ever imported:
 everything is `ast` on source text, so the analyzer runs without JAX
@@ -33,6 +33,11 @@ or a device.
     are fingerprinted per term and verified against the spec, term
     coverage is checked both ways, and scoring-shaped arithmetic
     outside the spec/registered sites is flagged.
+  * swallowed exceptions (robust_pass): bare/broad except handlers in
+    the recovery-critical planes (raft, rpc, server, parallel, solver)
+    must re-raise, use the bound error, or surface it through
+    logging/metrics — silent drops turn injected faults (chaos plane,
+    ISSUE 14) into undetected state divergence.
 
 Checked-in suppressions live in baseline.toml next to this file; every
 entry must carry a non-empty justification. Run `python -m
@@ -84,6 +89,7 @@ def analyze(package_dir: Optional[str] = None,
     from .shard_pass import run_shard_pass
     from .alias_pass import run_alias_pass
     from .score_pass import run_score_pass
+    from .robust_pass import run_robust_pass
     from .dataflow import DataflowEngine
 
     package_dir = package_dir or _PKG_DIR
@@ -105,6 +111,7 @@ def analyze(package_dir: Optional[str] = None,
     # a read JIT204 already covers
     findings += run_alias_pass(index, cfg, engine, prior=findings)
     findings += run_score_pass(index, cfg, package_dir=package_dir)
+    findings += run_robust_pass(index, cfg)
     if only_files is not None:
         findings = [f for f in findings
                     if f.rule not in ("SCORE603", "SCORE604")

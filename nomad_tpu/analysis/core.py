@@ -31,6 +31,7 @@ WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503"})
 RULE_PASSES: Tuple[Tuple[str, str], ...] = (
     ("FSM", "fsm"), ("JIT", "jit"), ("LOCK", "lock"),
     ("SHARD", "shard"), ("ALIAS", "alias"), ("SCORE", "score"),
+    ("ROBUST", "robust"),
 )
 
 
@@ -167,6 +168,12 @@ class AnalysisConfig:
     # package registry in score_pass.DEFAULT_SCORER_SITES); tests
     # point this at synthetic fixture backends.
     scorer_sites: Optional[Tuple] = None
+    # ROBUST701 scope: recovery-critical planes where a swallowed
+    # exception turns an injected fault into silent state divergence.
+    robust_module_prefixes: Tuple[str, ...] = (
+        "nomad_tpu.raft", "nomad_tpu.rpc", "nomad_tpu.server",
+        "nomad_tpu.parallel", "nomad_tpu.solver",
+    )
 
 
 class FuncInfo:

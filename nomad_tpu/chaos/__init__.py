@@ -1,0 +1,38 @@
+"""Chaos plane: seeded, fully deterministic fault injection plus a
+continuously-running invariant harness (ISSUE 14).
+
+Nomad's core promise is surviving failure; this package makes failure
+a first-class, replayable *input*.  A `FaultPlan` is a schedule of
+`FaultEvent`s keyed on LOGICAL steps (never wall time) — scripted
+explicitly or generated from (seed, horizon, rates) — and a
+`ChaosSupervisor` replays it through the recovery hooks the system
+already owns:
+
+  * shard kill / recover        (ElasticShardedResidentSolver)
+  * region kill / recover       (CrossRegionResidentSolver)
+  * gossip membership flaps     (ElasticMeshSupervisor / GossipAgent
+                                 on_fail / on_join)
+  * leader step-down            (RaftNode)
+  * slow / stuck / poisoned device solves and delta-row corruption
+                                (the `global_injections` site registry,
+                                 consulted by solver code)
+
+While a storm runs, an `InvariantHarness` checks end-to-end properties
+continuously: no eval lost through broker/shed lanes, no
+double-placement, per-node usage conservation bit-identical to a
+from-scratch repack at quiesce points, shed/admission accounting
+balanced, and device-resident planes checksum-verified against the
+raft-fed template after every recovery.
+
+Every applied event lands in the mesh event log (`chaos.*` kinds) so a
+storm is auditable after the fact; the same seed replays the same
+storm bit-for-bit.
+"""
+from .plan import FaultEvent, FaultPlan
+from .injection import Injection, InjectionRegistry, global_injections
+from .supervisor import ChaosSupervisor
+from .invariants import InvariantHarness, InvariantViolation
+
+__all__ = ["FaultEvent", "FaultPlan", "Injection", "InjectionRegistry",
+           "global_injections", "ChaosSupervisor", "InvariantHarness",
+           "InvariantViolation"]

@@ -318,7 +318,8 @@ class FederatedResidentSolver:
         runtime doesn't expose the cache."""
         try:
             return int(_federated_stream_kernel._cache_size())
-        except Exception:
+        except (AttributeError, TypeError):
+            # jax version without the _cache_size probe
             return -1
 
     # ---------------- usage ----------------

@@ -1049,6 +1049,35 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
                                   NamedSharding(self._mesh, spec))
         return jax.device_put(arr, NamedSharding(self._mesh, P()))
 
+    def plane_checksum(self) -> int:
+        """Layout-inverting override: the elastic planes live in
+        tile-routed device order, so fetch and route rows back to
+        template (global) order before hashing — healthy meshes cover
+        every global row with exactly one live tile, making the result
+        directly comparable to template_checksum (ISSUE 14)."""
+        from ..solver.tensorize import plane_crc
+        t = self.template
+        dn = self._dev_node
+        src = self._src_cache
+        live = src >= 0
+        Np = t.avail.shape[0]
+
+        def back(arr):
+            a = np.asarray(arr)
+            out = np.zeros((Np,) + a.shape[1:], a.dtype)
+            out[src[live]] = a[live]
+            return out
+
+        meta = f"{t.n_real}:{','.join(t.node_ids)}".encode()
+        return plane_crc(
+            back(dn["avail"]), back(dn["reserved"]),
+            back(dn["valid"]), back(dn["node_dc"]),
+            back(dn["attr_rank"]), back(dn["dev_cap"]),
+            ev_prio=(back(dn["ev_prio"]) if "ev_prio" in dn
+                     else None),
+            ev_res=(back(dn["ev_res"]) if "ev_res" in dn else None),
+            meta=meta)
+
     # delta scatters arrive with GLOBAL rows; route through the tile
     # tables to device-layout rows (the base scatter kernel's space).
     # Rows landing in a RETIRED tile (shrunk away, then handed to a

@@ -300,6 +300,18 @@ class RaftNode:
         self._append_locked(NOOP, None)
         self._role_events.append("leader")
 
+    def step_down(self) -> bool:
+        """Voluntary leader step-down (the chaos plane's
+        leader-failure hook, analog of raft leadership transfer):
+        bump the term and drop to follower so the election timer
+        picks a fresh leader.  No-op on non-leaders."""
+        with self._lock:
+            if self.role != ROLE_LEADER:
+                return False
+            self._step_down_locked(self.term + 1)
+        self._fire_role_events()
+        return True
+
     def _step_down_locked(self, term: int) -> None:
         was_leader = self.role == ROLE_LEADER
         self.term = term

@@ -7,14 +7,24 @@ concurrent callers draw distinct sockets.
 from __future__ import annotations
 
 import itertools
+import os
+import random
 import socket
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .wire import recv_frame, send_frame
 
 DIAL_TIMEOUT_S = 0.5
 CALL_TIMEOUT_S = 30.0           # > blocking-query timeouts
+# transient-transport retry policy (ISSUE 14): attempts beyond the
+# first, capped jittered exponential backoff between them, all inside
+# the per-call deadline (default: the call timeout, so existing
+# callers' worst-case latency is unchanged)
+MAX_RETRIES = int(os.environ.get("NOMAD_TPU_RPC_RETRIES", "2"))
+RETRY_BASE_S = 0.02
+RETRY_CAP_S = 0.25
 
 
 class RpcError(Exception):
@@ -47,9 +57,57 @@ class RpcClient:
         self._verify_hostname = verify_hostname
 
     def call(self, method: str, params: List[Any],
-             timeout: float = CALL_TIMEOUT_S) -> Any:
+             timeout: float = CALL_TIMEOUT_S,
+             retries: Optional[int] = None,
+             deadline_s: Optional[float] = None) -> Any:
         """One request/response. Raises RpcError for typed application
-        errors and ConnectionError for transport failures."""
+        errors and ConnectionError for transport failures.
+
+        Transient transport failures (dial refused, reset, torn frame)
+        retry up to `retries` extra attempts with capped jittered
+        exponential backoff, all inside one wall-clock deadline —
+        `deadline_s` when given, else `timeout`, so a probe with
+        timeout=0.5 still fails within ~0.5s total and liveness
+        detection latency is unchanged.  Typed RpcErrors (the server
+        answered) never retry."""
+        retries = MAX_RETRIES if retries is None else int(retries)
+        deadline = time.monotonic() + (
+            timeout if deadline_s is None else deadline_s)
+        attempt = 0
+        while True:
+            try:
+                remaining = deadline - time.monotonic()
+                if attempt and remaining <= 0:
+                    raise ConnectionError(
+                        f"rpc to {self.addr}: deadline exceeded after "
+                        f"{attempt} attempt(s)")
+                return self._call_once(method, params,
+                                       min(timeout, max(remaining,
+                                                        0.001)))
+            except ConnectionError:
+                from ..utils.metrics import global_metrics as _m
+                attempt += 1
+                if attempt > retries:
+                    if attempt > 1:
+                        _m.incr_counter("rpc.client.retries_exhausted")
+                    raise
+                delay = min(RETRY_CAP_S,
+                            RETRY_BASE_S * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random() / 2.0
+                if time.monotonic() + delay >= deadline:
+                    _m.incr_counter("rpc.client.deadline_exceeded")
+                    raise
+                _m.incr_counter("rpc.client.retries")
+                time.sleep(delay)
+
+    def _call_once(self, method: str, params: List[Any],
+                   timeout: float) -> Any:
+        from ..chaos.injection import global_injections
+        inj = global_injections.get("rpc_transport")
+        if inj is not None:
+            inj.fire()
+            raise ConnectionError(
+                f"rpc to {self.addr}: injected transport fault")
         try:
             sock = self._checkout()
         except OSError as e:

@@ -28,6 +28,7 @@ from ..utils.tracing import global_tracer as _tr
 FAILED_QUEUE = "_failed"
 DEFAULT_NACK_DELAY_S = 5.0
 DEFAULT_INITIAL_NACK_DELAY_S = 1.0
+DEFAULT_MAX_NACK_DELAY_S = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
 
 
@@ -65,7 +66,9 @@ class _Unack:
 class EvalBroker:
     def __init__(self, nack_delay_s: float = DEFAULT_NACK_DELAY_S,
                  initial_nack_delay_s: float = DEFAULT_INITIAL_NACK_DELAY_S,
-                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT):
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 max_nack_delay_s: float = DEFAULT_MAX_NACK_DELAY_S,
+                 nack_jitter_seed: int = 0xACED):
         self._lock = threading.Condition()
         self._enabled = False
         self._ready: Dict[str, _Heap] = {}
@@ -84,8 +87,12 @@ class EvalBroker:
         self._ready_since: Dict[str, float] = {}
         self.nack_delay_s = nack_delay_s
         self.initial_nack_delay_s = initial_nack_delay_s
+        self.max_nack_delay_s = max_nack_delay_s
         self.delivery_limit = delivery_limit
         self._deliveries: Dict[str, int] = {}
+        # seeded so chaos/replay runs see the same redelivery schedule
+        import random as _random
+        self._nack_rng = _random.Random(nack_jitter_seed)
         self._delay_thread: Optional[threading.Thread] = None
         self._stop_delay = threading.Event()
 
@@ -142,7 +149,18 @@ class EvalBroker:
             for t0 in self._ready_since.values():
                 oldest = _time.monotonic() - t0
                 break
+            # per-eval delivery counts: only evals past their first
+            # delivery (the interesting, bounded set — at most
+            # delivery_limit redeliveries each before parking), so
+            # gauge cardinality stays proportional to flapping evals,
+            # not throughput; the registry's namespace cap absorbs
+            # pathological storms as metrics.overflow
+            redelivered = {eid: n for eid, n in self._deliveries.items()
+                           if n > 1}
         _m.set_gauge("broker.ready_count", float(sum(ready.values())))
+        _m.set_gauge("broker.redelivering", float(len(redelivered)))
+        for eid, n in redelivered.items():
+            _m.set_gauge(f"broker.deliveries.{eid}", float(n))
         _m.set_gauge("broker.oldest_ready_age_s", oldest)
         _m.set_gauge("broker.unacked", float(unacked))
         _m.set_gauge("broker.waiting", float(waiting))
@@ -375,9 +393,14 @@ class EvalBroker:
                           deliveries=self._deliveries.get(eval_id, 0))
                 self._lock.notify_all()
                 return None
-            # redeliver after a compounding delay
-            delay = (self.initial_nack_delay_s
-                     * max(1, self._deliveries.get(eval_id, 1)))
+            # redeliver after a capped jittered exponential delay:
+            # linear compounding barely separates a flapping eval from
+            # healthy redeliveries, and unjittered delays re-collide a
+            # burst of nacked evals at every retry (thundering herd)
+            n = max(1, self._deliveries.get(eval_id, 1))
+            delay = min(self.max_nack_delay_s,
+                        self.initial_nack_delay_s * (2 ** (n - 1)))
+            delay *= 0.5 + self._nack_rng.random() / 2.0
             _tr.event(eval_id, "broker.nack", parked=False,
                       deliveries=self._deliveries.get(eval_id, 0),
                       redeliver_delay_s=round(delay, 6))

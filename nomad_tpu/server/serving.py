@@ -248,6 +248,7 @@ class AdmissionController:
         self._buckets: Dict[str, TokenBucket] = {}
         self._brownout = False
         self._over_since: Optional[float] = None
+        self._offered = 0
         self._admitted = 0
         self._shed = 0
         self._shed_by_ns: Dict[str, int] = {}
@@ -268,6 +269,9 @@ class AdmissionController:
         protected = (ev.priority >= self.protect_priority
                      or ev.type == JOB_TYPE_CORE)
         with self._lock:
+            # every offer is either admitted or shed — the invariant
+            # harness checks offered == admitted + shed holds exactly
+            self._offered += 1
             self._track_overload_locked(ready_count, now)
             if protected:
                 self._admitted += 1
@@ -326,6 +330,7 @@ class AdmissionController:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "offered": self._offered,
                 "admitted": self._admitted,
                 "shed": self._shed,
                 "shed_by_namespace": dict(self._shed_by_ns),
@@ -446,6 +451,64 @@ class RegionServingState:
             <= budget_s
 
 
+class WanLatencyModel:
+    """Modeled per-region-pair WAN round-trip latency, seeded jitter.
+
+    Cross-region placement in the real federation pays a WAN RPC
+    before the eval lands in the remote broker; the router's SLO math
+    and the `--multiregion` bench leg should pay that cost too, or
+    spillover looks free and the router over-spills.  Latency is
+    symmetric per unordered pair, zero within a region, and jittered
+    from a seeded RNG so two runs with the same seed see identical
+    delay sequences (the chaos plane's determinism rule: no wall
+    clocks, no unseeded randomness).
+
+    `expected()` is the jitter-free base — what the ROUTING decision
+    subtracts from the SLO budget when weighing a remote region.
+    `sample()` draws one jittered delay — what the SIMULATION adds to
+    an eval's completion time after routing."""
+
+    def __init__(self, default_s: float = 0.08, jitter: float = 0.25,
+                 seed: int = 0x3A21):
+        import random as _random
+        self.default_s = float(default_s)
+        self.jitter = float(jitter)
+        self._pairs: Dict[frozenset, float] = {}
+        self._rng = _random.Random(seed)
+        self._lock = threading.Lock()
+        self._samples = 0
+
+    def set_pair(self, a: str, b: str, base_s: float) -> None:
+        with self._lock:
+            self._pairs[frozenset((str(a), str(b)))] = float(base_s)
+
+    def expected(self, src: Optional[str], dst: str) -> float:
+        """Jitter-free base latency for routing math (0 in-region or
+        when the source region is unknown — no WAN hop to model)."""
+        if not src or src == dst:
+            return 0.0
+        with self._lock:
+            return self._pairs.get(frozenset((str(src), str(dst))),
+                                   self.default_s)
+
+    def sample(self, src: Optional[str], dst: str) -> float:
+        """One jittered delay draw for the latency simulation."""
+        base = self.expected(src, dst)
+        if base <= 0.0:
+            return 0.0
+        with self._lock:
+            self._samples += 1
+            return base * (1.0 + self.jitter
+                           * (2.0 * self._rng.random() - 1.0))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"default_s": self.default_s, "jitter": self.jitter,
+                    "pairs": {"|".join(sorted(k)): v
+                              for k, v in self._pairs.items()},
+                    "samples": self._samples}
+
+
 class SpilloverRouter:
     """Admission-tier cross-region spillover (ISSUE 13).
 
@@ -479,7 +542,7 @@ class SpilloverRouter:
 
     def __init__(self, regions: Optional[Dict[str, float]] = None,
                  overrides: Optional[dict] = None,
-                 directory=None, event_log=None):
+                 directory=None, event_log=None, wan_model=None):
         o = overrides or {}
         k = {}
         for name, (env, typ, default) in self.KNOBS.items():
@@ -495,6 +558,10 @@ class SpilloverRouter:
         self.default_cost = k["region_cost"]
         self.max_pending = k["max_pending"]
         self.directory = directory
+        #: optional WanLatencyModel — when set, remote candidates are
+        #: judged against the SLO budget minus the modeled WAN hop, and
+        #: wan_delay() lets simulations charge the jittered transfer
+        self.wan_model = wan_model
         if event_log is None:
             from ..utils.tracing import global_mesh_events
             event_log = global_mesh_events
@@ -580,8 +647,12 @@ class SpilloverRouter:
         if home_rs is not None and not home_rs.browned_out() \
                 and home_rs.meets_slo(n_evals, budget):
             return self._picked(home_rs, "home")
+        # remote candidates must clear SLO with the modeled WAN hop
+        # already spent — otherwise spillover looks free and a distant
+        # region wins over a slightly-loaded near one
         fits = [rs for rs in live if not rs.browned_out()
-                and rs.meets_slo(n_evals, budget)]
+                and rs.meets_slo(n_evals,
+                                 budget - self._wan_s(home, rs.name))]
         if fits:
             cause = "cheapest" if home_rs is None else "spillover"
             return self._picked(fits[0], cause)
@@ -601,6 +672,19 @@ class SpilloverRouter:
                               home=home or "", depth=len(
                                   self._shed_lane))
         return None, "shed"
+
+    def _wan_s(self, home: Optional[str], region: str) -> float:
+        if self.wan_model is None:
+            return 0.0
+        return self.wan_model.expected(home, region)
+
+    def wan_delay(self, src: Optional[str], dst: str) -> float:
+        """One jittered WAN transfer-delay draw for the chosen route
+        (0 without a model or for in-region placement) — charged by
+        the latency simulation, not by routing."""
+        if self.wan_model is None:
+            return 0.0
+        return self.wan_model.sample(src, dst)
 
     def _picked(self, rs: RegionServingState,
                 cause: str) -> Tuple[str, str]:
@@ -656,7 +740,10 @@ class SpilloverRouter:
                        "model_observations":
                            rs.model.observations()}
                 for name, rs in self._regions.items()}
-        return {"slo_budget_s": self.slo_budget_s,
-                "spill_margin": self.spill_margin,
-                "routed": counts, "shed_lane_depth": shed_depth,
-                "regions": regions}
+        out = {"slo_budget_s": self.slo_budget_s,
+               "spill_margin": self.spill_margin,
+               "routed": counts, "shed_lane_depth": shed_depth,
+               "regions": regions}
+        if self.wan_model is not None:
+            out["wan"] = self.wan_model.stats()
+        return out

@@ -7,6 +7,7 @@ Planner (scheduler/scheduler.go:106).
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional, Tuple
 
@@ -14,6 +15,8 @@ from ..scheduler.base import new_scheduler
 from ..structs import Evaluation, Plan, PlanResult
 
 DEQUEUE_TIMEOUT_S = 0.2
+
+_log = logging.getLogger(__name__)
 
 
 class Worker(threading.Thread):
@@ -89,9 +92,14 @@ class Worker(threading.Thread):
             t0 = _t.monotonic()
             try:
                 self._run_batch(serving, batch)
-            except Exception:
+            except Exception as exc:
                 # a poisoned eval must not kill the worker; the nack path
-                # redelivers it until the delivery limit parks it
+                # redelivers it until the delivery limit parks it — but
+                # the failure must be visible (ROBUST701): a storm of
+                # silent nacks looks exactly like a healthy idle worker
+                _log.warning("batch of %d eval(s) failed: %s",
+                             len(batch), exc)
+                _m.incr_counter("worker.batch_error")
                 for ev, token in batch:
                     self.server.broker.nack(ev.id, token)
             if serving is not None:

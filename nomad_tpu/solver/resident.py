@@ -595,6 +595,17 @@ class ResidentSolver:
                     [np.concatenate([r, p]) for r, p in zip(rows, pads)])
 
         if nd.touches_nodes():
+            from ..chaos.injection import global_injections
+            inj = global_injections.get("delta_row")
+            if inj is not None:
+                # chaos site "delta_row" (ISSUE 14): corrupt the
+                # device-bound scatter rows AFTER the host template took
+                # the clean apply — the planes diverge silently until a
+                # checksum audit (check_plane_checksums) catches it
+                inj.fire()
+                k = min(int(inj.args.get("rows", 1)), nd.avail.shape[0])
+                nd.avail = nd.avail.copy()
+                nd.avail[:k] += 1.0
             dn = self._dev_node
             idx, (r_avail, r_res, r_valid, r_dc, r_attr, r_dev) = _pad(
                 nd.idx, [nd.avail, nd.reserved, nd.valid,
@@ -1244,13 +1255,30 @@ class ResidentSolver:
         for fn in (_stream_kernel, _parallel_kernel):
             try:
                 total += fn._cache_size()
-            except Exception:
+            except (AttributeError, TypeError):
+                # jax version without the _cache_size probe
                 return -1
         return total
 
     def usage(self) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch the carried device usage (one sync — call sparingly)."""
         return np.asarray(self._used), np.asarray(self._dev_used)
+
+    def plane_checksum(self) -> int:
+        """Fingerprint the DEVICE-resident node planes (one fetch —
+        call at quiesce points only).  Must equal
+        tensorize.template_checksum(self.template) whenever the mesh
+        is healthy: the delta-scatter path, a repack, and an elastic
+        recover all have to land the device planes bit-identical to
+        the raft-fed host template (ISSUE 14 invariant harness)."""
+        from .tensorize import plane_crc
+        t = self.template
+        dn = self._dev_node
+        meta = f"{t.n_real}:{','.join(t.node_ids)}".encode()
+        return plane_crc(dn["avail"], dn["reserved"], dn["valid"],
+                         dn["node_dc"], dn["attr_rank"], dn["dev_cap"],
+                         ev_prio=dn.get("ev_prio"),
+                         ev_res=dn.get("ev_res"), meta=meta)
 
     def reset_usage(self, used0: Optional[np.ndarray] = None,
                     dev_used0: Optional[np.ndarray] = None) -> None:

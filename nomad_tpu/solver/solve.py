@@ -930,13 +930,38 @@ def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
     # "auto" resolves to the pallas fused wave on TPU backends (or when
     # NOMAD_TPU_PALLAS forces it) and to the unfused kernel otherwise —
     # placement-identical either way (tests/test_pallas_kernel.py)
+    host_ev_kw = dict(ev_kw)
     if ev_kw:
         # the eviction pass statically asserts no distinct batches;
         # the check above established it for this batch
         ev_kw["has_distinct"] = False
-    return solve_kernel(*_kernel_args(pb), has_spread=has_spread,
-                        pallas_mode=pallas, max_waves=max_waves,
-                        **ev_kw)
+
+    def _device():
+        from ..chaos.injection import global_injections
+        inj = global_injections.get("device_solve")
+        if inj is not None:
+            inj.fire()
+        res = solve_kernel(*_kernel_args(pb), has_spread=has_spread,
+                           pallas_mode=pallas, max_waves=max_waves,
+                           **ev_kw)
+        # materialize under the watchdog deadline: an async dispatch
+        # that only wedges at a later fetch would escape it
+        _np.asarray(res.choice)
+        return res
+
+    from .watchdog import global_watchdog
+    if not global_watchdog.enabled:
+        return _device()
+
+    def _host():
+        from .host import host_solve_kernel
+        return host_solve_kernel(*_kernel_args(pb),
+                                 has_spread=has_spread,
+                                 max_waves=max_waves, **host_ev_kw)
+
+    res, _backend = global_watchdog.run(
+        _device, _host, label=f"solve:{pb.n_asks}x{pb.n_real}")
+    return res
 
 
 def _kernel_args(pb: PackedBatch):

@@ -425,6 +425,44 @@ def _apply_evict_delta(template: PackedBatch, nd: NodeDelta) -> None:
     apply_evict_ops(template, nd.alloc_stop, nd.alloc_place)
 
 
+# ---------------------------------------------- plane epoch checksums
+# ISSUE 14: a cheap, order-stable fingerprint over the node-axis
+# planes.  The same function computed on the host template and on the
+# arrays fetched back from device must agree at every healthy quiesce
+# point — this is the invariant harness's post-recovery check that a
+# reshard/rebuild restored EXACTLY the raft-fed state.
+
+def plane_crc(avail, reserved, valid, node_dc, attr_rank, dev_cap,
+              ev_prio=None, ev_res=None, meta: bytes = b"") -> int:
+    """CRC32 over the node-side planes in a fixed order.  `valid` is
+    canonicalized to uint8 so host bools and fetched device bools hash
+    identically."""
+    import zlib
+    crc = zlib.crc32(meta)
+    arrs = [np.ascontiguousarray(np.asarray(avail)),
+            np.ascontiguousarray(np.asarray(reserved)),
+            np.ascontiguousarray(np.asarray(valid).astype(np.uint8)),
+            np.ascontiguousarray(np.asarray(node_dc)),
+            np.ascontiguousarray(np.asarray(attr_rank)),
+            np.ascontiguousarray(np.asarray(dev_cap))]
+    if ev_prio is not None:
+        arrs.append(np.ascontiguousarray(np.asarray(ev_prio)))
+        arrs.append(np.ascontiguousarray(np.asarray(ev_res)))
+    for a in arrs:
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc
+
+
+def template_checksum(template: PackedBatch) -> int:
+    """Fingerprint of a template's node-side planes (the raft-fed
+    source of truth).  Compare with ResidentSolver.plane_checksum()."""
+    t = template
+    meta = f"{t.n_real}:{','.join(t.node_ids)}".encode()
+    return plane_crc(t.avail, t.reserved, t.valid, t.node_dc,
+                     t.attr_rank, t.dev_cap, ev_prio=t.ev_prio,
+                     ev_res=t.ev_res, meta=meta)
+
+
 # ------------------------------------------------- elastic tile layout
 # ISSUE 8: the elastic mesh owns the node axis in TILES of `tile_np`
 # slots routed by an owner remap table instead of contiguous

@@ -1,0 +1,177 @@
+"""Solve watchdog: a deadline on device dispatch with a bit-identical
+host-twin failover (ISSUE 14).
+
+The device kernel and the numpy host twin produce placement-identical
+results (tests/test_host_solver.py), which makes a stuck or wedged
+device dispatch recoverable WITHOUT changing any answer: run the
+device call on a worker thread with a deadline; on expiry abandon it,
+answer from the host twin, quarantine the device path, and re-probe
+it with capped jittered exponential backoff.  Every transition lands
+in the mesh event log and the flight recorder, and counters surface
+through MetricsRegistry.
+
+Disabled by default (deadline None -> the device call runs inline,
+zero overhead).  Enable per-instance or fleet-wide via
+``NOMAD_TPU_SOLVE_DEADLINE_S``.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+_ENV_DEADLINE = "NOMAD_TPU_SOLVE_DEADLINE_S"
+
+
+def _env_deadline() -> Optional[float]:
+    raw = os.environ.get(_ENV_DEADLINE, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+class SolveWatchdog:
+    """Wraps one device dispatch site.  Thread-safe: concurrent solves
+    share the quarantine state under a lock; the device probe after
+    backoff is claimed by exactly one caller."""
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 base_backoff_s: float = 0.1,
+                 max_backoff_s: float = 5.0,
+                 seed: int = 0x5EED,
+                 event_log=None, tracer=None, metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_deadline())
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.quarantined = False
+        self._failures = 0            # consecutive deadline expiries
+        self._probe_at = 0.0          # next device re-probe time
+        self._probing = False         # a caller holds the probe claim
+        if event_log is None:
+            from ..utils.tracing import global_mesh_events
+            event_log = global_mesh_events
+        if tracer is None:
+            from ..utils.tracing import global_tracer
+            tracer = global_tracer
+        if metrics is None:
+            from ..utils.metrics import global_metrics
+            metrics = global_metrics
+        self.event_log = event_log
+        self.tracer = tracer
+        self.metrics = metrics
+
+    # ------------------------------------------------------------ state
+    @property
+    def enabled(self) -> bool:
+        return self.deadline_s is not None
+
+    def _claim_probe(self) -> bool:
+        """True when this caller should try the device again: either
+        healthy, or quarantined with the backoff elapsed (one caller
+        wins the probe; the rest stay on the host twin)."""
+        with self._lock:
+            if not self.quarantined:
+                return True
+            if self._probing or self._clock() < self._probe_at:
+                return False
+            self._probing = True
+            return True
+
+    def _note_success(self) -> None:
+        with self._lock:
+            was = self.quarantined
+            self.quarantined = False
+            self._failures = 0
+            self._probing = False
+        if was:
+            self.metrics.incr_counter("watchdog.recovered")
+            self.event_log.record("watchdog.recovered")
+
+    def _note_expiry(self, label: str, waited_s: float) -> None:
+        with self._lock:
+            self._failures += 1
+            fails = self._failures
+            self.quarantined = True
+            self._probing = False
+            # capped jittered exponential backoff before the next
+            # device probe; jitter decorrelates a fleet of workers
+            # re-probing a shared device
+            delay = min(self.max_backoff_s,
+                        self.base_backoff_s * (2 ** (fails - 1)))
+            delay *= 0.5 + self._rng.random() / 2.0
+            self._probe_at = self._clock() + delay
+        self.metrics.incr_counter("watchdog.expired")
+        self.metrics.set_gauge("watchdog.consecutive_failures",
+                               float(fails))
+        self.event_log.record("watchdog.failover", label=label,
+                              waited_s=round(waited_s, 4),
+                              failures=fails,
+                              retry_in_s=round(delay, 4))
+        self.tracer.event(label or "solve", "watchdog.failover",
+                          waited_s=round(waited_s, 4), failures=fails)
+
+    # -------------------------------------------------------------- run
+    def run(self, device_fn: Callable[[], object],
+            host_fn: Callable[[], object], label: str = ""):
+        """Answer from `device_fn` under the deadline, falling back to
+        the bit-identical `host_fn`.  Returns (result, backend) where
+        backend is "device", "host_failover" (this call expired) or
+        "host_quarantine" (an earlier expiry, backoff not elapsed).
+
+        `device_fn` must BLOCK until its result is materialized
+        (dispatch + fetch) — an async handle that only hangs at a
+        later fetch would escape the deadline."""
+        if not self.enabled:
+            return device_fn(), "device"
+        if not self._claim_probe():
+            self.metrics.incr_counter("watchdog.host_quarantine")
+            return host_fn(), "host_quarantine"
+
+        box: dict = {}
+        done = threading.Event()
+
+        def _runner():
+            try:
+                box["result"] = device_fn()
+            except BaseException as e:       # noqa: BLE001 — relayed
+                box["error"] = e
+            done.set()
+
+        t0 = self._clock()
+        t = threading.Thread(target=_runner, daemon=True,
+                             name="solve-watchdog")
+        t.start()
+        if done.wait(self.deadline_s) and "result" in box:
+            self._note_success()
+            return box["result"], "device"
+        waited = self._clock() - t0
+        if "error" in box:
+            # the device path died rather than hung: same failover
+            # (quarantine + host answer), but record the cause
+            self.event_log.record("watchdog.device_error",
+                                  label=label,
+                                  error=repr(box["error"]))
+        self._note_expiry(label, waited)
+        self.metrics.incr_counter("watchdog.host_failover")
+        return host_fn(), "host_failover"
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled,
+                    "deadline_s": self.deadline_s,
+                    "quarantined": self.quarantined,
+                    "consecutive_failures": self._failures}
+
+
+#: process-wide watchdog consulted by solve.py's _run_kernel; disabled
+#: unless NOMAD_TPU_SOLVE_DEADLINE_S is set or a harness configures it
+global_watchdog = SolveWatchdog()

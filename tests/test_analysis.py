@@ -679,6 +679,55 @@ void score_all(int n) {
 }
 """
 
+FIX_ROBUST = """
+    import logging
+    import socket
+
+    _log = logging.getLogger(__name__)
+
+
+    def bad_swallow(sock):
+        try:
+            sock.send(b"x")
+        except Exception:
+            pass
+
+
+    def bad_bare(sock):
+        try:
+            sock.send(b"x")
+        except:
+            pass
+
+
+    def good_narrow(sock):
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+    def good_logged(sock):
+        try:
+            sock.send(b"x")
+        except Exception:
+            _log.warning("send failed")
+
+
+    def good_reraise(sock):
+        try:
+            sock.send(b"x")
+        except Exception:
+            raise
+
+
+    def good_bound_use(sock, sink):
+        try:
+            sock.send(b"x")
+        except Exception as e:
+            sink.last_error = str(e)
+"""
+
 FIX_SCORER_SITES = (
     ScorerSite("host", "python", "fixpkg.score_host:host_scores"),
     ScorerSite("shortlist", "python", "fixpkg.score_sl:sl_scores"),
@@ -698,6 +747,7 @@ FIX_FILES = {
     "score_sl.py": FIX_SCORE_SL,
     "score_rogue.py": FIX_SCORE_ROGUE,
     "native_score.cc": FIX_SCORE_CC,
+    "recov.py": FIX_ROBUST,
 }
 
 FIX_CFG = AnalysisConfig(
@@ -707,6 +757,7 @@ FIX_CFG = AnalysisConfig(
     lock_module_prefixes=("fixpkg",),
     scatter_helpers=(),
     scorer_sites=FIX_SCORER_SITES,
+    robust_module_prefixes=("fixpkg",),
 )
 
 
@@ -1020,6 +1071,35 @@ def test_score_stale_registry_site_reported(tmp_path):
 
 
 # ----------------------------------------------------- baseline rules
+# ------------------------------------------------------- robust pass
+def test_robust_swallowed_exception_detected(fixture_report):
+    keys = _keys(fixture_report, "ROBUST701")
+    assert "ROBUST701:fixpkg.recov:bad_swallow:Exception" in keys
+    assert "ROBUST701:fixpkg.recov:bad_bare:bare" in keys
+
+
+def test_robust_handled_twins_quiet(fixture_report):
+    """Narrow except, logged, re-raised and bound-and-used handlers
+    must stay quiet — only silent broad catches fire."""
+    keys = _keys(fixture_report, "ROBUST701")
+    assert not any(":good_" in k for k in keys), keys
+
+
+def test_robust_error_tier():
+    from nomad_tpu.analysis import pass_of, severity_of
+    assert severity_of("ROBUST701") == "error"
+    assert pass_of("ROBUST701") == "robust"
+
+
+def test_repo_robust_zero_unsuppressed():
+    """The recovery-critical planes carry zero unsuppressed swallowed
+    exceptions; deliberate probe/trace fallbacks are baselined with
+    justifications."""
+    rep = analyze()
+    bad = [f for f in rep.findings if f.rule.startswith("ROBUST")]
+    assert not bad, "\n".join(f.render() for f in bad)
+
+
 def test_baseline_requires_justification():
     with pytest.raises(BaselineError):
         parse_baseline_text(
