@@ -1990,6 +1990,179 @@ def run_tracing_overhead(n_nodes=10_000, count=64, resident=100_000,
     return out
 
 
+def run_telemetry_overhead(n_nodes=10_000, count=64, resident=100_000,
+                           batch=32, iters=24, reps=9, warmup=4,
+                           sample_every=5, churn_steps=8,
+                           write_detail=True):
+    """Telemetry leg (ISSUE 15 acceptance): steady-state solve wall
+    with the fleet health kernel sampling every `sample_every` solves
+    vs never, at config-3 scale (10K nodes, 100K resident allocs,
+    count-64 asks).
+
+    The sampled leg is deliberately harsher than production: at this
+    scale the stream runs ~10 solves/s, so sample_every=5 is ~2 Hz —
+    roughly 10x the server's shipped duty cycle (one sample per
+    HEALTH_SAMPLE_EVERY=5 export beats, i.e. per 5 s).  The record
+    also carries the measured per-sample unit cost
+    (health_sample_cost_ms, ~2 ms at this scale: on the CPU backend
+    the kernel serializes with solves on one XLA stream, so the unit
+    cost IS the kernel wall) so any cadence's overhead can be read
+    off directly.  Legs interleave per rep so transport/CPU drift
+    cancels; min-of-reps isolates the systematic cost from
+    shared-CPU noise (same floor treatment as the tracing leg
+    above).
+
+    A second churn phase strands CPU on a growing fraction of nodes
+    (plenty of memory/disk free, but less CPU than the smallest probe
+    ask needs) and records the fragmentation-index trajectory the
+    health plane reports, through a real TimeSeriesStore ring so the
+    record also proves the series plumbing end to end."""
+    import dataclasses
+
+    import numpy as np
+
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.tensorize import Tensorizer
+    from nomad_tpu.telemetry.health import (device_health_counters,
+                                            device_health_raw,
+                                            fetch_health)
+    from nomad_tpu.telemetry.series import TimeSeriesStore
+
+    nodes = make_nodes(n_nodes)
+    probe_job = make_job(3, 0, count)
+    template_ask = asks_for(probe_job)[0]
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    t0 = time.perf_counter()
+    rs = ResidentSolver(nodes, asks_for(probe_job),
+                        gp=1 << max(0, (gp_need - 1).bit_length()),
+                        kp=1 << max(0, (count * batch - 1)
+                                    .bit_length()),
+                        max_waves=18)
+    used0 = resident_used0(rs.template, n_nodes, resident)
+    rs.reset_usage(used0=used0)
+    asks = [dataclasses.replace(template_ask, count=count)] * batch
+    masks, _keys = rs.merge_asks(asks)
+    pb = rs.pack_batch(masks)
+    rs.solve_stream([pb], seeds=[1])        # compile outside the legs
+    device_health_counters(rs)              # compile the health kernel
+    startup_s = time.perf_counter() - t0
+
+    seq = [0]
+
+    def leg(sample_health):
+        rs.reset_usage(used0=used0)
+        it = [0]
+        # double-buffered sampling, the way a production device-side
+        # sampler runs: dispatch this beat's kernel, materialize the
+        # PREVIOUS beat's (long since done) — a blocking fetch right
+        # after dispatch would charge the stream's in-flight tail to
+        # the sample
+        pending = [None]
+
+        def fetch_pending():
+            if pending[0] is not None:
+                fetch_health(pending[0])
+                pending[0] = None
+
+        def one_iter():
+            seq[0] += 1
+            it[0] += 1
+            rs.solve_stream([pb], seeds=[seq[0]])
+            if sample_health and it[0] % sample_every == 0:
+                fetch_pending()
+                pending[0] = device_health_raw(rs)
+
+        for _ in range(warmup):
+            one_iter()
+        t = time.perf_counter()
+        for _ in range(iters):
+            one_iter()
+        fetch_pending()
+        return time.perf_counter() - t
+
+    walls_off, walls_on = [], []
+    for _rep in range(reps):
+        walls_off.append(leg(False))
+        walls_on.append(leg(True))
+    off = min(walls_off)
+    on = min(walls_on)
+    overhead_pct = 100.0 * (on - off) / max(off, 1e-9)
+
+    # ---- churn phase: stranded-CPU fragmentation trajectory --------
+    # The smallest config-3 group asks 400 CPU; leaving 350 free makes
+    # a node un-placeable while its memory/disk headroom stays large —
+    # the classic fragmentation picture the index is built to surface.
+    avail = np.asarray(rs.template.avail, np.float32)
+    # start at t=1: the points() cursor is bucket_start > since and the
+    # default since is 0, which would hide a bucket starting at 0
+    fake_t = [1.0]
+    churn_store = TimeSeriesStore(resolutions=((1, 4 * churn_steps),),
+                                  clock=lambda: fake_t[0])
+    traj = []
+    for step in range(churn_steps + 1):
+        frac = step / churn_steps
+        n_churn = int(frac * n_nodes)
+        churned = used0.copy()
+        if n_churn:
+            churned[:n_churn, 0] = np.maximum(
+                avail[:n_churn, 0] - 350.0, churned[:n_churn, 0])
+        rs.reset_usage(used0=churned)
+        hc = device_health_counters(rs)
+        frag = hc.fragmentation_index()
+        traj.append({"churn_frac": round(frac, 3),
+                     "fragmentation_index": round(frag, 4),
+                     "nodes_stranded": hc.nodes_stranded,
+                     "nodes_busy": hc.nodes_busy})
+        churn_store.record("health.fragmentation_index", frag,
+                           now=fake_t[0])
+        fake_t[0] += 1.0
+    churn_store.flush(now=fake_t[0])
+    ring = churn_store.points("health.fragmentation_index", res=1)
+    frags = [p["fragmentation_index"] for p in traj]
+    # samples landing inside the timed window (iteration counter spans
+    # warmup too, so the modulo grid does not restart at the timer)
+    n_samples = len([i for i in range(warmup + 1, warmup + iters + 1)
+                     if i % sample_every == 0])
+    out = {
+        "phase": "telemetry",
+        "n_nodes": n_nodes, "count": count, "resident": resident,
+        "batch": batch, "iters": iters, "reps": reps,
+        "sample_every": sample_every,
+        "startup_s": round(startup_s, 2),
+        "unsampled_wall_s": [round(w, 4) for w in walls_off],
+        "sampled_wall_s": [round(w, 4) for w in walls_on],
+        "unsampled_evals_per_sec": round(batch * iters / off, 1),
+        "sampled_evals_per_sec": round(batch * iters / on, 1),
+        "overhead_pct": round(overhead_pct, 3),
+        "health_samples_per_leg": n_samples,
+        "health_sample_cost_ms": round(
+            1000.0 * (on - off) / max(n_samples, 1), 3),
+        "fragmentation_trajectory": traj,
+        "series_ring_points": len(ring),
+        "acceptance": {
+            "telemetry_within_2pct": overhead_pct <= 2.0,
+            "fragmentation_monotone": all(
+                b >= a - 1e-9 for a, b in zip(frags, frags[1:])),
+            "fragmentation_rises": frags[-1] > frags[0] + 0.25,
+            "ring_kept_every_sample": len(ring) == churn_steps + 1,
+        },
+    }
+    out["ok"] = all(out["acceptance"].values())
+    if write_detail:
+        # merge into BENCH_DETAIL.json preserving the other phases
+        path = os.path.join(REPO, "BENCH_DETAIL.json")
+        try:
+            with open(path) as f:
+                detail = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            detail = {}
+        detail["telemetry"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+    return out
+
+
 def measure_transport_rtt():
     """Median fixed round-trip of a trivial device call + result fetch:
     the per-call floor this transport imposes regardless of work."""
@@ -2712,6 +2885,13 @@ def main():
         out = run_tracing_overhead()
         print("\x1e" + json.dumps(out))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--telemetry":
+        # subprocess mode: the health-kernel/fragmentation phase
+        # (ISSUE 15) — merges its record into BENCH_DETAIL.json under
+        # "telemetry"
+        out = run_telemetry_overhead()
+        print("\x1e" + json.dumps(out))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--quality-sweep":
         out = run_quality_sweep()
         with open(os.path.join(REPO, "QUALITY_SWEEP.json"), "w") as f:
@@ -2885,6 +3065,26 @@ def main():
         sys.stderr.write(
             f"tracing phase failed rc={tr.returncode}:\n"
             f"{(tr.stderr or '')[-1500:]}\n")
+    # telemetry phase (ISSUE 15) in its own subprocess: same config-3
+    # scale resident world as tracing; measures the health kernel's
+    # steady-state cost and the churn fragmentation trajectory
+    telemetry = None
+    tm = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--telemetry"],
+        capture_output=True, text=True)
+    for line in tm.stdout.splitlines():
+        if line.startswith("\x1e"):
+            try:
+                telemetry = json.loads(line[1:])
+            except json.JSONDecodeError:
+                telemetry = None
+    if telemetry is None:
+        telemetry = {"phase": "telemetry", "skipped": True,
+                     "rc": tm.returncode,
+                     "tail": (tm.stderr or tm.stdout)[-1500:]}
+        sys.stderr.write(
+            f"telemetry phase failed rc={tm.returncode}:\n"
+            f"{(tm.stderr or '')[-1500:]}\n")
     detail = {"configs": results,
               "transport_rtt_ms": round(1000 * rtt, 1),
               "multichip": multichip,
@@ -2892,6 +3092,7 @@ def main():
               "open_loop": open_loop,
               "overcommit": overcommit,
               "tracing_overhead": tracing,
+              "telemetry": telemetry,
               "lint": lint}
     if only is None:
         # multi-seed / multi-shape / both-load sweep (30 duels): the

@@ -1,6 +1,6 @@
 """nomadlint: static invariant analyzer for the nomad_tpu package.
 
-Seven passes over a module-level call graph plus a dataflow layer
+Eight passes over a module-level call graph plus a dataflow layer
 (def-use chains, buffer-identity provenance, interprocedural
 summaries — see dataflow.py). No analyzed module is ever imported:
 everything is `ast` on source text, so the analyzer runs without JAX
@@ -38,6 +38,10 @@ or a device.
     must re-raise, use the bound error, or surface it through
     logging/metrics — silent drops turn injected faults (chaos plane,
     ISSUE 14) into undetected state divergence.
+  * observability hygiene (obs_pass): metric/series names must be
+    lowercase dotted paths under a registered namespace (OBS801);
+    names built at runtime are unbounded-cardinality hazards (OBS802,
+    warn) that must carry a baseline justification naming the bound.
 
 Checked-in suppressions live in baseline.toml next to this file; every
 entry must carry a non-empty justification. Run `python -m
@@ -90,6 +94,7 @@ def analyze(package_dir: Optional[str] = None,
     from .alias_pass import run_alias_pass
     from .score_pass import run_score_pass
     from .robust_pass import run_robust_pass
+    from .obs_pass import run_obs_pass
     from .dataflow import DataflowEngine
 
     package_dir = package_dir or _PKG_DIR
@@ -112,6 +117,7 @@ def analyze(package_dir: Optional[str] = None,
     findings += run_alias_pass(index, cfg, engine, prior=findings)
     findings += run_score_pass(index, cfg, package_dir=package_dir)
     findings += run_robust_pass(index, cfg)
+    findings += run_obs_pass(index, cfg)
     if only_files is not None:
         findings = [f for f in findings
                     if f.rule not in ("SCORE603", "SCORE604")

@@ -24,14 +24,14 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # severity tiers: "error" findings gate CI (exit 1); "warn" findings
 # are advisory heuristics (exit 3 when they are the only findings).
 # Everything not listed here is an error.
-WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503"})
+WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503", "OBS802"})
 
 # rule-id prefix -> pass name (used by --json/by_pass and bench's
 # lint_summary so BENCH_DETAIL records per-pass lint state)
 RULE_PASSES: Tuple[Tuple[str, str], ...] = (
     ("FSM", "fsm"), ("JIT", "jit"), ("LOCK", "lock"),
     ("SHARD", "shard"), ("ALIAS", "alias"), ("SCORE", "score"),
-    ("ROBUST", "robust"),
+    ("ROBUST", "robust"), ("OBS", "obs"),
 )
 
 
@@ -173,6 +173,19 @@ class AnalysisConfig:
     robust_module_prefixes: Tuple[str, ...] = (
         "nomad_tpu.raft", "nomad_tpu.rpc", "nomad_tpu.server",
         "nomad_tpu.parallel", "nomad_tpu.solver",
+    )
+    # OBS8xx: metric/series name hygiene.  Names must be lowercase
+    # dotted paths whose first segment (the namespace) is registered
+    # here; dynamically-built names are cardinality hazards (OBS802,
+    # warn) that carry a baseline justification naming the bound.
+    obs_metric_prefixes: Tuple[str, ...] = (
+        "broker", "health", "mesh", "metrics", "plan", "rpc",
+        "scheduler", "serving", "slo", "solver", "telemetry",
+        "watchdog", "worker",
+    )
+    # the sinks themselves (name arrives as a parameter there)
+    obs_exclude_modules: Tuple[str, ...] = (
+        "nomad_tpu.utils.metrics", "nomad_tpu.telemetry.series",
     )
 
 

@@ -398,7 +398,8 @@ class HTTPAgentServer:
                 raise HTTPError(403, "agent write permission required")
             return
         if path.startswith("/v1/agent") or path == "/v1/metrics" \
-                or path.startswith(("/v1/trace", "/v1/traces")):
+                or path.startswith(("/v1/trace", "/v1/traces",
+                                    "/v1/telemetry")):
             # traces expose job/placement internals cluster-wide, the
             # same blast radius as /v1/metrics + /v1/agent/monitor:
             # agent read to look, agent write to export to disk
@@ -830,14 +831,56 @@ class HTTPAgentServer:
 
     def agent_events(self, q, body):
         """/v1/agent/events — the mesh event log (elastic grow/shrink/
-        move/fail/recover transitions with measured bytes/durations)."""
+        move/fail/recover transitions with measured bytes/durations).
+        `?since_seq=N` pages by cursor: only events with seq > N, plus
+        the log's `last_seq` so pollers resume without overlap."""
         from ..utils.tracing import global_mesh_events
         try:
             limit = int(q.get("limit", 256))
+            since_seq = int(q.get("since_seq", 0))
         except ValueError:
-            raise HTTPError(400, "limit must be an integer")
-        return 200, {"events": global_mesh_events.events(
-            limit, kind=q.get("kind") or None)}, None
+            raise HTTPError(400, "limit/since_seq must be integers")
+        return 200, {
+            "events": global_mesh_events.events(
+                limit, kind=q.get("kind") or None,
+                since_seq=since_seq),
+            "last_seq": global_mesh_events.last_seq}, None
+
+    # ------------------------------------------------- telemetry plane
+    def telemetry_health(self, q, body):
+        """/v1/telemetry/health — the latest fleet health report
+        (server telemetry tick) plus the serving-tier SLO status and
+        the recorder/series bookkeeping."""
+        from ..telemetry.series import global_series
+        from ..utils.tracing import global_tracer
+        serving = getattr(self.server, "serving", None)
+        health_fn = getattr(self.server, "last_health", None)
+        return 200, {
+            "health": health_fn() if callable(health_fn) else None,
+            "serving": serving.stats() if serving is not None else None,
+            "tracer": global_tracer.stats(),
+            "series": global_series.stats(),
+        }, None
+
+    def telemetry_series(self, q, body):
+        """/v1/telemetry/series?name=&res=&since= — one named series
+        from the multi-resolution ring (bucket starts > since)."""
+        from ..telemetry.series import global_series
+        name = q.get("name", "")
+        if not name:
+            return 200, {"names": global_series.names()}, None
+        try:
+            res = int(q.get("res", 1))
+            since = float(q.get("since", 0))
+        except ValueError:
+            raise HTTPError(400, "res/since must be numeric")
+        try:
+            points = global_series.points(name, res=res, since=since)
+        except KeyError:
+            raise HTTPError(
+                400, f"unknown resolution {res}s (configured: "
+                     f"{[r for r, _ in global_series.resolutions]})")
+        return 200, {"name": name, "res": res, "points": points}, None
 
     # ----------------------------------------------- agent monitor/pprof
     def handle_monitor(self, handler) -> None:
@@ -1847,6 +1890,8 @@ def _build_routes(s: HTTPAgentServer):
                                     "PUT": s.trace_corpus}),
         (R(r"^/v1/trace/([^/]+)$"), {"GET": s.trace_get}),
         (R(r"^/v1/agent/events$"), {"GET": s.agent_events}),
+        (R(r"^/v1/telemetry/health$"), {"GET": s.telemetry_health}),
+        (R(r"^/v1/telemetry/series$"), {"GET": s.telemetry_series}),
         (R(r"^/v1/system/gc$"), {"PUT": s.system_gc,
                                  "POST": s.system_gc}),
         (R(r"^/v1/operator/scheduler/configuration$"),

@@ -340,6 +340,64 @@ class FederatedResidentSolver:
         self._used = jax.device_put(np.array(used0))
         self._dev_used = jax.device_put(np.array(dev_used0))
 
+    # ---------------- health (ISSUE 15) ----------------
+    def health_counters(self):
+        """Union-fleet health in ONE kernel call: the [R, Np, ...]
+        region stacks flatten to a single [R*Np, ...] node axis (the
+        health reduction is a sum over nodes, so region boundaries
+        are irrelevant — regions already share the attr/dc/device
+        universes by construction).  Bit-identical to merging the
+        per-region host twins."""
+        from ..telemetry.health import (HealthCounters, MAX_NODES,
+                                        _health_kernel)
+        ns = self._node_stack
+        R, Np = ns["valid"].shape
+        if R * Np > MAX_NODES:
+            raise ValueError(
+                f"health kernel split accumulators are i32-safe up "
+                f"to {MAX_NODES} stacked node rows; got {R * Np}")
+        key = ("health_ask_res", 0)
+        ask = self._const_cache.get(key)
+        if ask is None:
+            ask = self._const_cache[key] = jax.device_put(
+                np.asarray(self.solvers[0].template.ask_res,
+                           np.float32))
+        nres = ns["avail"].shape[-1]
+        raw = _health_kernel(
+            ns["avail"].reshape(-1, nres),
+            ns["valid"].reshape(-1),
+            ns["node_dc"].reshape(-1),
+            ns["dev_cap"].reshape(R * Np, -1),
+            self._used.reshape(-1, nres),
+            self._dev_used.reshape(R * Np, -1),
+            ask, None, None, None)
+        return HealthCounters.from_raw(jax.device_get(raw))
+
+    def health_host_twin(self):
+        """Per-region numpy twins, integer-merged — the reference the
+        property tests hold `health_counters` to."""
+        from ..telemetry.health import HealthCounters, health_host
+        used, dev_used = self.usage()
+        out: Optional[HealthCounters] = None
+        for r, s in enumerate(self.solvers):
+            hc = _twin_no_ev(s.template, used[r], dev_used[r])
+            out = hc if out is None else out.merge(hc)
+        return out
+
+
+def _twin_no_ev(template, used, dev_used):
+    """Host twin over a template whose DEVICE stack carries no ev
+    planes (the federated node stack) — mask them off so the twin
+    mirrors what the kernel saw."""
+    from ..telemetry.health import health_host
+    if getattr(template, "ev_prio", None) is None:
+        return health_host(template, used, dev_used)
+    import copy
+    t = copy.copy(template)
+    t.ev_prio = None
+    t.ev_res = None
+    return health_host(t, used, dev_used)
+
 
 # ===================================================================
 # Cross-region scheduling (ISSUE 13)
@@ -486,6 +544,16 @@ class CrossRegionResidentSolver:
 
     def usage(self):
         return self.solver.usage()
+
+    def health_counters(self):
+        """Fleet health over the UNION mesh — the inner elastic
+        solver's kernel runs with its tile-liveness mask, so a
+        region-degraded mesh reports only the device-resident fleet
+        (lost regions' rows drop out, exactly like the solve path)."""
+        return self.solver.health_counters()
+
+    def health_row_mask(self):
+        return self.solver.health_row_mask()
 
     def wave_traffic(self, batches) -> Dict:
         """The full tier stack: HBM + ICI + per-region DCN + the WAN

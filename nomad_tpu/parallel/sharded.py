@@ -1142,6 +1142,31 @@ class ElasticShardedResidentSolver(ShardedResidentSolver):
         du[src[real]] = du_dev[real]
         return u, du
 
+    def _health_live_mask(self):
+        """Device-row liveness for the health kernel (ISSUE 15):
+        retired / lost tile rows keep STALE plane values (including
+        valid=True) because layout fills apply only at put time, so
+        the kernel must mask on tile residency, not the valid plane.
+        Cached per layout epoch — `_src_cache` is replaced (never
+        mutated) on every grow/shrink/move/fail/recover."""
+        src = self._src_cache
+        cache = self.__dict__.get("_health_live_dev")
+        if cache is None or cache[0] is not src:
+            dev = jax.device_put(
+                np.ascontiguousarray(src >= 0),
+                NamedSharding(self._mesh, P(self._axis)))
+            self.__dict__["_health_live_dev"] = cache = (src, dev)
+        return cache[1]
+
+    def health_row_mask(self) -> np.ndarray:
+        """GLOBAL-order row mask of device-resident rows — the host
+        twin's view of what `_health_live_mask` keeps (lost tiles drop
+        out of both)."""
+        src = self._src_cache
+        mask = np.zeros(self.template.avail.shape[0], bool)
+        mask[src[src >= 0]] = True
+        return mask
+
     def solve_stream_async(self, batches, seeds=None):
         if self.mesh_state == "degraded":
             self.reshard_counters["degraded_solves"] += 1

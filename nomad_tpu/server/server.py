@@ -85,6 +85,13 @@ class Server:
         # keeping admission bounded.
         from .serving import ServingTier
         self.serving = ServingTier(overrides=serving_config)
+        # telemetry tick state (ISSUE 15): last counter snapshots for
+        # per-beat rate series + the most recent fleet health report
+        # served at /v1/telemetry/health (assigned whole — readers on
+        # the HTTP thread see either the old or the new dict)
+        self._telemetry_state: Dict[str, float] = {}
+        self._telemetry_lock = threading.Lock()
+        self._last_health: Optional[dict] = None
         self.planner = PlanApplier(self.plan_queue, self.store,
                                    self._apply_plan, self._create_evals,
                                    apply_async_fn=self._apply_plan_async)
@@ -269,9 +276,90 @@ class Server:
     #: server-side broker-gauge export beat (seconds)
     METRICS_EXPORT_INTERVAL_S = 1.0
 
+    #: fleet health sample cadence, in export beats (the host-twin
+    #: reduction walks every node plane; 1 Hz would be wasteful on
+    #: large fleets, 5 s tracks churn fine)
+    HEALTH_SAMPLE_EVERY = 5
+
     def _export_metrics_loop(self) -> None:
+        beats = 0
         while not self._stop_reapers.wait(self.METRICS_EXPORT_INTERVAL_S):
             self.broker.export_metrics()
+            beats += 1
+            try:
+                self._telemetry_tick(beats)
+            except Exception:
+                # telemetry must never kill the export beat — the
+                # broker gauges above are load-bearing for operators
+                from ..utils.metrics import global_metrics as _m
+                _m.incr_counter("telemetry.tick_error")
+
+    def _telemetry_tick(self, beats: int) -> None:
+        """Feed the multi-resolution series store on the export beat
+        (ISSUE 15): broker depth/age, admission rates (counter deltas
+        per beat), mesh event rate, and — every HEALTH_SAMPLE_EVERY
+        beats — a fleet health sample over the worker solver's
+        resident world, published for /v1/telemetry/health."""
+        from ..telemetry.series import global_series as _s
+        from ..utils.metrics import global_metrics as _m
+        from ..utils.tracing import global_mesh_events as _ev
+        st = self._telemetry_state
+        _s.record("broker.ready_depth", float(self.broker.ready_count()))
+        _s.record("broker.oldest_age_s",
+                  float(self.broker.oldest_ready_age()))
+        adm = self.serving.admission.stats()
+
+        def _rate(key: str) -> Optional[float]:
+            cur = float(adm.get(key, 0))
+            prev = st.get("adm_" + key)
+            st["adm_" + key] = cur
+            return None if prev is None else cur - prev
+
+        offered, admitted, shed = (_rate("offered"), _rate("admitted"),
+                                   _rate("shed"))
+        if offered is not None:
+            _s.record("serving.offered_rate", offered)
+        if admitted is not None:
+            _s.record("serving.admitted_rate", admitted)
+        if shed is not None:
+            _s.record("serving.shed_rate", shed)
+        _s.record("serving.brownout",
+                  1.0 if self.serving.admission.brownout_active() else 0.0)
+        seq = _ev.last_seq
+        prev = st.get("mesh_seq")
+        if prev is not None:
+            _s.record("mesh.event_rate", float(seq - prev))
+        st["mesh_seq"] = seq
+        if beats % self.HEALTH_SAMPLE_EVERY != 0 or not self.workers:
+            return
+        solver = self.workers[0]._solver   # sample only an EXISTING
+        if solver is None:                 # solver; never build one here
+            return
+        hc = solver.health_counters()
+        if hc is None:
+            return
+        report = hc.report()
+        report["sampled_at"] = _time.time()
+        with self._telemetry_lock:
+            self._last_health = report
+        _m.set_gauge("health.nodes_busy", float(hc.nodes_busy))
+        _m.set_gauge("health.nodes_stranded", float(hc.nodes_stranded))
+        _m.set_gauge("health.fragmentation_index",
+                     hc.fragmentation_index())
+        _m.set_gauge("health.spread_violations",
+                     float(hc.spread_violations()))
+        _m.set_gauge("health.ev_slots", float(hc.ev_slots))
+        _s.record("health.nodes_busy", float(hc.nodes_busy))
+        _s.record("health.fragmentation_index",
+                  hc.fragmentation_index())
+        _s.record("health.utilization",
+                  float(report["utilization"]))
+
+    def last_health(self) -> Optional[dict]:
+        """Most recent fleet health report from the telemetry tick
+        (None until a resident world exists to sample)."""
+        with self._telemetry_lock:
+            return self._last_health
 
     def _core_job_eval(self, kind: str) -> Evaluation:
         index = self.store.latest_index()

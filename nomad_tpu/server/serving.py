@@ -360,6 +360,16 @@ class ServingTier:
         "brownout_low": ("NOMAD_TPU_BROWNOUT_LOW", float, 0.25),
         "brownout_after_s": ("NOMAD_TPU_BROWNOUT_AFTER_S", float, 1.0),
         "margin": ("NOMAD_TPU_SLO_MARGIN", float, 0.6),
+        # SLO burn-rate accounting (ISSUE 15): the availability
+        # objective over "batch met the p99 latency target", and the
+        # SRE-workbook fast/slow window pair
+        "slo_objective": ("NOMAD_TPU_SLO_OBJECTIVE", float, 0.999),
+        "slo_fast_window_s": ("NOMAD_TPU_SLO_FAST_WINDOW_S", float,
+                              60.0),
+        "slo_fast_burn": ("NOMAD_TPU_SLO_FAST_BURN", float, 14.0),
+        "slo_slow_window_s": ("NOMAD_TPU_SLO_SLOW_WINDOW_S", float,
+                              600.0),
+        "slo_slow_burn": ("NOMAD_TPU_SLO_SLOW_BURN", float, 2.0),
     }
 
     def __init__(self, adaptive: bool = True,
@@ -389,6 +399,27 @@ class ServingTier:
             brownout_high=k["brownout_high"],
             brownout_low=k["brownout_low"],
             brownout_after_s=k["brownout_after_s"])
+        from ..telemetry.slo import SloBurnTracker
+        from ..utils.metrics import global_metrics
+        from ..utils.tracing import global_mesh_events
+        self.burn = SloBurnTracker(
+            objective=k["slo_objective"],
+            fast_window_s=int(k["slo_fast_window_s"]),
+            fast_burn=k["slo_fast_burn"],
+            slow_window_s=int(k["slo_slow_window_s"]),
+            slow_burn=k["slo_slow_burn"],
+            events=global_mesh_events, metrics=global_metrics)
+
+    def observe_batch(self, n_evals: int, wall_s: float) -> None:
+        """One solved batch's SLO verdict: every eval in a batch that
+        lands inside the latency budget is `good`, a blown batch
+        charges all its evals to the error budget (the batch IS the
+        latency unit — its evals waited on the same dispatch)."""
+        n = max(int(n_evals), 1)
+        if wall_s <= self.slo_budget_s:
+            self.burn.observe(good=n)
+        else:
+            self.burn.observe(bad=n)
 
     def stats(self) -> dict:
         return {
@@ -398,6 +429,7 @@ class ServingTier:
             "last_target_batch": self.batch_controller.last_target(),
             "model_observations": self.solve_model.observations(),
             "admission": self.admission.stats(),
+            "slo": self.burn.status(),
         }
 
 

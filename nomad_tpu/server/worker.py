@@ -103,8 +103,16 @@ class Worker(threading.Thread):
                 for ev, token in batch:
                     self.server.broker.nack(ev.id, token)
             if serving is not None:
-                serving.solve_model.observe(len(batch),
-                                            _t.monotonic() - t0)
+                wall = _t.monotonic() - t0
+                serving.solve_model.observe(len(batch), wall)
+                # SLO burn-rate accounting + the first explicit-bucket
+                # histogram users (ISSUE 15): batch solve latency on
+                # the latency bounds, batch size on pow2 count bounds
+                serving.observe_batch(len(batch), wall)
+                _m.observe_hist("worker.solve_latency_s", wall)
+                _m.observe_hist("worker.batch_size", float(len(batch)),
+                                buckets=(1, 2, 4, 8, 16, 32, 64, 128,
+                                         256, 512))
                 _m.set_gauge("serving.last_target_batch", float(target))
                 _m.set_gauge(
                     "serving.brownout",

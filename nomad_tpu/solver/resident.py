@@ -1070,6 +1070,14 @@ class ResidentSolver:
         return {"waves_total": waves, "rescore_waves": resc,
                 "shortlist_waves": waves - resc}
 
+    def health_counters(self):
+        """Fleet health reduction over the RESIDENT planes (ISSUE 15):
+        one kernel dispatch + one fetch, no repack, no host walk.
+        Returns a telemetry.HealthCounters bit-identical to the numpy
+        twin over the same template/usage mirrors."""
+        from ..telemetry.health import device_health_counters
+        return device_health_counters(self)
+
     @staticmethod
     def _has_spread(batches: Sequence[PackedBatch]) -> bool:
         return bool(any((pb.sp_col[:, 0] >= 0).any() for pb in batches))

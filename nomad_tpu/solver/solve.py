@@ -427,6 +427,21 @@ class Solver:
             world = self._world
             return dict(world.counters) if world else None
 
+    def health_counters(self):
+        """Fleet health sample over the resident world's delta-
+        maintained host template (ISSUE 15 telemetry tick).  Uses the
+        numpy twin of the device health kernel — bit-identical by the
+        telemetry property tests — so the server's 1 Hz beat never
+        touches the device.  None while no resident world is active
+        (small clusters host-walk; nothing to sample)."""
+        with self._world_lock:
+            world = self._world
+            if world is None:
+                return None
+            from ..telemetry.health import health_host
+            t = world.template
+            return health_host(t, t.used0, t.dev_used0)
+
     def plan_view(self) -> "PlanSolverView":
         """Facade for dry-run (what-if) schedulers: same resident
         template, overlay-only solves, zero writes to carried state."""

@@ -1,0 +1,29 @@
+"""Cluster health plane (ISSUE 15).
+
+Three layers, one substrate for the rebalancing / autoscaling tiers
+the roadmap has queued behind it:
+
+  * `health` — the device-side fleet reduction (utilization
+    histograms, stranded-capacity fragmentation, busy / per-DC spread
+    accounting, evictable pressure) with its bit-identical numpy twin.
+  * `series` — bounded multi-resolution time-series rings (1s/10s/60s
+    with min/max/sum/count downsampling, JSONL sink).
+  * `slo` — multi-window error-budget burn-rate alerting for the
+    serving tier.
+
+Served over `/v1/telemetry/health` and `/v1/telemetry/series`, and
+merged into the Prometheus exposition via the shared registry.
+"""
+from .health import (HealthCounters, MAX_DC, MAX_NODES, N_EDGES,
+                     UTIL_EDGES, device_health_counters, health_host,
+                     tier_bytes)
+from .series import (DEFAULT_RESOLUTIONS, TimeSeriesStore, global_series,
+                     open_sink)
+from .slo import SloBurnTracker
+
+__all__ = [
+    "DEFAULT_RESOLUTIONS", "HealthCounters", "MAX_DC", "MAX_NODES",
+    "N_EDGES", "SloBurnTracker", "TimeSeriesStore", "UTIL_EDGES",
+    "device_health_counters", "global_series", "health_host",
+    "open_sink", "tier_bytes",
+]

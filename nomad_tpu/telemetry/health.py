@@ -1,0 +1,489 @@
+"""Device-side fleet health kernel + bit-identical numpy host twin.
+
+One jit reduction over the resident node planes (avail / valid /
+node_dc / dev_cap) and the carried usage planes turns the whole fleet
+into a handful of integers per wave: per-resource utilization
+ge-counts (the histogram), stranded-capacity fragmentation inputs,
+busy / per-DC counts for spread-violation accounting, evictable
+pressure and device totals.  The kernel runs unchanged on the plain
+resident solver, the NamedSharding'd mesh solvers (GSPMD inserts the
+cross-shard psums) and the federated region stack (rows flattened).
+
+Bit-identity with the numpy twin is by construction, not luck:
+
+  * every reduced quantity is an INTEGER.  Per-node scalars are
+    clamped to [0, 2^24) (f32-exact), split into hi = v >> 14 /
+    lo = v & 16383 and summed in i32 — order-independent, overflow-
+    free for up to 2^17 nodes (`MAX_NODES`, guarded at the call
+    site) — then recombined host-side as Python ints.
+  * histogram membership uses MULTIPLICATION against exact-f32
+    threshold edges (`used >= avail * edge`), never division: float
+    multiply is correctly rounded everywhere, while TPU division may
+    lower to a reciprocal approximation.
+  * the host twin applies the SAME clamps in the same order, so both
+    sides saturate identically (a per-node value above 2^24-1 is
+    reported as 2^24-1 on both sides — semantic saturation, not
+    drift).
+
+The per-tier (ICI/DCN/WAN) byte totals ride along in the REPORT, not
+the kernel: they come from the mesh solvers' wave_traffic byte model
+at the sampling site (see `tier_bytes`), which already owns the
+topology.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: static DC-universe bound for the segment-sum planes; node_dc ids are
+#: clamped into it (interned ids are small in practice).
+MAX_DC = 64
+
+#: utilization ge-thresholds: 0 and 1 - 2^-k for k = 1..6, then 1.0.
+#: All exactly representable in f32, so `avail * edge` is a single
+#: correctly-rounded multiply on every backend.
+UTIL_EDGES: Tuple[float, ...] = (
+    0.0, 0.5, 0.75, 0.875, 0.9375, 0.96875, 0.984375, 1.0)
+N_EDGES = len(UTIL_EDGES)
+
+#: a node is "busy" when any resource sits at >= 3/4 of its allocatable
+#: capacity (the classic bin-packing pressure watermark).
+BUSY_EDGE = 0.75
+
+#: per-node integer ceiling: clamped to [0, 2^24) so every value is
+#: f32-exact and the hi/lo split sums cannot overflow i32.
+_CAP_I = (1 << 24) - 1
+_CAP_F = np.float32(_CAP_I)
+_SPLIT = 1 << 14
+
+#: hi/lo split sums stay inside i32 for up to this many nodes
+#: (2^17 * max(hi) = 2^17 * 2^10 < 2^31); health_counters guards it.
+MAX_NODES = 1 << 17
+
+
+def _split_sum(v_i):
+    """Order-independent i32 split sum over the node axis (axis 0)."""
+    return ((v_i >> 14).sum(axis=0),
+            (v_i & (_SPLIT - 1)).sum(axis=0))
+
+
+@jax.jit
+def _health_kernel(avail, valid, node_dc, dev_cap, used, dev_used,
+                   ask_res, live, ev_prio, ev_res):
+    """One-pass fleet reduction; returns a dict of small i32 arrays.
+
+    `live` masks device rows whose tile is still resident (elastic
+    layouts keep STALE plane rows for retired/lost tiles — `valid`
+    alone is not enough); None means every row is live.  `ev_prio` /
+    `ev_res` are None when the world has no preemption planes.
+    """
+    if live is not None:
+        valid = jnp.logical_and(valid, live)
+    edges = jnp.asarray(UTIL_EDGES, dtype=jnp.float32)
+    av = jnp.where(valid[:, None], jnp.clip(avail, 0.0, _CAP_F), 0.0)
+    us = jnp.where(valid[:, None], jnp.clip(used, 0.0, _CAP_F), 0.0)
+    free = jnp.clip(av - us, 0.0, _CAP_F)
+    av_i = av.astype(jnp.int32)
+    us_i = us.astype(jnp.int32)
+    free_i = free.astype(jnp.int32)
+
+    # ge-counts per (resource, edge): in-bucket histogram derived
+    # host-side as ge[k] - ge[k+1].  av is zeroed for invalid rows, so
+    # the av > 0 gate doubles as the validity gate.
+    cap_pos = (av > 0.0)
+    ge = jnp.logical_and(
+        us[:, :, None] >= av[:, :, None] * edges,
+        cap_pos[:, :, None]).astype(jnp.int32).sum(axis=0)   # [R, E]
+
+    busy = jnp.logical_and(
+        cap_pos, us >= av * jnp.float32(BUSY_EDGE)).any(axis=1)
+
+    # stranded capacity: free somewhere, but no nonzero probe ask fits
+    # whole on the node — the numerator of the fragmentation index.
+    ask_mask = (ask_res > 0.0).any(axis=1)                   # [Gp]
+    fits = (ask_res[None, :, :] <= free[:, None, :]).all(axis=2)
+    placeable = jnp.logical_and(fits, ask_mask[None, :]).any(axis=1)
+    stranded = jnp.logical_and(
+        jnp.logical_and(valid, free_i.sum(axis=1) > 0),
+        jnp.logical_not(placeable))
+
+    dcc = jnp.clip(node_dc, 0, MAX_DC - 1)
+
+    # device planes: few device types per node, so sum over the device
+    # axis first, then clamp (saturation rule shared with the twin).
+    dcap = jnp.minimum(
+        jnp.where(valid[:, None],
+                  jnp.clip(dev_cap, 0.0, _CAP_F), 0.0)
+        .astype(jnp.int32).sum(axis=1), _CAP_I)
+    dusd = jnp.minimum(
+        jnp.where(valid[:, None],
+                  jnp.clip(dev_used, 0.0, _CAP_F), 0.0)
+        .astype(jnp.int32).sum(axis=1), _CAP_I)
+
+    # outputs STACKED into a handful of buffers: dispatch + fetch cost
+    # on the sampling beat scales with output-buffer count, not bytes
+    # (order mirrors _SCALAR_KEYS / _SUM_KEYS in the host unpack)
+    scalars = [valid.astype(jnp.int32).sum(),
+               busy.astype(jnp.int32).sum(),
+               stranded.astype(jnp.int32).sum(),
+               (dcap >> 14).sum(), (dcap & (_SPLIT - 1)).sum(),
+               (dusd >> 14).sum(), (dusd & (_SPLIT - 1)).sum()]
+    sums = [jnp.stack(_split_sum(v_i))
+            for v_i in (free_i, us_i, av_i,
+                        jnp.where(stranded[:, None], free_i, 0))]
+
+    if ev_prio is not None:
+        slots = jnp.logical_and(ev_prio >= 0, valid[:, None])
+        scalars.append(slots.astype(jnp.int32).sum())
+        ev_i = jnp.minimum(
+            jnp.where(slots[:, :, None],
+                      jnp.clip(ev_res, 0.0, _CAP_F), 0.0)
+            .astype(jnp.int32).sum(axis=1), _CAP_I)       # [Np, R]
+        sums.append(jnp.stack(_split_sum(ev_i)))
+    return {
+        "scalars": jnp.stack(scalars),
+        "sums": jnp.stack(sums),
+        "util_ge": ge,
+        "dc_nodes": jax.ops.segment_sum(
+            valid.astype(jnp.int32), dcc, num_segments=MAX_DC),
+        "dc_busy": jax.ops.segment_sum(
+            busy.astype(jnp.int32), dcc, num_segments=MAX_DC),
+    }
+
+
+#: unpack order for the kernel's stacked outputs (ev entries ride at
+#: the end only when the world packs preemption planes)
+_SCALAR_KEYS = ("nodes_valid", "nodes_busy", "nodes_stranded",
+                "dev_cap_hi", "dev_cap_lo", "dev_used_hi",
+                "dev_used_lo")
+_SUM_KEYS = ("free", "used", "avail", "stranded_free")
+
+
+def _unpack_raw(got: Dict) -> Dict:
+    """Fan the kernel's stacked buffers back out to the flat raw-dict
+    key space `HealthCounters.from_raw` and the host twin share."""
+    raw = {"util_ge": got["util_ge"], "dc_nodes": got["dc_nodes"],
+           "dc_busy": got["dc_busy"]}
+    sc = np.asarray(got["scalars"])
+    for i, k in enumerate(_SCALAR_KEYS):
+        raw[k] = sc[i]
+    if sc.shape[0] > len(_SCALAR_KEYS):
+        raw["ev_slots"] = sc[len(_SCALAR_KEYS)]
+    sums = np.asarray(got["sums"])
+    for i, k in enumerate(_SUM_KEYS):
+        raw[k + "_hi"], raw[k + "_lo"] = sums[i, 0], sums[i, 1]
+    if sums.shape[0] > len(_SUM_KEYS):
+        raw["ev_hi"], raw["ev_lo"] = sums[-1, 0], sums[-1, 1]
+    return raw
+
+
+def _recombine(hi, lo) -> Tuple[int, ...]:
+    hi = np.atleast_1d(np.asarray(hi))
+    lo = np.atleast_1d(np.asarray(lo))
+    return tuple(int(h) * _SPLIT + int(l) for h, l in zip(hi, lo))
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthCounters:
+    """Exact integer fleet counters for one sampling wave.
+
+    Tuple-typed fields (never arrays) so `==` between the device and
+    host-twin products is structural — the property tests compare
+    whole dataclasses.
+    """
+    n_resources: int
+    nodes_valid: int
+    nodes_busy: int
+    nodes_stranded: int
+    util_ge: Tuple[Tuple[int, ...], ...]   # [R][N_EDGES] ge-counts
+    free: Tuple[int, ...]                  # per-resource exact sums
+    used: Tuple[int, ...]
+    avail: Tuple[int, ...]
+    stranded_free: Tuple[int, ...]
+    dc_nodes: Tuple[int, ...]              # [MAX_DC]
+    dc_busy: Tuple[int, ...]
+    dev_cap: int
+    dev_used: int
+    ev_slots: int = 0
+    ev_pressure: Tuple[int, ...] = ()      # per-resource evictable sums
+
+    @classmethod
+    def from_raw(cls, raw: Dict) -> "HealthCounters":
+        ge = np.asarray(raw["util_ge"])
+        kw = {}
+        if "ev_slots" in raw:
+            kw = {"ev_slots": int(raw["ev_slots"]),
+                  "ev_pressure": _recombine(raw["ev_hi"],
+                                            raw["ev_lo"])}
+        return cls(
+            n_resources=int(ge.shape[0]),
+            nodes_valid=int(raw["nodes_valid"]),
+            nodes_busy=int(raw["nodes_busy"]),
+            nodes_stranded=int(raw["nodes_stranded"]),
+            util_ge=tuple(tuple(int(x) for x in row) for row in ge),
+            free=_recombine(raw["free_hi"], raw["free_lo"]),
+            used=_recombine(raw["used_hi"], raw["used_lo"]),
+            avail=_recombine(raw["avail_hi"], raw["avail_lo"]),
+            stranded_free=_recombine(raw["stranded_free_hi"],
+                                     raw["stranded_free_lo"]),
+            dc_nodes=tuple(int(x) for x in np.asarray(raw["dc_nodes"])),
+            dc_busy=tuple(int(x) for x in np.asarray(raw["dc_busy"])),
+            dev_cap=_recombine(raw["dev_cap_hi"],
+                               raw["dev_cap_lo"])[0],
+            dev_used=_recombine(raw["dev_used_hi"],
+                                raw["dev_used_lo"])[0],
+            **kw)
+
+    def merge(self, other: "HealthCounters") -> "HealthCounters":
+        """Counter-wise sum — every field is a sum over nodes, so
+        merging regions == computing over the union fleet."""
+        if self.n_resources != other.n_resources:
+            raise ValueError("resource-dim mismatch in health merge")
+        add = lambda a, b: tuple(x + y for x, y in zip(a, b))
+        ep = (add(self.ev_pressure, other.ev_pressure)
+              if self.ev_pressure and other.ev_pressure
+              else self.ev_pressure or other.ev_pressure)
+        return HealthCounters(
+            n_resources=self.n_resources,
+            nodes_valid=self.nodes_valid + other.nodes_valid,
+            nodes_busy=self.nodes_busy + other.nodes_busy,
+            nodes_stranded=self.nodes_stranded + other.nodes_stranded,
+            util_ge=tuple(add(a, b) for a, b in
+                          zip(self.util_ge, other.util_ge)),
+            free=add(self.free, other.free),
+            used=add(self.used, other.used),
+            avail=add(self.avail, other.avail),
+            stranded_free=add(self.stranded_free, other.stranded_free),
+            dc_nodes=add(self.dc_nodes, other.dc_nodes),
+            dc_busy=add(self.dc_busy, other.dc_busy),
+            dev_cap=self.dev_cap + other.dev_cap,
+            dev_used=self.dev_used + other.dev_used,
+            ev_slots=self.ev_slots + other.ev_slots,
+            ev_pressure=ep)
+
+    # ------------------------------------------------- derived report
+    def spread_violations(self) -> int:
+        """DCs whose busy share exceeds 1.5x their node share —
+        exact integer cross-multiply, no float ratios."""
+        if self.nodes_busy <= 0 or self.nodes_valid <= 0:
+            return 0
+        out = 0
+        for nodes_d, busy_d in zip(self.dc_nodes, self.dc_busy):
+            if busy_d > 0 and \
+                    2 * busy_d * self.nodes_valid > \
+                    3 * nodes_d * self.nodes_busy:
+                out += 1
+        return out
+
+    def util_hist(self) -> Tuple[Tuple[int, ...], ...]:
+        """In-bucket counts per resource: bucket k = [edge_k,
+        edge_{k+1}), last bucket = full/overcommitted (u >= 1)."""
+        out = []
+        for ge in self.util_ge:
+            row = [ge[k] - ge[k + 1] for k in range(N_EDGES - 1)]
+            row.append(ge[N_EDGES - 1])
+            out.append(tuple(row))
+        return tuple(out)
+
+    def fragmentation_index(self) -> float:
+        """Stranded fraction of free capacity across all resources:
+        1.0 = every free unit is on a node nothing placeable fits."""
+        total_free = sum(self.free)
+        if total_free <= 0:
+            return 0.0
+        return sum(self.stranded_free) / total_free
+
+    def _dc_report(self) -> Dict:
+        """Per-DC counts trimmed to the populated id range."""
+        n_dc = max((i + 1 for i, n in enumerate(self.dc_nodes) if n),
+                   default=0)
+        return {"nodes": list(self.dc_nodes[:n_dc]),
+                "busy": list(self.dc_busy[:n_dc])}
+
+    def report(self, tiers: Optional[Dict] = None) -> Dict:
+        total_avail = sum(self.avail)
+        out = {
+            "nodes": {"valid": self.nodes_valid,
+                      "busy": self.nodes_busy,
+                      "stranded": self.nodes_stranded},
+            "utilization": (sum(self.used) / total_avail
+                            if total_avail > 0 else 0.0),
+            "util_edges": list(UTIL_EDGES),
+            "util_hist": [list(r) for r in self.util_hist()],
+            "fragmentation_index": self.fragmentation_index(),
+            "stranded_free": list(self.stranded_free),
+            "free": list(self.free),
+            "used": list(self.used),
+            "avail": list(self.avail),
+            "spread_violations": self.spread_violations(),
+            "dc": self._dc_report(),
+            "evictable": {"slots": self.ev_slots,
+                          "pressure": list(self.ev_pressure)},
+            "devices": {"cap": self.dev_cap, "used": self.dev_used},
+        }
+        if tiers:
+            out["tier_bytes"] = dict(tiers)
+        return out
+
+
+# ---------------------------------------------------------- host twin
+def health_host(template, used, dev_used,
+                row_mask: Optional[np.ndarray] = None
+                ) -> HealthCounters:
+    """Numpy twin of `_health_kernel` over a host-side PackedBatch
+    mirror: same clamps, same multiply-threshold compares, same split
+    accumulators, identical saturation.  `row_mask` selects the rows
+    the device world actually holds (elastic layouts drop lost tiles).
+    """
+    f32 = np.float32
+    valid = np.asarray(template.valid, bool).copy()
+    if row_mask is not None:
+        valid &= np.asarray(row_mask, bool)
+    edges = np.asarray(UTIL_EDGES, dtype=f32)
+    av = np.where(valid[:, None],
+                  np.clip(np.asarray(template.avail, f32),
+                          f32(0), _CAP_F), f32(0))
+    us = np.where(valid[:, None],
+                  np.clip(np.asarray(used, f32), f32(0), _CAP_F),
+                  f32(0))
+    free = np.clip(av - us, f32(0), _CAP_F)
+    av_i = av.astype(np.int32)
+    us_i = us.astype(np.int32)
+    free_i = free.astype(np.int32)
+
+    cap_pos = av > 0
+    ge = np.logical_and(
+        us[:, :, None] >= av[:, :, None] * edges,
+        cap_pos[:, :, None]).astype(np.int32).sum(axis=0)
+
+    busy = np.logical_and(cap_pos, us >= av * f32(BUSY_EDGE)).any(axis=1)
+
+    ask_res = np.asarray(template.ask_res, f32)
+    ask_mask = (ask_res > 0).any(axis=1)
+    fits = (ask_res[None, :, :] <= free[:, None, :]).all(axis=2)
+    placeable = np.logical_and(fits, ask_mask[None, :]).any(axis=1)
+    stranded = valid & (free_i.sum(axis=1) > 0) & ~placeable
+
+    dcc = np.clip(np.asarray(template.node_dc), 0, MAX_DC - 1)
+    dc_nodes = np.zeros(MAX_DC, np.int32)
+    np.add.at(dc_nodes, dcc, valid.astype(np.int32))
+    dc_busy = np.zeros(MAX_DC, np.int32)
+    np.add.at(dc_busy, dcc, busy.astype(np.int32))
+
+    raw: Dict = {
+        "nodes_valid": valid.astype(np.int32).sum(),
+        "nodes_busy": busy.astype(np.int32).sum(),
+        "nodes_stranded": stranded.astype(np.int32).sum(),
+        "util_ge": ge, "dc_nodes": dc_nodes, "dc_busy": dc_busy,
+    }
+    for name, v_i in (("free", free_i), ("used", us_i),
+                      ("avail", av_i),
+                      ("stranded_free",
+                       np.where(stranded[:, None], free_i, 0))):
+        raw[name + "_hi"], raw[name + "_lo"] = _split_sum(v_i)
+
+    for name, plane in (("dev_cap", template.dev_cap),
+                        ("dev_used", dev_used)):
+        v = np.minimum(
+            np.where(valid[:, None],
+                     np.clip(np.asarray(plane, f32), f32(0), _CAP_F),
+                     f32(0)).astype(np.int32).sum(axis=1),
+            np.int32(_CAP_I))
+        raw[name + "_hi"] = (v >> 14).sum()
+        raw[name + "_lo"] = (v & (_SPLIT - 1)).sum()
+
+    if getattr(template, "ev_prio", None) is not None:
+        slots = np.logical_and(
+            np.asarray(template.ev_prio) >= 0, valid[:, None])
+        raw["ev_slots"] = slots.astype(np.int32).sum()
+        ev_i = np.minimum(
+            np.where(slots[:, :, None],
+                     np.clip(np.asarray(template.ev_res, f32),
+                             f32(0), _CAP_F), f32(0))
+            .astype(np.int32).sum(axis=1),
+            np.int32(_CAP_I))
+        raw["ev_hi"], raw["ev_lo"] = _split_sum(ev_i)
+    return HealthCounters.from_raw(raw)
+
+
+# ------------------------------------------------------ solver driver
+def device_health_raw(solver) -> Dict:
+    """Dispatch the health kernel over a resident solver's device
+    planes and return the UNFETCHED raw output dict — the async half
+    of `device_health_counters`, for samplers that must not stall the
+    dispatch stream: dispatch now, materialize a beat later with
+    `HealthCounters.from_raw(jax.device_get(raw))` once the stream
+    has moved on (the arrays snapshot the planes at dispatch time).
+
+    Reuses the solver's plane caches (the probe ask_res is re-put
+    only when the template changes, via `_put_ask` so mesh solvers
+    replicate it).
+    """
+    dn = solver._dev_node
+    np_rows = int(solver.template.avail.shape[0])
+    if np_rows > MAX_NODES:
+        raise ValueError(
+            f"health kernel split accumulators are i32-safe up to "
+            f"{MAX_NODES} nodes; got {np_rows}")
+    # keyed on (template, mesh): a repack swaps the template, and a
+    # shard-loss/recover swaps the mesh the replica must live on
+    mesh = getattr(solver, "_mesh", None)
+    cache = solver.__dict__.get("_health_ask_dev")
+    if cache is None or cache[0] is not solver.template \
+            or cache[1] is not mesh:
+        dev = solver._put_ask(
+            "health_ask_res",
+            np.asarray(solver.template.ask_res, np.float32))
+        solver.__dict__["_health_ask_dev"] = cache = (
+            solver.template, mesh, dev)
+    live = None
+    live_fn = getattr(solver, "_health_live_mask", None)
+    if live_fn is not None:
+        live = live_fn()
+    return _health_kernel(
+        dn["avail"], dn["valid"], dn["node_dc"], dn["dev_cap"],
+        solver._used, solver._dev_used, cache[2], live,
+        dn.get("ev_prio"), dn.get("ev_res"))
+
+
+def fetch_health(raw) -> HealthCounters:
+    """Materialize a `device_health_raw` dispatch (blocking)."""
+    return HealthCounters.from_raw(_unpack_raw(jax.device_get(raw)))
+
+
+def device_health_counters(solver) -> HealthCounters:
+    """Run the health kernel over a resident solver's device planes:
+    one kernel dispatch + one blocking fetch."""
+    return fetch_health(device_health_raw(solver))
+
+
+def tier_bytes(solver, batches: Optional[Sequence] = None
+               ) -> Dict[str, int]:
+    """Per-tier modeled byte totals for the last dispatched stream —
+    HBM always, ICI/DCN/WAN when the solver's wave_traffic models
+    those tiers (mesh / federated solvers).  Advisory: returns {} when
+    no stream has been dispatched or the model fails."""
+    if not batches:
+        return {}
+    try:
+        wt = solver.wave_traffic(list(batches))
+    except Exception:
+        return {}   # the byte model must never fail a health sample
+    m = wt.get("measured") or {}
+    waves = int(m.get("waves_total", 1)) or 1
+    out: Dict[str, int] = {}
+    if "modeled_bytes_total" in m:
+        out["hbm"] = int(m["modeled_bytes_total"])
+    else:
+        out["hbm"] = int(wt.get("bytes_per_wave", 0)) * waves
+    for tier, key in (("ici", "bytes_ici_per_wave"),
+                      ("dcn", "bytes_dcn_per_wave"),
+                      ("wan", "bytes_wan_per_wave")):
+        if key in wt:
+            out[tier] = int(wt[key]) * waves
+    return out

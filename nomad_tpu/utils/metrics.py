@@ -68,12 +68,47 @@ class _Summary:
                 "p50": round(p50, 6), "p99": round(p99, 6)}
 
 
+#: default explicit bucket bounds for observe_hist: latency-shaped,
+#: 1ms..~67s in powers of 4 (seconds).  Callers with counts (batch
+#: sizes) pass their own bounds.
+DEFAULT_HIST_BUCKETS = (0.001, 0.004, 0.016, 0.064, 0.256, 1.024,
+                        4.096, 16.384, 65.536)
+
+
+class _Histogram:
+    """Explicit-bucket histogram: cumulative bucket counts as
+    Prometheus expects, +Inf implied by total count."""
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(f"bucket bounds must be strictly "
+                             f"increasing: {bounds}")
+        self.counts = [0] * len(self.bounds)
+        self.sum = 0.0
+        self.count = 0
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+
+    def snapshot(self) -> dict:
+        return {"buckets": [[b, c] for b, c in
+                            zip(self.bounds, self.counts)],
+                "sum": round(self.sum, 6), "count": self.count}
+
+
 class MetricsRegistry:
     def __init__(self, max_keys_per_ns: Optional[int] = None):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._samples: Dict[str, _Summary] = {}
+        self._hists: Dict[str, _Histogram] = {}
         if max_keys_per_ns is None:
             try:
                 max_keys_per_ns = int(os.environ.get(
@@ -115,6 +150,22 @@ class MetricsRegistry:
             if self._admit_locked(key, self._samples):
                 self._samples.setdefault(key, _Summary()).add(value_s)
 
+    def observe_hist(self, key: str, value: float,
+                     buckets=None) -> None:
+        """Explicit-bucket histogram observation (ISSUE 15).  Bucket
+        bounds are fixed at first observation; a later call with
+        different bounds keeps the original (bounds are config, not
+        data)."""
+        with self._lock:
+            if not self._admit_locked(key, self._hists):
+                return
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Histogram(
+                    buckets if buckets is not None
+                    else DEFAULT_HIST_BUCKETS)
+            h.add(float(value))
+
     def measure_since(self, key: str, t0: float) -> None:
         """t0 from time.monotonic(); records seconds elapsed."""
         self.add_sample(key, _time.monotonic() - t0)
@@ -134,6 +185,8 @@ class MetricsRegistry:
                 "gauges": dict(self._gauges),
                 "samples": {k: s.snapshot()
                             for k, s in self._samples.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
             }
 
     def reset(self) -> None:
@@ -141,6 +194,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._samples.clear()
+            self._hists.clear()
             self._ns_keys.clear()
 
     # --------------------------------------------------------- prometheus
@@ -148,7 +202,9 @@ class MetricsRegistry:
         """Prometheus text exposition (format 0.0.4) of the registry —
         served at /v1/metrics?format=prometheus next to the JSON dump.
         Counters map to `counter`, gauges to `gauge`, timing samples to
-        a `summary` (quantile series + _sum/_count).  Keys are mangled
+        a `summary` (quantile series + _sum/_count), explicit-bucket
+        histograms to `histogram` (cumulative `_bucket{le=}` series
+        plus the implied +Inf).  Keys are mangled
         to the metric charset ([a-zA-Z0-9_:]); collisions after
         mangling keep the first-seen series (stable within a dump —
         both orderings are sorted)."""
@@ -157,6 +213,8 @@ class MetricsRegistry:
             gauges = sorted(self._gauges.items())
             samples = sorted((k, s.snapshot())
                              for k, s in self._samples.items())
+            hists = sorted((k, h.snapshot())
+                           for k, h in self._hists.items())
         out: List[str] = []
         seen: set = set()
 
@@ -188,6 +246,16 @@ class MetricsRegistry:
             out.append(f"# TYPE {n} summary")
             out.append(f'{n}{{quantile="0.5"}} {_fmt(snap["p50"])}')
             out.append(f'{n}{{quantile="0.99"}} {_fmt(snap["p99"])}')
+            out.append(f"{n}_sum {_fmt(snap['sum'])}")
+            out.append(f"{n}_count {snap['count']}")
+        for key, snap in hists:
+            n = name(key)
+            if n is None:
+                continue
+            out.append(f"# TYPE {n} histogram")
+            for le, c in snap["buckets"]:
+                out.append(f'{n}_bucket{{le="{_fmt(le)}"}} {c}')
+            out.append(f'{n}_bucket{{le="+Inf"}} {snap["count"]}')
             out.append(f"{n}_sum {_fmt(snap['sum'])}")
             out.append(f"{n}_count {snap['count']}")
         return "\n".join(out) + "\n"

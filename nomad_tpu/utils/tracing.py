@@ -30,6 +30,12 @@ Knobs (env):
   NOMAD_TPU_TRACE        "0" disables recording (default on)
   NOMAD_TPU_TRACE_DEPTH  ring depth in traces (default 512)
   NOMAD_TPU_TRACE_SINK   JSONL path; completed spans append here
+  NOMAD_TPU_TRACE_SAMPLE sampling rate 0.0-1.0 (default 1.0 = every
+                         trace).  DETERMINISTIC per trace id (crc32
+                         threshold), so a sampled eval keeps its whole
+                         timeline and reruns sample identically —
+                         the bound that keeps open-loop rates cheap
+                         (ISSUE 15).
   NOMAD_TPU_MESH_EVENT_LOG  JSONL path for the mesh event log
 """
 from __future__ import annotations
@@ -38,6 +44,7 @@ import json
 import os
 import threading
 import time as _time
+import zlib
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional
 
@@ -129,8 +136,20 @@ class FlightRecorder:
 
     def __init__(self, depth: Optional[int] = None,
                  enabled: Optional[bool] = None,
-                 sink_path: Optional[str] = None):
+                 sink_path: Optional[str] = None,
+                 sample: Optional[float] = None):
         self._lock = threading.Lock()
+        if sample is None:
+            try:
+                sample = float(os.environ.get(
+                    "NOMAD_TPU_TRACE_SAMPLE", "1.0"))
+            except ValueError:
+                sample = 1.0
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        # crc32 threshold over [0, 2^32): trace ids at or above it are
+        # dropped whole — per-ID determinism keeps every sampled
+        # timeline complete and reruns reproducible
+        self._sample_cut = int(self.sample * (1 << 32))
         if depth is None:
             try:
                 depth = int(os.environ.get("NOMAD_TPU_TRACE_DEPTH",
@@ -155,10 +174,22 @@ class FlightRecorder:
         self._anchor_wall = _time.time()
 
     # ------------------------------------------------------------- record
+    def sampled(self, trace_id: str) -> bool:
+        """Deterministic per-trace-id sampling verdict: crc32 of the
+        id against the rate threshold.  All-or-nothing per id — every
+        stage of a sampled eval records, none of a dropped one."""
+        if self.sample >= 1.0:
+            return True
+        if self.sample <= 0.0:
+            return False
+        return (zlib.crc32(trace_id.encode("utf-8", "replace"))
+                & 0xFFFFFFFF) < self._sample_cut
+
     def span(self, trace_id: str, name: str,
              parent: Optional[str] = None, **attrs):
         """Open a span; the caller must end() it (or use `with`)."""
-        if not self.enabled or not trace_id:
+        if not self.enabled or not trace_id \
+                or not self.sampled(trace_id):
             return NULL_SPAN
         return Span(self, trace_id, name, parent or "", attrs)
 
@@ -166,7 +197,8 @@ class FlightRecorder:
         """Open a span chained on the trace's last completed span —
         the lifecycle-stage convenience (create -> admit -> dequeue ->
         ... each parented on its predecessor)."""
-        if not self.enabled or not trace_id:
+        if not self.enabled or not trace_id \
+                or not self.sampled(trace_id):
             return NULL_SPAN
         with self._lock:
             parent = self._tail.get(trace_id, "")
@@ -176,7 +208,8 @@ class FlightRecorder:
               parent: Optional[str] = None, **attrs) -> None:
         """Record a zero-duration stage (chained like `stage` unless an
         explicit parent is given)."""
-        if not self.enabled or not trace_id:
+        if not self.enabled or not trace_id \
+                or not self.sampled(trace_id):
             return
         sp = (self.span(trace_id, name, parent=parent, **attrs)
               if parent is not None else self.stage(trace_id, name,
@@ -256,6 +289,7 @@ class FlightRecorder:
     def stats(self) -> dict:
         with self._lock:
             return {"enabled": self.enabled,
+                    "sample": self.sample,
                     "traces": len(self._traces),
                     "spans": sum(len(v) for v in self._traces.values()),
                     "depth_limit": self.depth_limit,
@@ -359,14 +393,26 @@ class MeshEventLog:
                 return None
         return self._sink
 
-    def events(self, limit: int = 256, kind: Optional[str] = None
-               ) -> List[dict]:
-        """Newest-last events (the natural replay order)."""
+    def events(self, limit: int = 256, kind: Optional[str] = None,
+               since_seq: int = 0) -> List[dict]:
+        """Newest-last events (the natural replay order).  `since_seq`
+        is the poller cursor (ISSUE 15): only events with seq STRICTLY
+        above it return, so `since_seq=last_seen` re-reads nothing —
+        seq is monotone and ring eviction only ever drops the low
+        end."""
         with self._lock:
             evs = list(self._events)
+        if since_seq:
+            evs = [e for e in evs if e["seq"] > since_seq]
         if kind:
             evs = [e for e in evs if e["kind"] == kind]
         return evs[-max(int(limit), 1):]
+
+    @property
+    def last_seq(self) -> int:
+        """The newest assigned cursor (0 = nothing recorded yet)."""
+        with self._lock:
+            return self._seq
 
     def region_table(self) -> dict:
         """Federation membership replayed from the region.* events

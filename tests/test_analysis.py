@@ -728,6 +728,57 @@ FIX_ROBUST = """
             sink.last_error = str(e)
 """
 
+FIX_OBS = """
+    class _Reg:
+        def incr_counter(self, key, value=1.0):
+            pass
+
+        def set_gauge(self, key, value):
+            pass
+
+        def record(self, name, value):
+            pass
+
+    metrics = _Reg()
+    series_store = _Reg()
+
+
+    def good_counter():
+        metrics.incr_counter("worker.good_counter")
+
+
+    def good_series():
+        series_store.record("broker.ready_depth", 1.0)
+
+
+    def bad_namespace():
+        metrics.incr_counter("rogue.counter")          # OBS801
+
+
+    def bad_shape():
+        metrics.set_gauge("WorkerLatency", 1.0)        # OBS801
+
+
+    def bad_dynamic(ev):
+        metrics.set_gauge(f"worker.by_{ev}", 1.0)      # OBS802
+
+
+    def bad_dynamic_ns(ev):
+        metrics.set_gauge(f"rogue.{ev}", 1.0)          # OBS801 + 802
+
+
+    def bad_var(name):
+        metrics.incr_counter(name)                     # OBS802
+
+
+    def bad_series():
+        series_store.record("Broker.Depth", 1.0)       # OBS801
+
+
+    def unrelated_record(log):
+        log.record("not a metric at all")              # quiet
+"""
+
 FIX_SCORER_SITES = (
     ScorerSite("host", "python", "fixpkg.score_host:host_scores"),
     ScorerSite("shortlist", "python", "fixpkg.score_sl:sl_scores"),
@@ -748,6 +799,7 @@ FIX_FILES = {
     "score_rogue.py": FIX_SCORE_ROGUE,
     "native_score.cc": FIX_SCORE_CC,
     "recov.py": FIX_ROBUST,
+    "obsmod.py": FIX_OBS,
 }
 
 FIX_CFG = AnalysisConfig(
@@ -758,6 +810,7 @@ FIX_CFG = AnalysisConfig(
     scatter_helpers=(),
     scorer_sites=FIX_SCORER_SITES,
     robust_module_prefixes=("fixpkg",),
+    obs_metric_prefixes=("worker", "broker"),
 )
 
 
@@ -1097,6 +1150,53 @@ def test_repo_robust_zero_unsuppressed():
     justifications."""
     rep = analyze()
     bad = [f for f in rep.findings if f.rule.startswith("ROBUST")]
+    assert not bad, "\n".join(f.render() for f in bad)
+
+
+# ---------------------------------------------------------- obs pass
+def test_obs_literal_name_hygiene_detected(fixture_report):
+    keys = _keys(fixture_report, "OBS801")
+    assert "OBS801:fixpkg.obsmod:bad_namespace:rogue.counter" in keys
+    assert "OBS801:fixpkg.obsmod:bad_shape:WorkerLatency" in keys
+    assert "OBS801:fixpkg.obsmod:bad_series:Broker.Depth" in keys
+
+
+def test_obs_dynamic_name_detected_with_pattern_keys(fixture_report):
+    """f-strings keep their literal runs in the baseline key;
+    fully-opaque names collapse to <dynamic>."""
+    keys = _keys(fixture_report, "OBS802")
+    assert "OBS802:fixpkg.obsmod:bad_dynamic:worker.by_*" in keys
+    assert "OBS802:fixpkg.obsmod:bad_dynamic_ns:rogue.*" in keys
+    assert "OBS802:fixpkg.obsmod:bad_var:<dynamic>" in keys
+
+
+def test_obs_dynamic_unregistered_namespace_is_also_error(fixture_report):
+    """A literal-prefix f-string under an unregistered namespace gets
+    the namespace error on top of the cardinality warn."""
+    assert "OBS801:fixpkg.obsmod:bad_dynamic_ns:rogue.*" in \
+        _keys(fixture_report, "OBS801")
+
+
+def test_obs_clean_sites_quiet(fixture_report):
+    keys = _keys(fixture_report, "OBS801") | \
+        _keys(fixture_report, "OBS802")
+    assert not any(":good_" in k or ":unrelated_" in k for k in keys), \
+        keys
+
+
+def test_obs_tiers():
+    from nomad_tpu.analysis import pass_of, severity_of
+    assert severity_of("OBS801") == "error"
+    assert severity_of("OBS802") == "warn"
+    assert pass_of("OBS801") == "obs"
+
+
+def test_repo_obs_zero_unsuppressed():
+    """Every metric/series name in the real package is a registered
+    lowercase dotted literal; the bounded dynamic sites carry baseline
+    justifications naming the bound."""
+    rep = analyze()
+    bad = [f for f in rep.findings if f.rule.startswith("OBS")]
     assert not bad, "\n".join(f.render() for f in bad)
 
 

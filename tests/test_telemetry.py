@@ -1,0 +1,432 @@
+"""Cluster health plane (ISSUE 15).
+
+Three layers of guarantees:
+
+  * the device HEALTH KERNEL must be bit-identical to its numpy host
+    twin — whole HealthCounters dataclasses compared with `==` —
+    across pallas modes, mesh widths, elastic grow/shrink/fail/
+    recover rounds, evictable (preemption-plane) worlds, and the
+    [0, 2^24) saturation clamp, and region merge must equal the
+    union-fleet computation;
+  * the MULTI-RESOLUTION SERIES ring must downsample exactly
+    (min/max/sum/count cascade on rollover), stay bounded (ring caps
+    and the name-admission cap), page by the `since` cursor, and sink
+    finalized 1s points as JSONL;
+  * the SLO BURN tracker's window math is unit-checked against hand
+    burn rates, with trip/clear hysteresis surfacing as mesh events
+    and gauges; the mesh event log pages by `since_seq` across ring
+    eviction; flight-recorder sampling is deterministic per trace id.
+
+Runs on the conftest-forced 8-device virtual CPU mesh.
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from nomad_tpu.parallel.sharded import (ElasticShardedResidentSolver,
+                                        make_node_mesh)
+from nomad_tpu.solver.resident import ResidentSolver
+from nomad_tpu.solver.tensorize import alloc_usage_vector
+from nomad_tpu.telemetry.health import (BUSY_EDGE, MAX_NODES, N_EDGES,
+                                        HealthCounters,
+                                        device_health_counters,
+                                        device_health_raw,
+                                        fetch_health, health_host)
+from nomad_tpu.telemetry.series import (OVERFLOW_NAME, TimeSeriesStore)
+from nomad_tpu.telemetry.slo import SloBurnTracker
+from nomad_tpu.utils.metrics import MetricsRegistry
+from nomad_tpu.utils.tracing import FlightRecorder, MeshEventLog
+from tests.test_sharded_resident import make_ask, make_node
+
+
+def host_twin(solver) -> HealthCounters:
+    """The host-side correspondent of device_health_counters: the
+    fetched usage planes through the numpy twin, masked to the rows
+    the device world actually holds (elastic layouts)."""
+    u, du = solver.usage()
+    mask_fn = getattr(solver, "health_row_mask", None)
+    return health_host(solver.template, u, du,
+                       row_mask=mask_fn() if mask_fn else None)
+
+
+# ------------------------------------------------------------------
+# device kernel vs host twin: bit-identical, whole dataclass
+# ------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["off", "score", "topk"])
+def test_health_plain_solver_matches_twin_across_stream(mode):
+    nodes = [make_node(i) for i in range(40)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=4, kp=16, pallas=mode)
+    for step in range(3):
+        rs.solve_stream(
+            [rs.pack_batch([make_ask(count=4, cpu=300 + 100 * step)])])
+        dev = device_health_counters(rs)
+        assert dev == host_twin(rs)
+    assert dev.nodes_valid == 40
+    assert sum(dev.used) > 0                   # stream left usage
+
+
+@pytest.mark.parametrize("width", [1, 2, 4])
+def test_health_matches_twin_across_mesh_widths(width):
+    nodes = [make_node(i) for i in range(40)]
+    probe = [make_ask()]
+    if width == 1:
+        s = ResidentSolver(nodes, probe, gp=4, kp=16)
+    else:
+        s = ElasticShardedResidentSolver(nodes, probe, gp=4, kp=16,
+                                         mesh=make_node_mesh(width))
+    s.solve_stream([s.pack_batch([make_ask(count=6)])])
+    dev = device_health_counters(s)
+    assert dev == host_twin(s)
+    # and the mesh width must be invisible to the counters: compare
+    # against a fresh single-device world driven identically
+    ref = ResidentSolver(nodes, probe, gp=4, kp=16)
+    ref.solve_stream([ref.pack_batch([make_ask(count=6)])])
+    assert dev == device_health_counters(ref)
+
+
+def test_health_elastic_lifecycle_matches_twin():
+    """grow -> solve -> shrink -> fail -> recover: after every
+    transition the kernel (live-masked device rows) and the twin
+    (health_row_mask) agree bitwise."""
+    nodes = [make_node(i) for i in range(24)]
+    es = ElasticShardedResidentSolver(nodes, [make_ask()], gp=4,
+                                      kp=16, mesh=make_node_mesh(4))
+
+    def check():
+        dev = device_health_counters(es)
+        assert dev == host_twin(es)
+        return dev
+
+    base = check()
+    es.grow_tiles(1)
+    check()
+    es.solve_stream([es.pack_batch([make_ask(count=5)])])
+    check()
+    es.shrink_tiles(1)
+    check()
+    lost = es.fail_shard(1)
+    degraded = check()
+    if lost:
+        # lost tiles leave BOTH views — valid count shrinks together
+        assert degraded.nodes_valid < base.nodes_valid
+    es.recover()
+    recovered = check()
+    assert recovered.nodes_valid == base.nodes_valid
+
+
+def test_health_evictable_planes_match_twin():
+    from tests.test_preempt_kernel import overcommit_world
+    nodes, abn, asks = overcommit_world(0)
+    rs = ResidentSolver(nodes, asks, abn, evict_e=8, pallas="off")
+    u0 = np.zeros_like(rs.template.used0)
+    for i, n in enumerate(nodes):
+        for a in abn[n.id]:
+            u0[i] += alloc_usage_vector(a)
+    rs.reset_usage(used0=u0)
+    dev = device_health_counters(rs)
+    assert dev == host_twin(rs)
+    assert dev.ev_slots > 0
+    assert sum(dev.ev_pressure) > 0
+
+
+def test_health_saturation_clamps_identically():
+    """Per-node values above 2^24-1 saturate — semantically, on both
+    sides, rather than drifting apart in f32."""
+    nodes = [make_node(i, cpu=200_000_000) for i in range(8)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=4, kp=16)
+    dev = device_health_counters(rs)
+    assert dev == host_twin(rs)
+    cap = (1 << 24) - 1
+    assert max(dev.avail) <= 8 * cap
+    assert any(v % cap == 0 for v in dev.avail)   # cpu column clamped
+
+
+def test_health_async_fetch_equals_blocking():
+    nodes = [make_node(i) for i in range(16)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=4, kp=16)
+    raw = device_health_raw(rs)
+    assert fetch_health(raw) == device_health_counters(rs)
+
+
+def test_health_merge_equals_union_fleet():
+    """Counter-wise region merge == computing over the union fleet
+    (the federation aggregation path)."""
+    nodes = [make_node(i) for i in range(40)]
+    probe = [make_ask()]
+    halves = [ResidentSolver(nodes[:20], probe, gp=4, kp=16),
+              ResidentSolver(nodes[20:], probe, gp=4, kp=16)]
+    union = ResidentSolver(nodes, probe, gp=4, kp=16)
+    merged = host_twin(halves[0]).merge(host_twin(halves[1]))
+    assert merged == host_twin(union)
+    assert merged.nodes_valid == 40
+
+
+def test_health_node_count_guard():
+    class _Fake:
+        pass
+    f = _Fake()
+    f.template = type("T", (), {})()
+    f.template.avail = np.zeros((MAX_NODES + 1, 4), np.float32)
+    f._dev_node = {}
+    with pytest.raises(ValueError, match="i32-safe"):
+        device_health_raw(f)
+
+
+def test_fragmentation_and_hist_semantics():
+    """Hand-built usage: a full node lands in the last histogram
+    bucket, a node with a sliver below the probe ask is stranded with
+    exactly that sliver as stranded capacity, and a one-DC busy skew
+    is a spread violation."""
+    nodes = [make_node(i) for i in range(4)]       # dc0: 0,2  dc1: 1,3
+    rs = ResidentSolver(nodes, [make_ask(cpu=500)], gp=4, kp=16)
+    av = np.asarray(rs.template.avail, np.float32)
+    _, du = rs.usage()
+    used = np.zeros_like(np.asarray(rs.template.used0))
+    used[0] = av[0]                                # full -> busy
+    used[1] = av[1]
+    used[1][0] -= 100.0            # 100 cpu free < any 500-cpu ask
+    h = health_host(rs.template, used, du)
+    assert h.nodes_busy == 2
+    assert h.nodes_stranded == 1
+    assert h.stranded_free == (100, 0, 0, 0)
+    assert h.fragmentation_index() == pytest.approx(
+        100.0 / sum(h.free))
+    # full node: last (>= 1.0) bucket of every capacity-bearing row
+    hist = h.util_hist()
+    assert all(row[N_EDGES - 1] >= 1 for row in hist
+               if sum(row) > 0)
+    assert len(hist) == h.n_resources
+    # per-resource in-bucket counts re-sum to the ge-count at edge 0
+    for r, row in enumerate(hist):
+        assert sum(row) == h.util_ge[r][0]
+    # both busy nodes sit in dc0+dc1?  no: nodes 0 (dc0) and 1 (dc1)
+    # are busy -> shares match.  Rebuild with only node 0 busy:
+    used[1] = 0.0
+    h1 = health_host(rs.template, used, du)
+    assert h1.nodes_busy == 1 and h1.dc_busy[:2] == (1, 0)
+    assert h1.spread_violations() == 1             # dc0: 100% busy share
+    assert 0.0 <= BUSY_EDGE < 1.0
+
+
+def test_health_report_shape():
+    nodes = [make_node(i) for i in range(8)]
+    rs = ResidentSolver(nodes, [make_ask()], gp=4, kp=16)
+    rep = device_health_counters(rs).report(tiers={"hbm": 123})
+    assert rep["nodes"]["valid"] == 8
+    assert rep["tier_bytes"] == {"hbm": 123}
+    assert len(rep["util_hist"]) == len(rep["free"])
+    json.dumps(rep)                                # wire-serializable
+
+
+# ------------------------------------------------------------------
+# multi-resolution series ring
+# ------------------------------------------------------------------
+def test_series_rollover_downsamples_exactly():
+    clock = [0.0]
+    s = TimeSeriesStore(resolutions=((1, 32), (10, 8)),
+                        clock=lambda: clock[0])
+    # seconds 10..19: two samples each, values (t, t+0.5)
+    for t in range(10, 20):
+        s.record("m", float(t), now=float(t))
+        s.record("m", t + 0.5, now=float(t) + 0.25)
+    s.record("m", 99.0, now=25.0)        # rolls the [10, 20) decade
+    pts1 = s.points("m", res=1)
+    assert [p["t"] for p in pts1] == list(range(10, 20))
+    assert pts1[0] == {"t": 10, "min": 10.0, "max": 10.5,
+                       "sum": 20.5, "count": 2, "mean": 10.25}
+    pts10 = s.points("m", res=10)
+    assert len(pts10) == 1
+    p = pts10[0]
+    assert p["t"] == 10 and p["count"] == 20
+    assert p["min"] == 10.0 and p["max"] == 19.5
+    assert p["sum"] == pytest.approx(sum(t + t + 0.5
+                                         for t in range(10, 20)))
+    # cursor: strictly-greater paging re-reads nothing
+    assert [q["t"] for q in s.points("m", res=1, since=15)] == \
+        [16, 17, 18, 19]
+    with pytest.raises(KeyError):
+        s.points("m", res=60)
+
+
+def test_series_rings_stay_bounded():
+    clock = [0.0]
+    s = TimeSeriesStore(resolutions=((1, 4), (10, 2)),
+                        clock=lambda: clock[0])
+    for t in range(100):
+        s.record("m", 1.0, now=float(t))
+    s.flush(now=100.0)
+    assert len(s.points("m", res=1)) == 4          # ring cap, not 100
+    assert len(s.points("m", res=10)) == 2
+    # newest survive eviction
+    assert [p["t"] for p in s.points("m", res=1)] == [96, 97, 98, 99]
+
+
+def test_series_name_admission_cap_overflows():
+    s = TimeSeriesStore(resolutions=((1, 4),), max_names=3)
+    for i in range(10):
+        s.record(f"n{i}", 1.0, now=1.0)
+    st = s.stats()
+    assert st["names"] == 3
+    assert st["overflow"] == 7
+    assert OVERFLOW_NAME not in s.names()  # cap counts, not a series
+
+
+def test_series_sink_emits_finalized_points_as_jsonl():
+    sink = io.StringIO()
+    s = TimeSeriesStore(resolutions=((1, 8),), sink=sink)
+    s.record("a.b", 2.0, now=5.0)
+    s.record("a.b", 4.0, now=5.5)
+    assert sink.getvalue() == ""                   # nothing final yet
+    s.record("a.b", 7.0, now=6.0)                  # finalizes [5, 6)
+    s.flush(now=7.0)
+    rows = [json.loads(ln) for ln in
+            sink.getvalue().strip().splitlines()]
+    assert rows[0] == {"name": "a.b", "t": 5, "min": 2.0, "max": 4.0,
+                       "sum": 6.0, "count": 2}
+    assert rows[1]["t"] == 6 and rows[1]["count"] == 1
+
+
+def test_series_resolutions_must_nest():
+    with pytest.raises(ValueError, match="nest"):
+        TimeSeriesStore(resolutions=((2, 4), (5, 4)))
+    with pytest.raises(ValueError, match="bad resolutions"):
+        TimeSeriesStore(resolutions=())
+
+
+# ------------------------------------------------------------------
+# SLO burn-rate accounting
+# ------------------------------------------------------------------
+def test_burn_rate_window_math():
+    """burn = (bad fraction over window) / (1 - objective), by hand:
+    99% objective, 2 bad of 100 over the window -> 0.02 / 0.01 = 2."""
+    tr = SloBurnTracker(objective=0.99, fast_window_s=10,
+                        fast_burn=14.0, slow_window_s=100,
+                        slow_burn=2.0, clock=lambda: 0.0)
+    tr.observe(good=98, bad=2, now=50.0)
+    assert tr.burn_rate(10, now=50.0) == pytest.approx(2.0)
+    # outside the fast window the samples age out
+    assert tr.burn_rate(10, now=70.0) == 0.0
+    # ...but still inside the slow window
+    assert tr.burn_rate(100, now=70.0) == pytest.approx(2.0)
+
+
+def test_burn_trip_and_hysteresis_emit_mesh_events():
+    log = MeshEventLog(depth=32)
+    m = MetricsRegistry()
+    tr = SloBurnTracker(objective=0.9, fast_window_s=10, fast_burn=5.0,
+                        slow_window_s=60, slow_burn=100.0,
+                        clock=lambda: 0.0, events=log, metrics=m,
+                        prefix="slo")
+    tr.observe(good=50, bad=50, now=1.0)           # burn 0.5/0.1 = 5
+    assert tr.status(now=1.0)["alerting"]["fast"] is True
+    trips = log.events(kind="slo.burn")
+    assert trips[-1]["state"] == "trip"
+    assert trips[-1]["window"] == "fast"
+    assert m.dump()["gauges"]["slo.alerting"] == 1.0
+    # burn must fall below HALF the threshold to clear (hysteresis):
+    # 11s later the bad burst is out of the fast window entirely
+    tr.observe(good=400, bad=0, now=12.0)
+    assert tr.status(now=12.0)["alerting"]["fast"] is False
+    assert log.events(kind="slo.burn")[-1]["state"] == "clear"
+    assert m.dump()["gauges"]["slo.burn_fast"] == 0.0
+
+
+def test_burn_hysteresis_holds_between_half_and_full():
+    tr = SloBurnTracker(objective=0.9, fast_window_s=10, fast_burn=5.0,
+                        slow_window_s=10, slow_burn=500.0,
+                        clock=lambda: 0.0)
+    tr.observe(good=50, bad=50, now=1.0)           # trip at 5.0
+    assert tr.status(now=1.0)["alerting"]["fast"] is True
+    # dilute to burn 3.0: above half-threshold (2.5) -> still alerting
+    tr.observe(good=110, bad=10, now=2.0)
+    st = tr.status(now=2.0)
+    assert 2.5 < st["windows"]["fast"]["burn_rate"] < 5.0
+    assert st["alerting"]["fast"] is True
+
+
+def test_burn_tracker_validates_config():
+    with pytest.raises(ValueError):
+        SloBurnTracker(objective=1.0)
+    with pytest.raises(ValueError):
+        SloBurnTracker(fast_window_s=60, slow_window_s=10)
+
+
+# ------------------------------------------------------------------
+# mesh-event cursor paging + trace sampling (satellites 1 and 2)
+# ------------------------------------------------------------------
+def test_mesh_events_since_seq_paging():
+    log = MeshEventLog(depth=16)
+    for i in range(10):
+        log.record("grow" if i % 2 else "shrink", i=i)
+    assert log.last_seq == 10
+    assert [e["seq"] for e in log.events(since_seq=7)] == [8, 9, 10]
+    assert log.events(since_seq=10) == []
+    evs = log.events(kind="grow", since_seq=4)
+    assert evs and all(e["kind"] == "grow" and e["seq"] > 4
+                       for e in evs)
+    # ring eviction only drops the LOW end; the cursor keeps working
+    for _ in range(20):
+        log.record("churn")
+    assert log.last_seq == 30
+    assert [e["seq"] for e in log.events(since_seq=28)] == [29, 30]
+
+
+def test_trace_sampling_deterministic_per_id():
+    a = FlightRecorder(depth=256, enabled=True, sample=0.5)
+    b = FlightRecorder(depth=256, enabled=True, sample=0.5)
+    ids = [f"eval-{i}" for i in range(300)]
+    kept = {i for i in ids if a.sampled(i)}
+    assert 0 < len(kept) < len(ids)                # actually sampling
+    assert kept == {i for i in ids if b.sampled(i)}   # reruns agree
+    # all-or-nothing per id: every stage of a sampled eval records
+    for i in ids:
+        a.event(i, "create")
+        a.event(i, "admit")
+    st = a.stats()
+    assert st["traces"] == len(kept)
+    assert st["spans"] == 2 * len(kept)
+
+
+def test_trace_sampling_bounds_and_env(monkeypatch):
+    assert FlightRecorder(enabled=True, sample=0.0).sampled("x") is False
+    assert FlightRecorder(enabled=True, sample=1.0).sampled("x") is True
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "0.25")
+    assert FlightRecorder(enabled=True).sample == 0.25
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "nonsense")
+    assert FlightRecorder(enabled=True).sample == 1.0
+    monkeypatch.setenv("NOMAD_TPU_TRACE_SAMPLE", "7")
+    assert FlightRecorder(enabled=True).sample == 1.0   # clamped
+
+
+# ------------------------------------------------------------------
+# explicit-bucket histograms (satellite 3)
+# ------------------------------------------------------------------
+def test_histogram_buckets_cumulative_and_prometheus():
+    m = MetricsRegistry()
+    for v in (0.0005, 0.01, 0.05, 2.0, 100.0):
+        m.observe_hist("worker.solve_s", v, buckets=(0.001, 0.1, 10.0))
+    snap = m.dump()["histograms"]["worker.solve_s"]
+    assert snap["count"] == 5
+    assert snap["buckets"] == [[0.001, 1], [0.1, 3], [10.0, 4]]
+    text = m.prometheus()
+    assert "# TYPE worker_solve_s histogram" in text
+    assert 'worker_solve_s_bucket{le="0.1"} 3' in text
+    assert 'worker_solve_s_bucket{le="+Inf"} 5' in text
+    assert "worker_solve_s_count 5" in text
+
+
+def test_histogram_bounds_fixed_at_first_observation():
+    m = MetricsRegistry()
+    m.observe_hist("w.h", 1.0, buckets=(1.0, 2.0))
+    m.observe_hist("w.h", 1.5, buckets=(9.0,))     # ignored: config
+    snap = m.dump()["histograms"]["w.h"]
+    assert [b for b, _ in snap["buckets"]] == [1.0, 2.0]
+    assert snap["count"] == 2
+
+
+def test_histogram_rejects_unsorted_bounds():
+    m = MetricsRegistry()
+    with pytest.raises(ValueError, match="increasing"):
+        m.observe_hist("w.bad", 1.0, buckets=(2.0, 1.0))
