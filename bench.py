@@ -1878,6 +1878,401 @@ def run_open_loop(n_nodes=2048, count=4, max_batch=128, fixed_batch=8,
     return out
 
 
+# ---------------- scale-out serving phase (ISSUE 17) ----------------
+
+class _ScaleoutHarness:
+    """N worker threads on an S-shard broker feeding the single
+    resident solver through the REAL SolveCoordinator: the production
+    scale-out shape (home-shard dequeue + work stealing, cross-worker
+    fusion, one pinned device world) with the scheduler/raft plane
+    stripped away, so the measured number is the sharded broker ->
+    coordinator -> fused-solve serving path itself."""
+
+    def __init__(self, rs, template_ask, count, n_workers, n_shards,
+                 fuse, slo_s, max_batch, max_pending):
+        import threading
+
+        from nomad_tpu.scheduler.fleet import SolveCoordinator
+        from nomad_tpu.server.blocked_evals import BlockedEvals
+        from nomad_tpu.server.eval_broker import EvalBroker
+        from nomad_tpu.server.serving import (AdmissionController,
+                                              BatchController,
+                                              EwmaSolveModel)
+        self.rs = rs
+        self.template_ask = template_ask
+        self.count = count
+        self.n_workers = n_workers
+        self.max_batch = max_batch
+        self.broker = EvalBroker(nack_delay_s=60.0, shards=n_shards)
+        self.broker.set_enabled(True)
+        self.blocked = BlockedEvals(self.broker)
+        self.blocked.set_enabled(True)
+        self.model = EwmaSolveModel()
+        self.controller = BatchController(self.model, slo_budget_s=slo_s,
+                                          max_batch=max_batch)
+        self.admission = AdmissionController(
+            max_pending=max_pending, protect_priority=80,
+            ns_rate=1e9, ns_burst=1e9, brownout_after_s=0.25)
+        self.coordinator = None
+        if fuse and n_workers > 1:
+            self.coordinator = SolveCoordinator(
+                None, max_fused=max_batch,
+                solve_fn=lambda _srv, _w, batch: self._solve(
+                    [e for e, _t in batch]))
+        self.arrival_t = {}
+        self.readmitted = set()         # excluded from the percentiles
+        self.lat_s = []
+        self.completed = 0
+        self.offered = 0
+        self.device_busy_s = 0.0
+        self.device_waves = 0
+        self.solve_calls = 0
+        self._solve_lock = threading.Lock()
+        self._lat_lock = threading.Lock()
+        self.stop = threading.Event()
+        self._seq = 0
+
+    def ingress(self, ev):
+        self.offered += 1
+        self.arrival_t[ev.id] = time.perf_counter()
+        if self.admission.offer(ev, self.broker.ready_count()):
+            self.broker.enqueue(ev)
+            return True
+        self.blocked.shed(ev)
+        return False
+
+    def worker_loop(self, index):
+        broker = self.broker
+        while not self.stop.is_set():
+            target = self.controller.target_batch(
+                broker.ready_count(), broker.oldest_ready_age())
+            batch = broker.dequeue_batch(["service"], target, 0.002,
+                                         home=index)
+            if not batch:
+                self._readmit()
+                continue
+            t0 = time.perf_counter()
+            for ev, tok in batch:
+                broker.pause_nack_timeout(ev.id, tok)
+            if self.coordinator is not None:
+                self.coordinator.submit(index, batch)
+            else:
+                self._solve([e for e, _t in batch])
+            now = time.perf_counter()
+            lats = []
+            for ev, tok in batch:
+                broker.ack(ev.id, tok)
+                t_arr = self.arrival_t.pop(ev.id, None)
+                if t_arr is not None and ev.id not in self.readmitted:
+                    lats.append(now - t_arr)
+            with self._lat_lock:
+                self.lat_s.extend(lats)
+                self.completed += len(batch)
+            self.model.observe(len(batch), now - t0)
+            self._readmit()
+
+    def _readmit(self):
+        # drain capacity back to the shed lane — also the hook that
+        # clears brownout once the queue is under the low watermark
+        quota = self.admission.readmit_quota(
+            self.broker.ready_count(), batch=self.max_batch)
+        if quota > 0:
+            for ev in self.blocked.pop_shed(quota):
+                self.readmitted.add(ev.id)
+                self.broker.enqueue(ev)
+
+    def _solve(self, evs):
+        # one fused device call for however many evals the coordinator
+        # coalesced; identical ask signatures merge to one packed row.
+        # The coordinator's round can overshoot max_fused by one
+        # member's batch, so chunk to the packed capacity — still a
+        # single stream dispatch (jobs are unique per stream here)
+        with self._solve_lock:
+            for lo in range(0, len(evs), self.max_batch):
+                n = min(self.max_batch, len(evs) - lo)
+                masks, _keys = self.rs.merge_asks(
+                    [self.template_ask] * n)
+                pb = self.rs.pack_batch(masks)
+                self._seq += 1
+                # one stream per chunk: every chunk shares the template
+                # job identity, and a job may appear in at most one
+                # batch per stream
+                self.rs.solve_stream([pb], seeds=[self._seq])
+                self.device_busy_s += self.rs.last_solve_stats["wall_s"]
+                waves = getattr(self.rs, "last_waves", None)
+                if waves is not None:
+                    import numpy as _np
+                    self.device_waves += int(_np.asarray(waves).sum())
+                self.solve_calls += 1
+
+
+def _run_scaleout_leg(rs, template_ask, count, n_workers, n_shards,
+                      fuse, duration_s, slo_s, max_batch, max_pending,
+                      used0, warmup_s=0.4):
+    """Saturate one (workers, shards, fuse) config and return its
+    record: the feeder offers as fast as admission allows, so the
+    completed rate IS the config's capacity."""
+    import gc
+    import threading
+
+    from nomad_tpu.structs import Evaluation
+    from nomad_tpu.utils.metrics import global_metrics as _gm
+
+    gc.collect()
+    rs.reset_usage(used0=used0)
+    h = _ScaleoutHarness(rs, template_ask, count, n_workers, n_shards,
+                         fuse, slo_s, max_batch, max_pending)
+    c0 = _gm.dump()["counters"]
+    workers = [threading.Thread(target=h.worker_loop, args=(i,),
+                                daemon=True) for i in range(n_workers)]
+    for t in workers:
+        t.start()
+    t_start = time.perf_counter()
+    t_meas = t_start
+    i = 0
+    warmup_done = False
+    while time.perf_counter() - t_start < warmup_s + duration_s:
+        if not warmup_done and time.perf_counter() - t_start >= warmup_s:
+            # restart the clocks: the EWMA model is trained, drop the
+            # warmup completions/latencies from the measured window
+            with h._lat_lock:
+                h.lat_s.clear()
+                h.completed = 0
+            h.device_busy_s = 0.0
+            h.device_waves = 0
+            h.solve_calls = 0
+            t_meas = time.perf_counter()
+            warmup_done = True
+        i += 1
+        if not h.ingress(Evaluation(job_id=f"sc-{i}", priority=50)):
+            time.sleep(0.0005)       # admission-bounded: back off
+    elapsed = time.perf_counter() - t_meas
+    h.stop.set()
+    for t in workers:
+        t.join(timeout=5.0)
+    c1 = _gm.dump()["counters"]
+    lat = latency_summary(h.lat_s)
+    return {
+        "workers": n_workers, "shards": n_shards, "fused": bool(fuse),
+        "completed": h.completed,
+        "evals_per_sec": round(h.completed / max(elapsed, 1e-9), 1),
+        "p50_ms": lat["p50_ms"], "p99_ms": lat["p99_ms"],
+        "device_occupancy": round(h.device_busy_s
+                                  / max(elapsed, 1e-9), 3),
+        "device_waves": h.device_waves,
+        "solve_calls": h.solve_calls,
+        "evals_per_solve": round(h.completed
+                                 / max(h.solve_calls, 1), 1),
+        "cross_worker_rounds": round(
+            c1.get("coordinator.cross_worker_rounds", 0)
+            - c0.get("coordinator.cross_worker_rounds", 0)),
+    }
+
+
+def _run_group_commit_leg(group_commit, n_plans=300, n_nodes=64):
+    """Plan applies through the real PlanApplier against a durable
+    fsynced log: group_commit=K amortizes one fsync (and one raft
+    entry) over K plans."""
+    import tempfile
+    import threading
+
+    from nomad_tpu import mock
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs import Plan
+    from nomad_tpu.utils.codec import to_wire
+
+    store = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.node_resources.cpu = 1 << 20
+        node.node_resources.memory_mb = 1 << 20
+        node.reserved_resources.cpu = 0
+        node.reserved_resources.memory_mb = 0
+        store.upsert_node(i + 1, node)
+        nodes.append(node)
+
+    state = {"index": 100, "fsyncs": 0, "entries": 0}
+    lock = threading.Lock()
+    fh = tempfile.TemporaryFile(mode="w+")
+
+    def _commit(items):
+        # leader append: serialize + flush + fsync ONCE per entry, the
+        # raft-boltdb discipline the group commit amortizes
+        with lock:
+            state["index"] += 1
+            ix = state["index"]
+            fh.write(json.dumps([to_wire(res) for _pl, res in items])
+                     + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+            state["fsyncs"] += 1
+            state["entries"] += 1
+        for plan, result in items:
+            store.upsert_plan_results(ix, result, job=plan.job)
+
+        def finish(timeout=10.0):
+            return ix
+        return 0, finish
+
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    applier = PlanApplier(
+        queue, store, None, None,
+        apply_async_fn=lambda plan, res: _commit([(plan, res)]),
+        apply_batch_async_fn=_commit if group_commit > 1 else None,
+        group_commit=group_commit)
+
+    def plan_for(i):
+        job = mock.job()
+        node = nodes[i % n_nodes]
+        plan = Plan(job=job)
+        a = mock.alloc(job=job, node_id=node.id)
+        for tr in a.allocated_resources.tasks.values():
+            tr.networks = []
+            tr.cpu = 10
+            tr.memory_mb = 10
+        plan.node_allocation[node.id] = [a]
+        return plan
+
+    plans = [plan_for(i) for i in range(n_plans)]
+    applier.start()
+    try:
+        t0 = time.perf_counter()
+        pendings = [queue.enqueue(p) for p in plans]
+        for p in pendings:
+            result, err = p.future.wait(30.0)
+            assert err is None, err
+        elapsed = time.perf_counter() - t0
+    finally:
+        applier.stop()
+        queue.set_enabled(False)
+        fh.close()
+    return {
+        "group_commit": group_commit, "plans": n_plans,
+        "raft_entries": state["entries"], "fsyncs": state["fsyncs"],
+        "plans_per_fsync": round(n_plans / max(state["fsyncs"], 1), 2),
+        "plans_per_sec": round(n_plans / max(elapsed, 1e-9), 1),
+    }
+
+
+def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
+                 duration_s=2.0, resident=5000, seed=11,
+                 grid=((1, 1), (2, 2), (4, 4), (8, 8)),
+                 write_detail=True):
+    """Scale-out control-plane phase (ISSUE 17 acceptance).
+
+    Sweeps (workers x broker shards) over the sharded-broker ->
+    SolveCoordinator -> fused-resident-solve path and reports each
+    config's saturated evals/sec at its p99, the device-occupancy
+    fraction (fused solve wall over elapsed), and the coordinator's
+    cross-worker fusion counters; plus the group-commit leg's
+    plans-per-fsync amortization.  The acceptance figure is the best
+    config's throughput relative to the single-worker single-shard
+    baseline (same solver, same machine — CPU-backend numbers are the
+    recorded profile the issue allows; the serialization the
+    coordinator removes exists on every backend)."""
+    from nomad_tpu.solver.resident import ResidentSolver
+    from nomad_tpu.solver.tensorize import Tensorizer
+
+    slo_s = slo_ms / 1000.0
+    nodes = make_nodes(n_nodes)
+    probe_job = make_job(2, 0, count)
+    template_ask = asks_for(probe_job)[0]
+    gp_need = len({Tensorizer.ask_signature(a)
+                   for a in asks_for(probe_job)})
+    t0 = time.perf_counter()
+    rs = ResidentSolver(nodes, asks_for(probe_job),
+                        gp=1 << max(0, (gp_need - 1).bit_length()),
+                        kp=1 << max(0, (count * max_batch - 1)
+                                    .bit_length()),
+                        max_waves=18)
+    used0 = resident_used0(rs.template, n_nodes, resident)
+    rs.reset_usage(used0=used0)
+    import dataclasses
+    k = 1
+    while k <= max_batch:
+        asks = [dataclasses.replace(template_ask, count=count)] * k
+        masks, _keys = rs.merge_asks(asks)
+        rs.solve_stream([rs.pack_batch(masks)], seeds=[1])
+        k <<= 1
+    rs.reset_usage(used0=used0)
+    startup_s = time.perf_counter() - t0
+
+    # admission bound sized to ~2 fused batches of backlog: saturated
+    # throughput is unaffected (workers never starve) and the admitted
+    # traffic's p99 stays queue-bounded instead of growing with the
+    # feeder's appetite
+    max_pending = max_batch * 2
+    out = {"phase": "scaleout", "n_nodes": n_nodes, "count": count,
+           "slo_ms": slo_ms, "max_batch": max_batch,
+           "duration_s": duration_s, "max_pending": max_pending,
+           "startup_s": round(startup_s, 2), "sweep": []}
+
+    base = _run_scaleout_leg(rs, template_ask, count, 1, 1, False,
+                             duration_s, slo_s, max_batch, max_pending,
+                             used0)
+    out["baseline"] = base
+    sys.stderr.write(f"scaleout baseline 1wx1s: "
+                     f"{base['evals_per_sec']}/s "
+                     f"p99={base['p99_ms']}ms "
+                     f"occ={base['device_occupancy']}\n")
+    best = base
+    for n_workers, n_shards in grid:
+        if (n_workers, n_shards) == (1, 1):
+            continue
+        rec = _run_scaleout_leg(rs, template_ask, count, n_workers,
+                                n_shards, True, duration_s, slo_s,
+                                max_batch, max_pending, used0)
+        out["sweep"].append(rec)
+        sys.stderr.write(
+            f"scaleout {n_workers}wx{n_shards}s fused: "
+            f"{rec['evals_per_sec']}/s p99={rec['p99_ms']}ms "
+            f"occ={rec['device_occupancy']} "
+            f"xw_rounds={rec['cross_worker_rounds']}\n")
+        if rec["evals_per_sec"] > best["evals_per_sec"]:
+            best = rec
+
+    gc_legs = [_run_group_commit_leg(k) for k in (1, 8, 32)]
+    out["group_commit"] = gc_legs
+    for leg in gc_legs:
+        sys.stderr.write(
+            f"group-commit K={leg['group_commit']}: "
+            f"{leg['plans_per_sec']}/s "
+            f"{leg['plans_per_fsync']} plans/fsync\n")
+
+    rel = (best["evals_per_sec"] / base["evals_per_sec"]
+           if base["evals_per_sec"] else float("inf"))
+    amortized = max(leg["plans_per_fsync"] for leg in gc_legs)
+    out["best"] = best
+    out["relative_speedup"] = round(rel, 2)
+    out["acceptance"] = {
+        "best_evals_per_sec": best["evals_per_sec"],
+        "ge_50k_evals_per_sec": best["evals_per_sec"] >= 50_000,
+        "ge_10x_relative": rel >= 10.0,
+        "bounded_p99_ms": best["p99_ms"],
+        "group_commit_amortizes_fsync": amortized > 1.5,
+        "backend": "cpu (recorded profile; the issue's 10x target "
+                   "binds on accelerator backends)",
+    }
+    out["ok"] = bool(rel > 1.0
+                     and out["acceptance"]["group_commit_amortizes_fsync"])
+    if write_detail:
+        # merge into BENCH_DETAIL.json preserving the other phases
+        path = os.path.join(REPO, "BENCH_DETAIL.json")
+        try:
+            with open(path) as f:
+                detail = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            detail = {}
+        detail["scaleout"] = out
+        with open(path, "w") as f:
+            json.dump(detail, f, indent=1)
+    return out
+
+
 def run_tracing_overhead(n_nodes=10_000, count=64, resident=100_000,
                          batch=32, iters=24, reps=5, warmup=4,
                          write_detail=True):
@@ -2872,6 +3267,12 @@ def main():
         out = run_open_loop()
         print("\x1e" + json.dumps(out))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--scaleout":
+        # subprocess mode: the scale-out control-plane phase (ISSUE 17)
+        # — merges its record into BENCH_DETAIL.json under "scaleout"
+        out = run_scaleout()
+        print("\x1e" + json.dumps(out))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--overcommit":
         # subprocess mode: the in-kernel preemption phase (ISSUE 7) —
         # merges its record into BENCH_DETAIL.json under "overcommit"
@@ -3025,6 +3426,27 @@ def main():
         sys.stderr.write(
             f"open-loop phase failed rc={ol.returncode}:\n"
             f"{(ol.stderr or '')[-1500:]}\n")
+    # scale-out control-plane phase (ISSUE 17) in its own subprocess:
+    # it runs worker/coordinator thread fleets over a resident world
+    # and must not perturb the configs' device state; self-merged into
+    # BENCH_DETAIL.json too
+    scaleout = None
+    so = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scaleout"],
+        capture_output=True, text=True)
+    for line in so.stdout.splitlines():
+        if line.startswith("\x1e"):
+            try:
+                scaleout = json.loads(line[1:])
+            except json.JSONDecodeError:
+                scaleout = None
+    if scaleout is None:
+        scaleout = {"phase": "scaleout", "skipped": True,
+                    "rc": so.returncode,
+                    "tail": (so.stderr or so.stdout)[-1500:]}
+        sys.stderr.write(
+            f"scaleout phase failed rc={so.returncode}:\n"
+            f"{(so.stderr or '')[-1500:]}\n")
     # overcommit / in-kernel preemption phase (ISSUE 7) in its own
     # subprocess: it drives the full scheduler stack over a store and
     # toggles NOMAD_TPU_EVICT_E between legs
@@ -3090,6 +3512,7 @@ def main():
               "multichip": multichip,
               "multiregion": multiregion,
               "open_loop": open_loop,
+              "scaleout": scaleout,
               "overcommit": overcommit,
               "tracing_overhead": tracing,
               "telemetry": telemetry,

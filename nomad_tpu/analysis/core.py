@@ -179,8 +179,8 @@ class AnalysisConfig:
     # here; dynamically-built names are cardinality hazards (OBS802,
     # warn) that carry a baseline justification naming the bound.
     obs_metric_prefixes: Tuple[str, ...] = (
-        "broker", "health", "mesh", "metrics", "plan", "rpc",
-        "scheduler", "serving", "slo", "solver", "telemetry",
+        "broker", "coordinator", "health", "mesh", "metrics", "plan",
+        "rpc", "scheduler", "serving", "slo", "solver", "telemetry",
         "watchdog", "worker",
     )
     # the sinks themselves (name arrives as a parameter there)
@@ -212,7 +212,7 @@ class FuncInfo:
 
 class ClassInfo:
     __slots__ = ("key", "module", "name", "node", "bases", "methods",
-                 "attr_types", "path")
+                 "attr_types", "attr_elem_types", "path")
 
     def __init__(self, key: str, module: str, name: str,
                  node: ast.ClassDef, path: str):
@@ -224,6 +224,10 @@ class ClassInfo:
         self.bases: List[str] = []          # resolved class keys
         self.methods: Dict[str, str] = {}   # name -> func key
         self.attr_types: Dict[str, str] = {}  # self attr -> class key
+        # self attr -> ELEMENT class key for list-of-instances attrs
+        # (`self._shards = [_Shard(...) for ...]`) — the sharded-
+        # container composition edge (ISSUE 17)
+        self.attr_elem_types: Dict[str, str] = {}
 
 
 class ModuleInfo:
@@ -383,6 +387,10 @@ class PackageIndex:
                             t = self._expr_class(mi, ann, node.value)
                             if t:
                                 ci.attr_types.setdefault(tgt.attr, t)
+                            et = self._elem_class(mi, ann, node.value)
+                            if et:
+                                ci.attr_elem_types.setdefault(tgt.attr,
+                                                              et)
 
     def _annotation_class(self, mi: ModuleInfo, node) -> Optional[str]:
         if node is None:
@@ -402,6 +410,20 @@ class PackageIndex:
             return None
         r = self._resolve_symbol(mi, name)
         return r if r in self.classes else None
+
+    def _elem_class(self, mi: ModuleInfo, ann: Dict[str, str],
+                    node) -> Optional[str]:
+        """Element class key of a list-of-instances expression —
+        `[_Shard(...) for ...]` or `[Foo(), Foo()]` — if every element
+        infers to the same package class."""
+        if isinstance(node, ast.ListComp):
+            return self._expr_class(mi, ann, node.elt)
+        if isinstance(node, ast.List) and node.elts:
+            ts = {self._expr_class(mi, ann, e) for e in node.elts}
+            ts.discard(None)
+            if len(ts) == 1:
+                return ts.pop()
+        return None
 
     def _expr_class(self, mi: ModuleInfo, ann: Dict[str, str],
                     node) -> Optional[str]:

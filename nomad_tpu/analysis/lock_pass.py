@@ -96,7 +96,11 @@ def _thread_shared_classes(index: PackageIndex) -> Set[str]:
             ci = index.classes.get(ck)
             if ci is None:
                 continue
-            for tkey in ci.attr_types.values():
+            # composition edges: scalar attrs AND list-of-instances
+            # containers (a shard hung off a threaded broker is reached
+            # from every dequeue thread — ISSUE 17)
+            for tkey in list(ci.attr_types.values()) \
+                    + list(ci.attr_elem_types.values()):
                 if tkey in index.classes and tkey not in shared:
                     shared.add(tkey)
                     changed = True
@@ -171,6 +175,45 @@ def run_lock_pass(index: PackageIndex, cfg: AnalysisConfig
                          "the method with a `_locked` suffix if the "
                          "caller is documented to hold it"))
             _ = guarded  # (used by LOCK302 below; kept for symmetry)
+
+    # ---- LOCK301 (sharded containers, ISSUE 17): a write that reaches
+    # an ELEMENT of a lock-owning class through a subscripted container
+    # (`self._shards[i].attr = v`) must hold the element's OWN lock —
+    # the owning class's lock (if any) does not guard shard state
+    for ck in sorted(thread_shared):
+        ci = index.classes.get(ck)
+        if ci is None or not _in_scope(ci.module, cfg):
+            continue
+        for cont, elem_key in sorted(ci.attr_elem_types.items()):
+            elem_locks = lock_owners.get(elem_key)
+            if not elem_locks:
+                continue
+            elem_name = index.classes[elem_key].name
+            for mname, fkey in sorted(ci.methods.items()):
+                if mname == "__init__" or mname.endswith("_locked"):
+                    continue
+                fi = index.functions[fkey]
+                spans = _elem_locked_regions(fi, cont, elem_locks)
+                for node in index._own_nodes(fi):
+                    w = _subscript_attr_write(node)
+                    if w is None:
+                        continue
+                    wcont, attr, line = w
+                    if wcont != cont or attr in elem_locks:
+                        continue
+                    if _in_spans(line, spans):
+                        continue
+                    findings.append(Finding(
+                        "LOCK301", ci.module, f"{ci.name}.{mname}",
+                        f"{cont}[].{attr}", ci.path, line,
+                        f"`self.{cont}[...].{attr}` is written without "
+                        f"the owning {elem_name} shard's "
+                        f"{_lock_label(elem_locks)}; per-shard state "
+                        "must be guarded by the element's own lock",
+                        hint="wrap the write in `with "
+                             f"self.{cont}[i].{sorted(elem_locks)[0]}:`"
+                             " or route it through a shard method that "
+                             "takes its lock"))
 
     # ---- LOCK302: racy getters
     for ck, locks in sorted(lock_owners.items()):
@@ -266,6 +309,54 @@ def _self_attr_write(node) -> Optional[Tuple[str, int]]:
                 base.value, ast.Name) and base.value.id == "self":
             return base.attr, node.lineno
     return None
+
+
+def _subscript_attr_write(node) -> Optional[Tuple[str, str, int]]:
+    """(container_attr, leaf_attr, line) for writes of the shape
+    `self.<cont>[...].<attr> = v` (one subscript hop off self)."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    for t in targets:
+        leaf = t
+        while isinstance(leaf, ast.Subscript):
+            leaf = leaf.value
+        if not isinstance(leaf, ast.Attribute):
+            continue
+        sub = leaf.value
+        if not isinstance(sub, ast.Subscript):
+            continue
+        base = sub.value
+        if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name) and base.value.id == "self":
+            return base.attr, leaf.attr, node.lineno
+    return None
+
+
+def _elem_locked_regions(fi, cont: str, elem_locks: Set[str]):
+    """Line spans covered by `with self.<cont>[...].<lock>:` — the
+    subscripted form with_lock_names can't render (its _dotted walker
+    stops at a Subscript)."""
+    spans = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ce = item.context_expr
+            if not (isinstance(ce, ast.Attribute)
+                    and ce.attr in elem_locks
+                    and isinstance(ce.value, ast.Subscript)):
+                continue
+            base = ce.value.value
+            if isinstance(base, ast.Attribute) and isinstance(
+                    base.value, ast.Name) and base.value.id == "self" \
+                    and base.attr == cont:
+                spans.append((node.lineno, _end(node)))
+    return spans
 
 
 def _guarded_attrs(index: PackageIndex, ci: ClassInfo,

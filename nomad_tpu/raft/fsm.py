@@ -104,6 +104,16 @@ class StateFSM:
         job = from_wire(Job, p["job"]) if p.get("job") is not None else None
         self.store.upsert_plan_results(index, result, job)
 
+    def _ap_plan_results_batch(self, index, p):
+        # group commit (ISSUE 17): K plan results in one log entry, in
+        # submission order, all under the shared commit index — the same
+        # store state K consecutive plan_result entries would produce
+        for item in p["items"]:
+            result = from_wire(PlanResult, item["result"])
+            job = from_wire(Job, item["job"]) \
+                if item.get("job") is not None else None
+            self.store.upsert_plan_results(index, result, job)
+
     def _ap_job_stability(self, index, p):
         self.store.update_job_stability(index, p["namespace"],
                                         p["job_id"], p["version"],

@@ -16,11 +16,17 @@ to the single-eval path, which sees its stops.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, Allocation,
                        Evaluation, JOB_TYPE_BATCH, JOB_TYPE_SERVICE)
 from .generic import GenericScheduler, _VALID_TRIGGERS
+
+#: hard ceiling on evals fused into one coordinator round — beyond
+#: this the ask tensor gets big enough that solve wall grows past the
+#: SLO budget the BatchController sized the member batches for
+DEFAULT_MAX_FUSED = 128
 
 
 class _Entry:
@@ -175,3 +181,129 @@ def process_fleet(server, worker, batch: List[Tuple[Evaluation, str]]
         else:
             # partial commit / refresh: the single-eval retry loop owns it
             worker._process(e.ev, e.token)
+
+
+class _FusedSubmission:
+    """One worker's bulk batch parked on the coordinator: the worker
+    blocks on `done` while the drain leader solves it (possibly fused
+    with other workers' batches)."""
+
+    __slots__ = ("worker", "batch", "done", "error")
+
+    def __init__(self, worker, batch):
+        self.worker = worker
+        self.batch = batch
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+class SolveCoordinator:
+    """Cross-worker solve fusion (ISSUE 17): N dequeue workers submit
+    their bulk batches here instead of each running its own
+    process_fleet — the first submitter becomes the drain leader,
+    coalesces every queued submission into ONE combined batch, and runs
+    the existing process_fleet path on a single pinned solver, so the
+    device sees one big wave instead of N serialized small ones.
+    Non-leaders park on a per-batch future.
+
+    Lock discipline: `self._lock` guards only the queue/role flags and
+    is NEVER held across the device solve or a submission wait — a
+    submitter holding it through `done.wait()` would deadlock the drain
+    leader trying to pick its batch up (the LOCK304 shape the lint
+    fixture pins down).
+
+    `pause()`/`resume()` is the determinism hook for tests: paused, the
+    coordinator only accumulates submissions; `resume()` drains them in
+    one fused round, so a test can prove fusion produces placements
+    identical to serialized singles."""
+
+    def __init__(self, server, max_fused: int = DEFAULT_MAX_FUSED,
+                 solve_fn=None):
+        self.server = server
+        self.max_fused = max(1, int(max_fused))
+        #: (server, worker, combined_batch) -> None; defaults to the
+        #: scheduler-plane process_fleet — the bench injects a direct
+        #: resident-solver path here to measure fusion alone
+        self.solve_fn = solve_fn
+        self._lock = threading.Lock()
+        self._queue: List[_FusedSubmission] = []
+        self._draining = False
+        self._paused = False
+        # the single resident solver the combined waves run on: pinned
+        # to the first drain leader's worker so every fused round reuses
+        # one tensorized world + compile cache
+        self._solve_worker = None
+
+    def submit(self, worker, batch: List[Tuple[Evaluation, str]]) -> None:
+        """Solve `batch`, fused with whatever other workers have queued.
+        Blocks until the batch's evals are acked/nacked/fallen back;
+        re-raises the drain error so the caller's nack path owns its
+        own evals."""
+        sub = _FusedSubmission(worker, batch)
+        with self._lock:
+            self._queue.append(sub)
+            leader = not self._draining and not self._paused
+            if leader:
+                self._draining = True
+        if leader:
+            self._drain(worker)
+        if not sub.done.wait(60.0):
+            raise TimeoutError("fused solve coordinator timed out")
+        if sub.error is not None:
+            raise sub.error
+
+    def pause(self) -> None:
+        """Hold submissions without draining (test/chaos hook)."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        """Release a pause; the resuming thread drains the backlog."""
+        with self._lock:
+            self._paused = False
+            leader = not self._draining and bool(self._queue)
+            if leader:
+                self._draining = True
+        if leader:
+            self._drain(None)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _drain(self, worker) -> None:
+        """Drain leader: fuse queued submissions round by round until
+        the queue is empty (submissions landing mid-solve join the next
+        round).  The role flag hand-off is atomic with the queue check,
+        so a submission is never left behind without a drainer."""
+        from ..utils.metrics import global_metrics as _m
+        while True:
+            with self._lock:
+                if self._paused or not self._queue:
+                    self._draining = False
+                    return
+                round_subs: List[_FusedSubmission] = []
+                total = 0
+                while self._queue and total < self.max_fused:
+                    s = self._queue.pop(0)
+                    round_subs.append(s)
+                    total += len(s.batch)
+                if self._solve_worker is None:
+                    self._solve_worker = worker or round_subs[0].worker
+                solve_worker = self._solve_worker
+            combined = [pair for s in round_subs for pair in s.batch]
+            _m.add_sample("coordinator.fused_evals", float(len(combined)))
+            if len(round_subs) > 1:
+                _m.incr_counter("coordinator.cross_worker_rounds")
+            _m.incr_counter("coordinator.rounds")
+            try:
+                (self.solve_fn or process_fleet)(
+                    self.server, solve_worker, combined)
+            except Exception as exc:
+                # each submitter nacks its OWN evals from its worker
+                # loop's failure path — the coordinator only relays
+                for s in round_subs:
+                    s.error = exc
+            finally:
+                for s in round_subs:
+                    s.done.set()

@@ -6,19 +6,33 @@ Semantics mirror nomad/eval_broker.go — per-scheduler-type priority heaps
 and a delivery limit that shunts flapping evals to a `_failed` queue
 (:23, :531, :595), and delayed evals via a wait-until heap (:89, :751).
 
-`dequeue_batch` drains up to K ready evals — each for a different job, by
-construction of the per-job serialization — and is the coalescing point
-for the fused multi-eval device solve (SURVEY §2.5); the stock worker
-loop dequeues singly, matching the reference.  K is sized per dequeue by
-the serving tier's BatchController (server/serving.py) from the queue
-depth and the oldest ready eval's age, which the broker tracks here.
+SHARDING (ISSUE 17): the broker is partitioned into S independent
+shards keyed by crc32(namespace, job) — per-shard lock, ready heaps,
+`_ready_since` insertion-order age tracking, job slots and nack
+timers.  A job maps to exactly one shard, so per-job serialization
+holds by construction without any cross-shard coordination; evals
+without a job route by eval id.  Dequeue starts at the caller's home
+shard (its worker index) and steals from the other shards when the
+home shard is dry, so no shard strands work.  One shard (the default)
+is bit-identical to the pre-shard broker: same heap ordering, same
+seeded nack-jitter schedule, same delivery-limit parking.
+
+`dequeue_batch` drains up to K ready evals — each for a different job,
+by construction of the per-job serialization — and is the coalescing
+point for the fused multi-eval device solve (SURVEY §2.5); the stock
+worker loop dequeues singly, matching the reference.  K is sized per
+dequeue by the serving tier's BatchController (server/serving.py) from
+the queue depth and the oldest ready eval's age, which the broker
+tracks here.
 """
 from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import threading
 import time as _time
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..structs import EVAL_STATUS_PENDING, Evaluation
@@ -30,6 +44,17 @@ DEFAULT_NACK_DELAY_S = 5.0
 DEFAULT_INITIAL_NACK_DELAY_S = 1.0
 DEFAULT_MAX_NACK_DELAY_S = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
+#: shard count when neither the ctor nor NOMAD_TPU_BROKER_SHARDS says
+#: otherwise — 1 keeps the reference (pre-shard) behavior bit-identical
+DEFAULT_BROKER_SHARDS = 1
+
+
+def _default_shards() -> int:
+    try:
+        return max(1, int(os.environ.get("NOMAD_TPU_BROKER_SHARDS",
+                                         str(DEFAULT_BROKER_SHARDS))))
+    except ValueError:
+        return DEFAULT_BROKER_SHARDS
 
 
 class _Heap:
@@ -63,18 +88,23 @@ class _Unack:
         self.nack_timer: Optional[threading.Timer] = None
 
 
-class EvalBroker:
-    def __init__(self, nack_delay_s: float = DEFAULT_NACK_DELAY_S,
-                 initial_nack_delay_s: float = DEFAULT_INITIAL_NACK_DELAY_S,
-                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
-                 max_nack_delay_s: float = DEFAULT_MAX_NACK_DELAY_S,
-                 nack_jitter_seed: int = 0xACED):
-        self._lock = threading.Condition()
-        self._enabled = False
+class _Shard:
+    """One broker partition: its own lock, ready heaps, job slots,
+    unacked set, delay heap and nack timers.  All cross-thread entry
+    points take `self._lock`; `_locked`-suffixed helpers document the
+    caller already holds it.  Wake-ups for blocked dequeuers go through
+    the owning broker's shared ready condition (`notify_ready`) — the
+    shard lock is never held while waiting, only while mutating."""
+
+    def __init__(self, broker: "EvalBroker", index: int,
+                 nack_jitter_seed: int):
+        self._broker = broker
+        self.index = index
+        self._lock = threading.Lock()
         self._ready: Dict[str, _Heap] = {}
         self._unack: Dict[str, _Unack] = {}
-        self._job_evals: Dict[Tuple[str, str], str] = {}   # (ns, job) -> eval
-        self._blocked: Dict[Tuple[str, str], _Heap] = {}   # per-job backlog
+        self._job_evals: Dict[Tuple[str, str], str] = {}  # (ns, job) -> eval
+        self._blocked: Dict[Tuple[str, str], _Heap] = {}  # per-job backlog
         self._requeue: Dict[str, Evaluation] = {}  # token-gated re-enqueue
         self._waiting: Dict[str, Evaluation] = {}  # delayed (wait_until)
         self._delay_heap: List[tuple] = []
@@ -85,104 +115,11 @@ class EvalBroker:
         # SLO-budget close rule input (insertion order ~ enqueue order,
         # so the first live entry is the oldest)
         self._ready_since: Dict[str, float] = {}
-        self.nack_delay_s = nack_delay_s
-        self.initial_nack_delay_s = initial_nack_delay_s
-        self.max_nack_delay_s = max_nack_delay_s
-        self.delivery_limit = delivery_limit
         self._deliveries: Dict[str, int] = {}
-        # seeded so chaos/replay runs see the same redelivery schedule
+        # seeded per shard so chaos/replay runs see the same redelivery
+        # schedule; shard 0 keeps the exact pre-shard sequence
         import random as _random
-        self._nack_rng = _random.Random(nack_jitter_seed)
-        self._delay_thread: Optional[threading.Thread] = None
-        self._stop_delay = threading.Event()
-
-    # ------------------------------------------------------------ lifecycle
-    def set_enabled(self, enabled: bool) -> None:
-        with self._lock:
-            prev = self._enabled
-            self._enabled = enabled
-            if enabled and not prev:
-                # thread handle guarded by _lock (the watcher's first
-                # action is to take it, so starting under the lock just
-                # briefly blocks the new thread)
-                self._stop_delay.clear()
-                self._delay_thread = threading.Thread(
-                    target=self._run_delayed_watcher, daemon=True)
-                self._delay_thread.start()
-        if prev and not enabled:
-            self.flush()
-        if not enabled:
-            self._stop_delay.set()
-
-    @property
-    def enabled(self) -> bool:
-        with self._lock:    # guarded by _lock: see set_enabled
-            return self._enabled
-
-    def ready_count(self) -> int:
-        """Evals ready for dequeue right now (not delayed/unacked)."""
-        with self._lock:
-            return sum(len(h) for h in self._ready.values())
-
-    def oldest_ready_age(self) -> float:
-        """Seconds the oldest currently-ready eval has been waiting.
-        Dict insertion order tracks enqueue order, so the first live
-        entry is the oldest — O(1), called per dequeue by the
-        BatchController."""
-        with self._lock:
-            for t0 in self._ready_since.values():
-                return _time.monotonic() - t0
-            return 0.0
-
-    def export_metrics(self) -> None:
-        """Publish queue-shape gauges through the global metrics path
-        (surfaced at /v1/metrics next to the worker.dequeue_eval
-        counters).  Called by the worker loop each iteration — cheap:
-        one lock hold, no allocation beyond the per-queue dict walk."""
-        from ..utils.metrics import global_metrics as _m
-        with self._lock:
-            ready = {q: len(h) for q, h in self._ready.items()}
-            unacked = len(self._unack)
-            waiting = len(self._waiting)
-            blocked = sum(len(h) for h in self._blocked.values())
-            oldest = 0.0
-            for t0 in self._ready_since.values():
-                oldest = _time.monotonic() - t0
-                break
-            # per-eval delivery counts: only evals past their first
-            # delivery (the interesting, bounded set — at most
-            # delivery_limit redeliveries each before parking), so
-            # gauge cardinality stays proportional to flapping evals,
-            # not throughput; the registry's namespace cap absorbs
-            # pathological storms as metrics.overflow
-            redelivered = {eid: n for eid, n in self._deliveries.items()
-                           if n > 1}
-        _m.set_gauge("broker.ready_count", float(sum(ready.values())))
-        _m.set_gauge("broker.redelivering", float(len(redelivered)))
-        for eid, n in redelivered.items():
-            _m.set_gauge(f"broker.deliveries.{eid}", float(n))
-        _m.set_gauge("broker.oldest_ready_age_s", oldest)
-        _m.set_gauge("broker.unacked", float(unacked))
-        _m.set_gauge("broker.waiting", float(waiting))
-        _m.set_gauge("broker.job_blocked", float(blocked))
-        for q, n in ready.items():
-            _m.set_gauge(f"broker.ready.{q}", float(n))
-
-    def flush(self) -> None:
-        with self._lock:
-            for u in self._unack.values():
-                if u.nack_timer:
-                    u.nack_timer.cancel()
-            self._ready.clear()
-            self._unack.clear()
-            self._job_evals.clear()
-            self._blocked.clear()
-            self._requeue.clear()
-            self._waiting.clear()
-            self._delay_heap.clear()
-            self._deliveries.clear()
-            self._ready_since.clear()
-            self._lock.notify_all()
+        self._nack_rng = _random.Random(nack_jitter_seed + index)
 
     # ------------------------------------------------------------- enqueue
     def enqueue(self, ev: Evaluation) -> None:
@@ -190,8 +127,6 @@ class EvalBroker:
             self._enqueue_locked(ev, ev.type)
 
     def enqueue_all(self, evals: List[Tuple[Evaluation, str]]) -> None:
-        """Enqueue (eval, token) pairs; a matching token for an unacked
-        eval defers the re-enqueue until that eval is acked."""
         with self._lock:
             for ev, token in evals:
                 if token:
@@ -208,14 +143,13 @@ class EvalBroker:
             self._enqueue_locked(ev, ev.type)
 
     def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
-        if not self._enabled:
+        if not self._broker.enabled_flag:
             return
         if ev.id in self._unack or ev.id in self._waiting:
             return
         if ev.wait_until and ev.wait_until > _time.time():
             self._waiting[ev.id] = ev
             heapq.heappush(self._delay_heap, (ev.wait_until, ev.id))
-            self._lock.notify_all()
             return
         namespaced = (ev.namespace, ev.job_id)
         if queue != FAILED_QUEUE and ev.job_id:
@@ -228,52 +162,32 @@ class EvalBroker:
             self._job_evals[namespaced] = ev.id
         self._ready.setdefault(queue, _Heap()).push(ev)
         self._ready_since[ev.id] = _time.monotonic()
-        _tr.event(ev.id, "broker.enqueue", queue=queue)
-        self._lock.notify_all()
+        _tr.event(ev.id, "broker.enqueue", queue=queue, shard=self.index)
+        self._broker.notify_ready()
 
     # ------------------------------------------------------------- dequeue
-    def dequeue(self, sched_types: Sequence[str], timeout: float = 0.0
-                ) -> Tuple[Optional[Evaluation], str]:
-        deadline = _time.monotonic() + timeout
+    def try_dequeue(self, sched_types: Sequence[str]
+                    ) -> Tuple[Optional[Evaluation], str]:
+        """Non-blocking: pop the best ready eval, register the unack and
+        start its nack timer.  Returns (eval, token) or (None, "")."""
         with self._lock:
-            while True:
-                ev, age = self._dequeue_locked(sched_types)
-                if ev is not None:
-                    token = generate_uuid()
-                    u = _Unack(ev, token)
-                    self._unack[ev.id] = u
-                    self._deliveries[ev.id] = \
-                        self._deliveries.get(ev.id, 0) + 1
-                    self._dequeues += 1
-                    self._start_nack_timer(u)
-                    _tr.event(ev.id, "broker.dequeue",
-                              queue_age_s=round(age, 6),
-                              delivery=self._deliveries[ev.id])
-                    return ev, token
-                remain = deadline - _time.monotonic()
-                if remain <= 0 or not self._enabled:
-                    return None, ""
-                self._lock.wait(remain)
-
-    def dequeue_batch(self, sched_types: Sequence[str], max_batch: int,
-                      timeout: float = 0.0
-                      ) -> List[Tuple[Evaluation, str]]:
-        """Drain up to max_batch ready evals (the TPU coalescing point).
-        Blocks for the first eval only; the rest are taken opportunistically."""
-        first, token = self.dequeue(sched_types, timeout)
-        if first is None:
-            return []
-        out = [(first, token)]
-        while len(out) < max_batch:
-            ev, tok = self.dequeue(sched_types, 0.0)
+            ev, age = self._dequeue_locked(sched_types)
             if ev is None:
-                break
-            out.append((ev, tok))
-        # dequeue-batch size histogram (p50/p99 via the metrics
-        # reservoir) — the observability face of the BatchController
-        from ..utils.metrics import global_metrics as _m
-        _m.add_sample("broker.dequeue_batch_size", float(len(out)))
-        return out
+                return None, ""
+            # shard index rides in the token so ack/nack route without
+            # a broker-level eval->shard map (no shared lock on the
+            # ack path)
+            token = f"{self.index}.{generate_uuid()}"
+            u = _Unack(ev, token)
+            self._unack[ev.id] = u
+            self._deliveries[ev.id] = self._deliveries.get(ev.id, 0) + 1
+            self._dequeues += 1
+            self._start_nack_timer(u)
+            _tr.event(ev.id, "broker.dequeue",
+                      queue_age_s=round(age, 6),
+                      delivery=self._deliveries[ev.id],
+                      shard=self.index)
+            return ev, token
 
     def _dequeue_locked(self, sched_types: Sequence[str]
                         ) -> Tuple[Optional[Evaluation], float]:
@@ -297,7 +211,7 @@ class EvalBroker:
         return ev, age
 
     def _start_nack_timer(self, u: _Unack) -> None:
-        t = threading.Timer(self.nack_delay_s,
+        t = threading.Timer(self._broker.nack_delay_s,
                             self._nack_timeout, args=(u.eval.id, u.token))
         t.daemon = True
         u.nack_timer = t
@@ -310,10 +224,8 @@ class EvalBroker:
                 return
         self.nack(eval_id, token)
 
-    def pause_nack_timeout(self, eval_id: str, token: str) -> Optional[str]:
-        """Stop the redelivery timer while the holder does long work
-        (reference: eval_broker PauseNackTimeout, used while waiting on
-        raft / the fused solve). The holder must still ack or nack."""
+    def pause_nack_timeout(self, eval_id: str,
+                           token: str) -> Optional[str]:
         with self._lock:
             u = self._unack.get(eval_id)
             if u is None or u.token != token:
@@ -323,7 +235,8 @@ class EvalBroker:
                 u.nack_timer = None
             return None
 
-    def resume_nack_timeout(self, eval_id: str, token: str) -> Optional[str]:
+    def resume_nack_timeout(self, eval_id: str,
+                            token: str) -> Optional[str]:
         with self._lock:
             u = self._unack.get(eval_id)
             if u is None or u.token != token:
@@ -351,8 +264,8 @@ class EvalBroker:
 
     def _release_job_slot_locked(self, ev: Evaluation,
                                  eval_id: str) -> None:
-        """Free the job's serialization slot and promote its next blocked
-        eval, if any."""
+        """Free the job's serialization slot and promote its next
+        blocked eval, if any."""
         namespaced = (ev.namespace, ev.job_id)
         if self._job_evals.get(namespaced) != eval_id:
             return
@@ -365,7 +278,7 @@ class EvalBroker:
             self._job_evals[namespaced] = nxt.id
             self._ready.setdefault(nxt.type, _Heap()).push(nxt)
             self._ready_since[nxt.id] = _time.monotonic()
-            self._lock.notify_all()
+            self._broker.notify_ready()
 
     def nack(self, eval_id: str, token: str) -> Optional[str]:
         with self._lock:
@@ -384,73 +297,411 @@ class EvalBroker:
             # until it is acked (reference Nack semantics) so a newer eval
             # for the job can't jump ahead of the redelivery; the slot is
             # only freed when the eval is parked for the failed-eval reaper
-            if self._deliveries.get(eval_id, 0) >= self.delivery_limit:
+            if self._deliveries.get(eval_id, 0) >= \
+                    self._broker.delivery_limit:
                 self._release_job_slot_locked(ev, eval_id)
                 # too many failed deliveries: park it for the leader reaper
                 self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
                 self._ready_since[ev.id] = _time.monotonic()
                 _tr.event(eval_id, "broker.nack", parked=True,
                           deliveries=self._deliveries.get(eval_id, 0))
-                self._lock.notify_all()
+                self._broker.notify_ready()
                 return None
             # redeliver after a capped jittered exponential delay:
             # linear compounding barely separates a flapping eval from
             # healthy redeliveries, and unjittered delays re-collide a
             # burst of nacked evals at every retry (thundering herd)
             n = max(1, self._deliveries.get(eval_id, 1))
-            delay = min(self.max_nack_delay_s,
-                        self.initial_nack_delay_s * (2 ** (n - 1)))
+            delay = min(self._broker.max_nack_delay_s,
+                        self._broker.initial_nack_delay_s * (2 ** (n - 1)))
             delay *= 0.5 + self._nack_rng.random() / 2.0
             _tr.event(eval_id, "broker.nack", parked=False,
                       deliveries=self._deliveries.get(eval_id, 0),
                       redeliver_delay_s=round(delay, 6))
-            ev2 = ev
             deadline = _time.time() + delay
-            self._waiting[ev2.id] = ev2
-            heapq.heappush(self._delay_heap, (deadline, ev2.id))
-            self._lock.notify_all()
+            self._waiting[ev.id] = ev
+            heapq.heappush(self._delay_heap, (deadline, ev.id))
             return None
 
-    # ------------------------------------------------------ delayed watcher
-    def _run_delayed_watcher(self) -> None:
-        while not self._stop_delay.is_set():
-            with self._lock:
-                now = _time.time()
-                wait = 0.1
-                while self._delay_heap and self._delay_heap[0][0] <= now:
-                    _, eid = heapq.heappop(self._delay_heap)
-                    ev = self._waiting.pop(eid, None)
-                    if ev is not None:
-                        ev2 = ev
-                        if ev2.wait_until:
-                            import copy
-                            ev2 = copy.copy(ev)
-                            ev2.wait_until = 0.0
-                        self._enqueue_locked(ev2, ev2.type)
-                if self._delay_heap:
-                    wait = min(wait, max(0.0,
-                                         self._delay_heap[0][0] - now))
-            self._stop_delay.wait(max(wait, 0.01))
-
-    # --------------------------------------------------------------- stats
-    def stats(self) -> dict:
+    # ------------------------------------------------------------ plumbing
+    def pop_due_delayed(self) -> float:
+        """Promote delayed evals whose wait has expired (called by the
+        broker's single delayed-watcher thread).  Returns the seconds
+        until this shard's next deadline (or 0.1 when idle)."""
         with self._lock:
-            oldest = 0.0
+            now = _time.time()
+            wait = 0.1
+            while self._delay_heap and self._delay_heap[0][0] <= now:
+                _, eid = heapq.heappop(self._delay_heap)
+                ev = self._waiting.pop(eid, None)
+                if ev is not None:
+                    ev2 = ev
+                    if ev2.wait_until:
+                        import copy
+                        ev2 = copy.copy(ev)
+                        ev2.wait_until = 0.0
+                    self._enqueue_locked(ev2, ev2.type)
+            if self._delay_heap:
+                wait = min(wait, max(0.0, self._delay_heap[0][0] - now))
+            return wait
+
+    def flush(self) -> None:
+        with self._lock:
+            for u in self._unack.values():
+                if u.nack_timer:
+                    u.nack_timer.cancel()
+            self._ready.clear()
+            self._unack.clear()
+            self._job_evals.clear()
+            self._blocked.clear()
+            self._requeue.clear()
+            self._waiting.clear()
+            self._delay_heap.clear()
+            self._deliveries.clear()
+            self._ready_since.clear()
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(len(h) for h in self._ready.values())
+
+    def oldest_ready_t0(self) -> Optional[float]:
+        """Monotonic enqueue time of this shard's oldest ready eval."""
+        with self._lock:
             for t0 in self._ready_since.values():
-                oldest = _time.monotonic() - t0
-                break
-            return {
-                "total_ready": sum(len(h) for h in self._ready.values()),
-                "total_unacked": len(self._unack),
-                "total_blocked": sum(len(h) for h in self._blocked.values()),
-                "total_waiting": len(self._waiting),
-                "by_scheduler": {q: len(h) for q, h in self._ready.items()},
-                "dequeues": self._dequeues,
-                "nacks": self._nacks,
-                "oldest_ready_age_s": round(oldest, 6),
-            }
+                return t0
+            return None
 
     def outstanding(self, eval_id: str) -> Optional[str]:
         with self._lock:
             u = self._unack.get(eval_id)
             return u.token if u else None
+
+    def snapshot_stats(self) -> dict:
+        with self._lock:
+            return {
+                "ready": {q: len(h) for q, h in self._ready.items()},
+                "unacked": len(self._unack),
+                "blocked": sum(len(h) for h in self._blocked.values()),
+                "waiting": len(self._waiting),
+                "dequeues": self._dequeues,
+                "nacks": self._nacks,
+                "oldest_t0": next(iter(self._ready_since.values()), None),
+                "redelivered": {eid: n
+                                for eid, n in self._deliveries.items()
+                                if n > 1},
+            }
+
+
+class EvalBroker:
+    """Facade over S `_Shard` partitions (see module docstring).  All
+    public methods keep the pre-shard signatures; `dequeue`/
+    `dequeue_batch` additionally accept a `home` shard hint (the
+    worker's index) for locality-first stealing."""
+
+    def __init__(self, nack_delay_s: float = DEFAULT_NACK_DELAY_S,
+                 initial_nack_delay_s: float = DEFAULT_INITIAL_NACK_DELAY_S,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 max_nack_delay_s: float = DEFAULT_MAX_NACK_DELAY_S,
+                 nack_jitter_seed: int = 0xACED,
+                 shards: Optional[int] = None):
+        # shared ready condition: blocked dequeuers wait here; shards
+        # notify through notify_ready().  A generation counter closes
+        # the scan-then-wait race (an enqueue landing between a dry
+        # scan and the wait bumps the gen, so the waiter re-scans
+        # instead of sleeping through the wake-up).
+        self._ready_cv = threading.Condition()
+        self._ready_gen = 0
+        self._enabled = False
+        self.nack_delay_s = nack_delay_s
+        self.initial_nack_delay_s = initial_nack_delay_s
+        self.max_nack_delay_s = max_nack_delay_s
+        self.delivery_limit = delivery_limit
+        n = shards if shards is not None else _default_shards()
+        self.num_shards = max(1, int(n))
+        self._shards = [_Shard(self, i, nack_jitter_seed)
+                        for i in range(self.num_shards)]
+        self._rr = itertools.count()
+        self._delay_thread: Optional[threading.Thread] = None
+        self._stop_delay = threading.Event()
+        # export_metrics rate gate (ISSUE 17 satellite): hot loops pass
+        # min_interval_s >= 1 so queue-shape gauges cost one monotonic
+        # read per call instead of S lock holds
+        self._export_lock = threading.Lock()
+        self._last_export = 0.0
+
+    # ------------------------------------------------------------ lifecycle
+    def set_enabled(self, enabled: bool) -> None:
+        with self._ready_cv:
+            prev = self._enabled
+            self._enabled = enabled
+            if enabled and not prev:
+                self._stop_delay.clear()
+                self._delay_thread = threading.Thread(
+                    target=self._run_delayed_watcher, daemon=True)
+                self._delay_thread.start()
+        if prev and not enabled:
+            self.flush()
+        if not enabled:
+            self._stop_delay.set()
+
+    @property
+    def enabled(self) -> bool:
+        with self._ready_cv:    # guarded by _ready_cv: see set_enabled
+            return self._enabled
+
+    @property
+    def enabled_flag(self) -> bool:
+        """Enabled read for the shards' enqueue path.  Nests the shared
+        condition inside the calling shard's lock — the one sanctioned
+        order (shard lock -> ready condition, same as notify_ready);
+        the condition never wraps a shard lock."""
+        with self._ready_cv:
+            return self._enabled
+
+    def notify_ready(self) -> None:
+        """Wake blocked dequeuers (called by shards after making work
+        ready; the caller holds only its shard lock — the shared
+        condition nests strictly inside shard locks, never around
+        them)."""
+        with self._ready_cv:
+            self._ready_gen += 1
+            self._ready_cv.notify_all()
+
+    def ready_count(self) -> int:
+        """Evals ready for dequeue right now (not delayed/unacked)."""
+        return sum(s.ready_count() for s in self._shards)
+
+    def oldest_ready_age(self) -> float:
+        """Seconds the oldest currently-ready eval has been waiting —
+        the max across shards (each shard's dict insertion order tracks
+        enqueue order, so its first live entry is its oldest)."""
+        t0s = [t0 for t0 in (s.oldest_ready_t0() for s in self._shards)
+               if t0 is not None]
+        if not t0s:
+            return 0.0
+        return _time.monotonic() - min(t0s)
+
+    def export_metrics(self, min_interval_s: float = 0.0) -> None:
+        """Publish queue-shape gauges through the global metrics path
+        (surfaced at /v1/metrics next to the worker.dequeue_eval
+        counters).  `min_interval_s` rate-gates hot callers: a call
+        landing inside the window is a no-op (one monotonic read), so
+        per-dequeue loops can't turn the gauge walk into lock traffic —
+        the leader's 1s export beat passes the default 0 and always
+        publishes."""
+        from ..utils.metrics import global_metrics as _m
+        if min_interval_s > 0.0:
+            now = _time.monotonic()
+            with self._export_lock:
+                if now - self._last_export < min_interval_s:
+                    return
+                self._last_export = now
+        ready: Dict[str, int] = {}
+        unacked = waiting = blocked = 0
+        oldest_t0: Optional[float] = None
+        redelivered: Dict[str, int] = {}
+        for s in self._shards:
+            st = s.snapshot_stats()
+            for q, cnt in st["ready"].items():
+                ready[q] = ready.get(q, 0) + cnt
+            unacked += st["unacked"]
+            waiting += st["waiting"]
+            blocked += st["blocked"]
+            if st["oldest_t0"] is not None and \
+                    (oldest_t0 is None or st["oldest_t0"] < oldest_t0):
+                oldest_t0 = st["oldest_t0"]
+            # per-eval delivery counts: only evals past their first
+            # delivery (the interesting, bounded set — at most
+            # delivery_limit redeliveries each before parking), so
+            # gauge cardinality stays proportional to flapping evals,
+            # not throughput; the registry's namespace cap absorbs
+            # pathological storms as metrics.overflow
+            redelivered.update(st["redelivered"])
+        oldest = (_time.monotonic() - oldest_t0) if oldest_t0 else 0.0
+        _m.set_gauge("broker.ready_count", float(sum(ready.values())))
+        _m.set_gauge("broker.redelivering", float(len(redelivered)))
+        for eid, cnt in redelivered.items():
+            _m.set_gauge(f"broker.deliveries.{eid}", float(cnt))
+        _m.set_gauge("broker.oldest_ready_age_s", oldest)
+        _m.set_gauge("broker.unacked", float(unacked))
+        _m.set_gauge("broker.waiting", float(waiting))
+        _m.set_gauge("broker.job_blocked", float(blocked))
+        _m.set_gauge("broker.shards", float(self.num_shards))
+        for q, cnt in ready.items():
+            _m.set_gauge(f"broker.ready.{q}", float(cnt))
+
+    def flush(self) -> None:
+        for s in self._shards:
+            s.flush()
+        self.notify_ready()
+
+    # -------------------------------------------------------------- routing
+    def shard_of(self, ev: Evaluation) -> _Shard:
+        """A job maps to exactly ONE shard (per-job serialization by
+        construction); job-less evals spread by eval id.  crc32, not
+        hash(): stable across processes and PYTHONHASHSEED, so replay
+        and chaos runs shard identically."""
+        if self.num_shards == 1:
+            return self._shards[0]
+        if ev.job_id:
+            key = f"{ev.namespace}\x00{ev.job_id}"
+        else:
+            key = ev.id
+        idx = (zlib.crc32(key.encode("utf-8", "replace")) & 0xFFFFFFFF) \
+            % self.num_shards
+        return self._shards[idx]
+
+    def _shard_by_token(self, eval_id: str, token: str
+                        ) -> Optional[_Shard]:
+        """The shard that issued `token` (its index is the token's
+        prefix).  Falls back to a scan for foreign token formats."""
+        head, _, rest = token.partition(".")
+        if rest:
+            try:
+                idx = int(head)
+            except ValueError:
+                idx = -1
+            if 0 <= idx < self.num_shards:
+                return self._shards[idx]
+        for s in self._shards:
+            if s.outstanding(eval_id) == token:
+                return s
+        return None
+
+    # ------------------------------------------------------------- enqueue
+    def enqueue(self, ev: Evaluation) -> None:
+        self.shard_of(ev).enqueue(ev)
+
+    def enqueue_all(self, evals: List[Tuple[Evaluation, str]]) -> None:
+        """Enqueue (eval, token) pairs; a matching token for an unacked
+        eval defers the re-enqueue until that eval is acked.  Routing
+        is deterministic by eval content, so the token's unack entry —
+        if any — lives in the same shard the eval routes to."""
+        by_shard: Dict[int, List[Tuple[Evaluation, str]]] = {}
+        for ev, token in evals:
+            sh = self.shard_of(ev)
+            by_shard.setdefault(sh.index, []).append((ev, token))
+        for idx, group in by_shard.items():
+            self._shards[idx].enqueue_all(group)
+
+    # ------------------------------------------------------------- dequeue
+    def dequeue(self, sched_types: Sequence[str], timeout: float = 0.0,
+                home: Optional[int] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue: home shard first, then steal round-robin
+        across the rest.  `home` defaults to a rotating pick so
+        anonymous callers spread load."""
+        deadline = _time.monotonic() + timeout
+        start = (home if home is not None else next(self._rr)) \
+            % self.num_shards
+        while True:
+            with self._ready_cv:
+                gen = self._ready_gen
+                enabled = self._enabled
+            for k in range(self.num_shards):
+                ev, token = self._shards[(start + k) % self.num_shards] \
+                    .try_dequeue(sched_types)
+                if ev is not None:
+                    return ev, token
+            remain = deadline - _time.monotonic()
+            if remain <= 0 or not enabled:
+                return None, ""
+            with self._ready_cv:
+                if self._ready_gen == gen:
+                    self._ready_cv.wait(remain)
+
+    def dequeue_batch(self, sched_types: Sequence[str], max_batch: int,
+                      timeout: float = 0.0, home: Optional[int] = None
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Drain up to max_batch ready evals (the TPU coalescing point).
+        Blocks for the first eval only; the rest are taken
+        opportunistically — home shard first, stealing across the other
+        shards when it runs dry so no shard strands work."""
+        first, token = self.dequeue(sched_types, timeout, home=home)
+        if first is None:
+            return []
+        out = [(first, token)]
+        start = (home if home is not None else 0) % self.num_shards
+        for k in range(self.num_shards):
+            if len(out) >= max_batch:
+                break
+            shard = self._shards[(start + k) % self.num_shards]
+            while len(out) < max_batch:
+                ev, tok = shard.try_dequeue(sched_types)
+                if ev is None:
+                    break
+                out.append((ev, tok))
+        # dequeue-batch size histogram (p50/p99 via the metrics
+        # reservoir) — the observability face of the BatchController
+        from ..utils.metrics import global_metrics as _m
+        _m.add_sample("broker.dequeue_batch_size", float(len(out)))
+        return out
+
+    # --------------------------------------------------------- nack timers
+    def pause_nack_timeout(self, eval_id: str, token: str) -> Optional[str]:
+        """Stop the redelivery timer while the holder does long work
+        (reference: eval_broker PauseNackTimeout, used while waiting on
+        raft / the fused solve). The holder must still ack or nack."""
+        sh = self._shard_by_token(eval_id, token)
+        if sh is None:
+            return "token mismatch"
+        return sh.pause_nack_timeout(eval_id, token)
+
+    def resume_nack_timeout(self, eval_id: str,
+                            token: str) -> Optional[str]:
+        sh = self._shard_by_token(eval_id, token)
+        if sh is None:
+            return "token mismatch"
+        return sh.resume_nack_timeout(eval_id, token)
+
+    # ------------------------------------------------------------ ack/nack
+    def ack(self, eval_id: str, token: str) -> Optional[str]:
+        sh = self._shard_by_token(eval_id, token)
+        if sh is None:
+            return "token mismatch"
+        return sh.ack(eval_id, token)
+
+    def nack(self, eval_id: str, token: str) -> Optional[str]:
+        sh = self._shard_by_token(eval_id, token)
+        if sh is None:
+            return "token mismatch"
+        return sh.nack(eval_id, token)
+
+    # ------------------------------------------------------ delayed watcher
+    def _run_delayed_watcher(self) -> None:
+        while not self._stop_delay.is_set():
+            wait = 0.1
+            for s in self._shards:
+                wait = min(wait, s.pop_due_delayed())
+            self._stop_delay.wait(max(wait, 0.01))
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        shard_stats = [s.snapshot_stats() for s in self._shards]
+        by_sched: Dict[str, int] = {}
+        for st in shard_stats:
+            for q, cnt in st["ready"].items():
+                by_sched[q] = by_sched.get(q, 0) + cnt
+        t0s = [st["oldest_t0"] for st in shard_stats
+               if st["oldest_t0"] is not None]
+        oldest = (_time.monotonic() - min(t0s)) if t0s else 0.0
+        return {
+            "total_ready": sum(by_sched.values()),
+            "total_unacked": sum(st["unacked"] for st in shard_stats),
+            "total_blocked": sum(st["blocked"] for st in shard_stats),
+            "total_waiting": sum(st["waiting"] for st in shard_stats),
+            "by_scheduler": by_sched,
+            "dequeues": sum(st["dequeues"] for st in shard_stats),
+            "nacks": sum(st["nacks"] for st in shard_stats),
+            "oldest_ready_age_s": round(oldest, 6),
+            "shards": self.num_shards,
+            "ready_by_shard": [sum(st["ready"].values())
+                               for st in shard_stats],
+        }
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        for s in self._shards:
+            token = s.outstanding(eval_id)
+            if token is not None:
+                return token
+        return None

@@ -175,13 +175,13 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
 
 
 class _Outstanding:
-    """A dispatched-but-unacknowledged plan apply."""
-    __slots__ = ("pending", "plan", "result", "finish")
+    """A dispatched-but-unacknowledged apply: one plan, or a
+    group-commit batch of K plans riding a single raft entry (one
+    fsync); each member keeps its own future + result."""
+    __slots__ = ("items", "finish")
 
-    def __init__(self, pending, plan, result, finish):
-        self.pending = pending
-        self.plan = plan
-        self.result = result
+    def __init__(self, items, finish):
+        self.items = items            # [(pending, plan, result), ...]
         self.finish = finish          # blocks until raft-applied
 
 
@@ -192,11 +192,18 @@ class PlanApplier:
 
     def __init__(self, queue: PlanQueue, store, apply_fn: ApplyFn,
                  create_evals: Optional[Callable[[List[Evaluation]], None]]
-                 = None, apply_async_fn=None):
+                 = None, apply_async_fn=None, apply_batch_async_fn=None,
+                 group_commit: int = 1):
         self.queue = queue
         self.store = store
         self.apply_fn = apply_fn
         self.apply_async_fn = apply_async_fn
+        #: group commit (ISSUE 17): batch fn takes [(plan, result)] and
+        #: dispatches ONE raft entry carrying all K results; group_commit
+        #: caps K.  Plans are only grouped when already queued back to
+        #: back, so a singleton keeps the unbatched latency.
+        self.apply_batch_async_fn = apply_batch_async_fn
+        self.group_commit = max(1, int(group_commit))
         self.create_evals = create_evals
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -253,22 +260,65 @@ class PlanApplier:
                    out: Optional[_Outstanding]
                    ) -> Optional[_Outstanding]:
         from ..utils.metrics import global_metrics as _m
-        plan = pending.plan
         _m.set_gauge("plan.queue_depth", self.queue.depth()
                      if hasattr(self.queue, "depth") else 0)
+        # group commit: opportunistically drain up to K-1 more queued
+        # plans into this round — never waits, so an idle queue keeps
+        # the per-plan latency and a saturated one amortizes the fsync
+        group = [pending]
+        if self.apply_batch_async_fn is not None and self.group_commit > 1:
+            while len(group) < self.group_commit:
+                extra = self.queue.dequeue(0.0)
+                if extra is None:
+                    break
+                group.append(extra)
         snapshot = self.store.snapshot()
         if out is not None:
-            # evaluate against base + the in-flight plan's known result
+            # evaluate against base + the in-flight plans' known results
             # (the overlay is idempotent if the apply already landed)
-            snapshot = _OverlaySnapshot(snapshot, out.result)
-        with _m.timed("plan.evaluate"):
-            result = evaluate_plan(snapshot, plan)
-        if result.is_no_op() and not result.refresh_index:
-            pending.future.respond(result, None)
+            for _p, _pl, res in out.items:
+                snapshot = _OverlaySnapshot(snapshot, res)
+        items = []
+        for p in group:
+            try:
+                with _m.timed("plan.evaluate"):
+                    result = evaluate_plan(snapshot, p.plan)
+            except Exception as e:
+                # a poisoned group member must not strand the others
+                p.future.respond(None, f"plan apply error: {e}")
+                continue
+            if result.is_no_op() and not result.refresh_index:
+                p.future.respond(result, None)
+                continue
+            items.append((p, p.plan, result))
+            # later members validate against earlier members' results:
+            # intra-batch conflicts surface as partial commits exactly
+            # as they would pipelined one by one
+            snapshot = _OverlaySnapshot(snapshot, result)
+        if not items:
             return out
-        if self.apply_async_fn is not None:
+        if len(items) > 1 and self.apply_batch_async_fn is not None:
+            try:
+                index, finish = self.apply_batch_async_fn(
+                    [(pl, res) for _p, pl, res in items])
+            except Exception as e:
+                for p, _pl, _res in items:
+                    p.future.respond(None, f"plan apply error: {e}")
+                return out
+            _m.incr_counter("plan.group_commits")
+            _m.incr_counter("plan.raft_applies")
+            _m.add_sample("plan.group_commit_size", float(len(items)))
+            new_out = _Outstanding(items, finish)
+            if out is not None:
+                # the batch's consensus is in flight: the previous
+                # round's wait+respond rides under it
+                self._finalize(out)
+            return new_out
+        if self.apply_async_fn is not None and len(items) == 1:
+            p, plan, result = items[0]
             index, finish = self.apply_async_fn(plan, result)
-            new_out = _Outstanding(pending, plan, result, finish)
+            _m.incr_counter("plan.raft_applies")
+            new_out = _Outstanding(items, finish)
             if out is not None:
                 # plan N+1's consensus is in flight: N's wait+respond
                 # rides under it
@@ -277,16 +327,17 @@ class PlanApplier:
         # legacy synchronous path (no async apply wired)
         if out is not None:
             self._finalize(out)
-        with _m.timed("plan.apply"):
-            index = self.apply_fn(plan, result)
-        result.alloc_index = index
-        self._account_and_respond(pending, plan, result)
+        for p, plan, result in items:
+            with _m.timed("plan.apply"):
+                index = self.apply_fn(plan, result)
+            result.alloc_index = index
+            self._account_and_respond(p, plan, result)
         return None
 
-    def _finalize(self, out: _Outstanding) -> None:
-        """Wait out a dispatched apply and respond its future — exactly
-        once, never raising: every failure path error-responds instead
-        (PlanFuture.respond is first-wins, so a partial
+    def _finalize(self, out: _Outstanding):
+        """Wait out a dispatched apply and respond every member future —
+        exactly once, never raising: every failure path error-responds
+        instead (PlanFuture.respond is first-wins, so a partial
         _account_and_respond that already delivered the result cannot
         be overwritten by the trailing error)."""
         from ..utils.metrics import global_metrics as _m
@@ -294,13 +345,15 @@ class PlanApplier:
             with _m.timed("plan.apply"):
                 index = out.finish(10.0)
         except Exception as e:
-            out.pending.future.respond(None, f"plan apply error: {e}")
+            for pending, _plan, _result in out.items:
+                pending.future.respond(None, f"plan apply error: {e}")
             return None
-        out.result.alloc_index = index
-        try:
-            self._account_and_respond(out.pending, out.plan, out.result)
-        except Exception as e:
-            out.pending.future.respond(None, f"plan apply error: {e}")
+        for pending, plan, result in out.items:
+            result.alloc_index = index
+            try:
+                self._account_and_respond(pending, plan, result)
+            except Exception as e:
+                pending.future.respond(None, f"plan apply error: {e}")
         return None
 
     def _account_and_respond(self, pending, plan: Plan,

@@ -48,7 +48,7 @@ class JobValidationError(ValueError):
 
 
 class Server:
-    def __init__(self, num_workers: int = 2,
+    def __init__(self, num_workers: Optional[int] = None,
                  enabled_schedulers: Optional[List[str]] = None,
                  batch_size: int = 8,
                  min_heartbeat_ttl_s: float = 10.0,
@@ -73,18 +73,19 @@ class Server:
                              on_leader=self._establish_leadership,
                              on_follower=self._revoke_leadership)
         self._multi = len(raft_config.peers) > 1
-        self.broker = EvalBroker()
-        self.blocked_evals = BlockedEvals(self.broker)
-        self.plan_queue = PlanQueue()
-        self.batch_size = batch_size
         # serving tier (ISSUE 6): adaptive micro-batching + admission
         # control shared by every worker and the eval-ingress path;
         # `serving_config` (agent `server { serving { ... } }` stanza)
         # overrides env overrides defaults.  {"adaptive": False} pins
         # the fixed batch_size dequeue (the pre-serving behavior) while
-        # keeping admission bounded.
+        # keeping admission bounded.  Built before the broker: the tier
+        # owns the scale-out knobs (shards/workers/group commit).
         from .serving import ServingTier
         self.serving = ServingTier(overrides=serving_config)
+        self.broker = EvalBroker(shards=self.serving.broker_shards)
+        self.blocked_evals = BlockedEvals(self.broker)
+        self.plan_queue = PlanQueue()
+        self.batch_size = batch_size
         # telemetry tick state (ISSUE 15): last counter snapshots for
         # per-beat rate series + the most recent fleet health report
         # served at /v1/telemetry/health (assigned whole — readers on
@@ -94,7 +95,10 @@ class Server:
         self._last_health: Optional[dict] = None
         self.planner = PlanApplier(self.plan_queue, self.store,
                                    self._apply_plan, self._create_evals,
-                                   apply_async_fn=self._apply_plan_async)
+                                   apply_async_fn=self._apply_plan_async,
+                                   apply_batch_async_fn=(
+                                       self._apply_plan_batch_async),
+                                   group_commit=self.serving.group_commit)
         self.enabled_schedulers = enabled_schedulers or [
             s for s in SCHEDULERS if s != JOB_TYPE_CORE]
         # every worker must also drain the core queue or GC evals pile up
@@ -103,8 +107,17 @@ class Server:
         worker_types = list(self.enabled_schedulers)
         if JOB_TYPE_CORE not in worker_types:
             worker_types.append(JOB_TYPE_CORE)
-        self.workers = [Worker(self, worker_types)
-                        for _ in range(num_workers)]
+        if num_workers is None:
+            num_workers = self.serving.num_workers
+        self.workers = [Worker(self, worker_types, index=i)
+                        for i in range(num_workers)]
+        # cross-worker fused solves (ISSUE 17): bulk batches from every
+        # worker coalesce into one device wave; express lane stays
+        # single-solve inside the worker
+        self.solve_coordinator = None
+        if self.serving.coordinator and num_workers > 1:
+            from ..scheduler.fleet import SolveCoordinator
+            self.solve_coordinator = SolveCoordinator(self)
         self.heartbeater = NodeHeartbeater(
             self._on_heartbeat_expired,
             min_heartbeat_ttl_s=min_heartbeat_ttl_s,
@@ -156,11 +169,20 @@ class Server:
         self.planner.start()
         for w in self.workers:
             w.start()
-        # Reserve leader CPU for raft + plan application by pausing 3/4
-        # of the scheduling workers (reference: leader.go:206-212 —
-        # len(s.workers)/4*3 of them are paused while leader); at least
-        # one worker always runs so scheduling can't stall
-        n_pause = len(self.workers) // 4 * 3
+        # Reserve leader CPU for raft + plan application by pausing a
+        # fraction of the scheduling workers (reference: leader.go:206-212
+        # pauses len(s.workers)/4*3 while leader).  Pausing directly caps
+        # dequeue parallelism, which defeats the sharded broker — so the
+        # fraction is a serving knob: -1 (auto) pauses none once the
+        # broker is sharded (shard homes need their workers) and keeps
+        # the reference 3/4 otherwise; at least one worker always runs
+        # so scheduling can't stall.
+        frac = self.serving.worker_pause_fraction
+        if frac < 0.0:
+            n_pause = 0 if self.serving.broker_shards > 1 \
+                else len(self.workers) // 4 * 3
+        else:
+            n_pause = int(len(self.workers) * min(frac, 1.0))
         if n_pause >= len(self.workers):
             n_pause = len(self.workers) - 1
         for w in self.workers[:max(0, n_pause)]:
@@ -1126,6 +1148,26 @@ class Server:
         def finish(timeout: float = 10.0) -> int:
             ix = wait(timeout)
             self._claim_csi_for_placements(plan, result)
+            return ix
+        return index, finish
+
+    def _apply_plan_batch_async(self, items):
+        """Group commit (ISSUE 17): K plan results ride ONE raft entry —
+        one log append, one fsync — instead of K.  `items` is
+        [(plan, result)]; returns (index, finish_fn) like the single
+        path.  The FSM applies the K results in submission order under
+        the shared commit index, which is the same store state K chained
+        single applies would produce."""
+        index, wait = self.raft.propose_async("plan_results_batch", {
+            "items": [{
+                "result": to_wire(result),
+                "job": to_wire(plan.job) if plan.job is not None else None,
+            } for plan, result in items]})
+
+        def finish(timeout: float = 10.0) -> int:
+            ix = wait(timeout)
+            for plan, result in items:
+                self._claim_csi_for_placements(plan, result)
             return ix
         return index, finish
 

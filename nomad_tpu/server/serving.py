@@ -370,6 +370,17 @@ class ServingTier:
         "slo_slow_window_s": ("NOMAD_TPU_SLO_SLOW_WINDOW_S", float,
                               600.0),
         "slo_slow_burn": ("NOMAD_TPU_SLO_SLOW_BURN", float, 2.0),
+        # scale-out plane (ISSUE 17): broker sharding, dequeue worker
+        # count, raft group-commit width, cross-worker solve fusion
+        "broker_shards": ("NOMAD_TPU_BROKER_SHARDS", int, 1),
+        "num_workers": ("NOMAD_TPU_NUM_WORKERS", int, 2),
+        "group_commit": ("NOMAD_TPU_GROUP_COMMIT", int, 8),
+        "coordinator": ("NOMAD_TPU_COORDINATOR", int, 1),
+        # leader soft-pause fraction of workers; -1 = auto (0 once the
+        # broker is sharded — pausing dequeue parallelism defeats shard
+        # homing — else the reference's 3/4)
+        "worker_pause_fraction": ("NOMAD_TPU_WORKER_PAUSE_FRACTION",
+                                  float, -1.0),
     }
 
     def __init__(self, adaptive: bool = True,
@@ -388,6 +399,11 @@ class ServingTier:
         self.bypass_priority = k["bypass_priority"]
         self.slo_budget_s = k["slo_budget_s"]
         self.max_batch = k["max_batch"]
+        self.broker_shards = max(1, k["broker_shards"])
+        self.num_workers = max(1, k["num_workers"])
+        self.group_commit = max(1, k["group_commit"])
+        self.coordinator = bool(k["coordinator"])
+        self.worker_pause_fraction = k["worker_pause_fraction"]
         self.solve_model = EwmaSolveModel()
         self.batch_controller = BatchController(
             self.solve_model, slo_budget_s=k["slo_budget_s"],
@@ -426,6 +442,10 @@ class ServingTier:
             "adaptive": self.adaptive,
             "slo_budget_s": self.slo_budget_s,
             "max_batch": self.max_batch,
+            "broker_shards": self.broker_shards,
+            "num_workers": self.num_workers,
+            "group_commit": self.group_commit,
+            "coordinator": self.coordinator,
             "last_target_batch": self.batch_controller.last_target(),
             "model_observations": self.solve_model.observations(),
             "admission": self.admission.stats(),

@@ -20,10 +20,14 @@ _log = logging.getLogger(__name__)
 
 
 class Worker(threading.Thread):
-    def __init__(self, server, sched_types: List[str]):
+    def __init__(self, server, sched_types: List[str], index: int = 0):
         super().__init__(daemon=True)
         self.server = server
         self.sched_types = list(sched_types)
+        #: worker index doubles as the broker home shard: worker i
+        #: drains shard i % S first, so at N == S workers each shard has
+        #: a dedicated drainer and dequeues never contend on one lock
+        self.index = index
         self._shutdown = threading.Event()
         self.paused = threading.Event()
         self._solver = None
@@ -68,8 +72,8 @@ class Worker(threading.Thread):
                 continue
             target = self._target_batch(serving, broker)
             batch = broker.dequeue_batch(
-                self.sched_types, target, DEQUEUE_TIMEOUT_S)
-            broker.export_metrics()
+                self.sched_types, target, DEQUEUE_TIMEOUT_S,
+                home=self.index)
             if not batch:
                 # idle tick: readmit shed work once the queue drains
                 self._readmit_tick(serving)
@@ -163,8 +167,16 @@ class Worker(threading.Thread):
         if len(bulk) == 1:
             self._process(*bulk[0])
         elif bulk:
-            from ..scheduler.fleet import process_fleet
-            process_fleet(self.server, self, bulk)
+            coordinator = getattr(self.server, "solve_coordinator", None)
+            if coordinator is not None:
+                # cross-worker fusion: park on the coordinator so this
+                # batch rides one combined device wave with whatever the
+                # other workers dequeued (errors re-raise here and the
+                # run-loop nack path owns our evals)
+                coordinator.submit(self, bulk)
+            else:
+                from ..scheduler.fleet import process_fleet
+                process_fleet(self.server, self, bulk)
 
     def _readmit_tick(self, serving) -> None:
         """Pop admission-shed evals back into the broker once the queue

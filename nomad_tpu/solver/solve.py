@@ -371,11 +371,13 @@ class Solver:
         """Serving-tier brownout: solve with the reduced
         BROWNOUT_MAX_WAVES budget while set (leftovers stay
         retryable)."""
-        self._degraded = bool(degraded)
+        with self._world_lock:
+            self._degraded = bool(degraded)
 
     @property
     def degraded(self) -> bool:
-        return self._degraded
+        with self._world_lock:
+            return self._degraded
 
     # ------------------------------------------------- resident world
     def resident_active(self, snapshot=None) -> bool:
@@ -395,32 +397,35 @@ class Solver:
         worker calls this right after submit_plan so the next eval's
         solve starts from already-advanced tensors and the change-log
         sync degenerates to a no-op dedup."""
-        world = self._world
-        if world is None or result is None:
-            return
-        delta = ClusterDelta()
-        for nid, allocs in (result.node_update or {}).items():
-            for a in allocs:
-                tracked = world.live.pop(a.id, None)
-                if tracked is not None:
-                    delta.stop.append(tracked)
-        for allocs in (result.node_preemptions or {}).values():
-            for a in allocs:
-                tracked = world.live.pop(a.id, None)
-                if tracked is not None:
-                    delta.stop.append(tracked)
-        for nid, allocs in (result.node_allocation or {}).items():
-            for a in allocs:
-                if a.id not in world.live and not a.terminal_status():
-                    delta.place.append((nid, a))
-                    world.live[a.id] = (nid, a)
-        if delta.empty():
-            return
-        world.counters["plan_feeds"] += 1
-        if not world.feed(delta):
-            # inexpressible eagerly (e.g. alloc on an unknown node):
-            # drop the world; the next solve rebuilds from its snapshot
-            self._world = None
+        with self._world_lock:
+            world = self._world
+            if world is None or result is None:
+                return
+            delta = ClusterDelta()
+            for nid, allocs in (result.node_update or {}).items():
+                for a in allocs:
+                    tracked = world.live.pop(a.id, None)
+                    if tracked is not None:
+                        delta.stop.append(tracked)
+            for allocs in (result.node_preemptions or {}).values():
+                for a in allocs:
+                    tracked = world.live.pop(a.id, None)
+                    if tracked is not None:
+                        delta.stop.append(tracked)
+            for nid, allocs in (result.node_allocation or {}).items():
+                for a in allocs:
+                    if a.id not in world.live \
+                            and not a.terminal_status():
+                        delta.place.append((nid, a))
+                        world.live[a.id] = (nid, a)
+            if delta.empty():
+                return
+            world.counters["plan_feeds"] += 1
+            if not world.feed(delta):
+                # inexpressible eagerly (e.g. alloc on an unknown
+                # node): drop the world; the next solve rebuilds from
+                # its snapshot
+                self._world = None
 
     def resident_counters(self) -> Optional[Dict]:
         with self._world_lock:

@@ -52,6 +52,10 @@ from .ids import generate_uuid
 
 DEFAULT_TRACE_DEPTH = 512
 DEFAULT_MESH_EVENTS = 4096
+#: bounded record spill (ISSUE 17): completed spans park here and the
+#: drainer thread does the ring insert + JSONL sink write, so the solve
+#: hot path never takes the recorder's main lock
+DEFAULT_TRACE_SPILL = 8192
 
 
 def _env_on(name: str, default: bool = True) -> bool:
@@ -172,6 +176,23 @@ class FlightRecorder:
         # offset, so cross-process consumers can line traces up
         self._anchor_mono = _time.monotonic()
         self._anchor_wall = _time.time()
+        # off-hot-path record spill (ISSUE 17): `end()` builds the row,
+        # updates the tail pointer under the LEAF `_tail_lock` and parks
+        # the row here; the lazily-started drainer thread (or the next
+        # query, whichever comes first) moves it into the ring + sink
+        # under `self._lock`.  Lock order is `_lock` outer, `_tail_lock`
+        # inner, and the record path takes only the leaf.
+        try:
+            spill = int(os.environ.get("NOMAD_TPU_TRACE_SPILL",
+                                       str(DEFAULT_TRACE_SPILL)))
+        except ValueError:
+            spill = DEFAULT_TRACE_SPILL
+        self.spill_limit = max(int(spill), 1)
+        self._spill: deque = deque()
+        self._spill_dropped = 0
+        self._tail_lock = threading.Lock()
+        self._spill_event = threading.Event()
+        self._drainer: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- record
     def sampled(self, trace_id: str) -> bool:
@@ -200,7 +221,7 @@ class FlightRecorder:
         if not self.enabled or not trace_id \
                 or not self.sampled(trace_id):
             return NULL_SPAN
-        with self._lock:
+        with self._tail_lock:
             parent = self._tail.get(trace_id, "")
         return Span(self, trace_id, name, parent, attrs)
 
@@ -226,29 +247,68 @@ class FlightRecorder:
                             + (sp.t_start - self._anchor_mono), 6),
             "attrs": sp.attrs,
         }
-        with self._lock:
-            spans = self._traces.get(sp.trace_id)
-            if spans is None:
-                while len(self._traces) >= self.depth_limit:
-                    self._traces.popitem(last=False)
-                    self._dropped += 1
-                spans = self._traces[sp.trace_id] = []
-            spans.append(row)
+        with self._tail_lock:
+            # eager tail update: stage() parent chaining stays exact
+            # even while the row itself waits in the spill queue
             self._tail[sp.trace_id] = sp.span_id
-            if len(self._tail) > 4 * self.depth_limit:
-                # the tail map tracks evicted traces too until trimmed
-                live = set(self._traces)
-                for tid in [t for t in self._tail if t not in live]:
-                    del self._tail[tid]
-            sink = self._sink_file_locked()
-            if sink is not None:
-                # written under the lock: concurrent stages must not
-                # interleave bytes mid-line in the sink
-                try:
-                    sink.write(json.dumps(row, sort_keys=True) + "\n")
-                    sink.flush()
-                except OSError:
-                    pass
+            if len(self._spill) >= self.spill_limit:
+                # bounded: a storm sheds rows, never blocks the solver
+                self._spill_dropped += 1
+                return
+            self._spill.append(row)
+            if self._drainer is None:
+                self._drainer = threading.Thread(
+                    target=self._drain_loop, daemon=True,
+                    name="trace-drain")
+                self._drainer.start()
+        self._spill_event.set()
+
+    def flush(self) -> None:
+        """Synchronously drain the spill queue into the ring + sink —
+        after this, everything recorded-before-call is durably sunk."""
+        self._drain_pending()
+
+    def _drain_loop(self) -> None:
+        while True:
+            self._spill_event.wait(0.5)
+            self._spill_event.clear()
+            self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        """Move spilled rows into the ring + sink.  Runs on the drainer
+        thread AND at the top of every query path (so a reader always
+        sees everything recorded before its call)."""
+        with self._lock:
+            while True:
+                with self._tail_lock:
+                    if not self._spill:
+                        break
+                    row = self._spill.popleft()
+                self._apply_row_locked(row)
+            with self._tail_lock:
+                if len(self._tail) > 4 * self.depth_limit:
+                    # the tail map tracks evicted traces too until trimmed
+                    live = set(self._traces)
+                    for tid in [t for t in self._tail if t not in live]:
+                        del self._tail[tid]
+
+    def _apply_row_locked(self, row: dict) -> None:
+        spans = self._traces.get(row["trace_id"])
+        if spans is None:
+            while len(self._traces) >= self.depth_limit:
+                self._traces.popitem(last=False)
+                self._dropped += 1
+            spans = self._traces[row["trace_id"]] = []
+        spans.append(row)
+        sink = self._sink_file_locked()
+        if sink is not None:
+            # single writer (the drain holds the main lock): concurrent
+            # stages can't interleave bytes mid-line in the sink
+            try:
+                sink.write(json.dumps(row, sort_keys=True) + "\n")
+                sink.flush()
+            except OSError:
+                pass
 
     def _sink_file_locked(self):
         if not self._sink_path:
@@ -266,6 +326,7 @@ class FlightRecorder:
         """The trace's completed spans, ordered by start time (records
         land in completion order; concurrent stages can end out of
         start order)."""
+        self._drain_pending()
         with self._lock:
             spans = self._traces.get(trace_id)
             if spans is None:
@@ -275,6 +336,7 @@ class FlightRecorder:
 
     def traces(self, limit: int = 50) -> List[dict]:
         """Newest-first trace summaries."""
+        self._drain_pending()
         with self._lock:
             items = list(self._traces.items())[-max(int(limit), 1):]
         out = []
@@ -287,18 +349,25 @@ class FlightRecorder:
         return out
 
     def stats(self) -> dict:
+        self._drain_pending()
         with self._lock:
+            with self._tail_lock:
+                spill_dropped = self._spill_dropped
             return {"enabled": self.enabled,
                     "sample": self.sample,
                     "traces": len(self._traces),
                     "spans": sum(len(v) for v in self._traces.values()),
                     "depth_limit": self.depth_limit,
-                    "dropped_traces": self._dropped}
+                    "dropped_traces": self._dropped,
+                    "spill_dropped": spill_dropped}
 
     def reset(self) -> None:
         with self._lock:
+            with self._tail_lock:
+                self._spill.clear()
+                self._tail.clear()
+                self._spill_dropped = 0
             self._traces.clear()
-            self._tail.clear()
             self._dropped = 0
 
     # ------------------------------------------------------------- corpus
@@ -308,6 +377,7 @@ class FlightRecorder:
         spans — per-eval features, the candidate (group, node) score
         window, the chosen placement.  Failed placements ride along
         with node_id "" (negative examples are training signal too)."""
+        self._drain_pending()
         with self._lock:
             traces = [(tid, list(spans))
                       for tid, spans in self._traces.items()]

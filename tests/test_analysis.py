@@ -404,6 +404,79 @@ FIX_LOCKS = """
         def tick(self):
             self.model.observe("a", 1)
             self.clean.observe("a", 1)
+
+
+    class Shard:
+        # per-shard lock owner held in a container (ISSUE 17)
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.depth = 0
+            self._timer = None
+
+        def start(self):
+            with self._lock:
+                self._timer = threading.Timer(1.0, self.tick)
+                self._timer.start()
+
+        def tick(self):
+            with self._lock:
+                self.depth += 1
+
+
+    class ShardedOwner:
+        # writes reaching a shard through the container index must hold
+        # the ELEMENT's lock, not (only) any owner-level lock
+        def __init__(self):
+            self._shards = [Shard() for _ in range(4)]
+            self._t = threading.Thread(target=self.poke)
+
+        def poke(self):
+            self._shards[0].depth = 9          # LOCK301 (sharded)
+
+        def poke_safe(self, i):
+            with self._shards[i]._lock:
+                self._shards[i].depth = 9
+
+
+    class Coordinator:
+        # drain leader must not nest the queue lock inside the drain
+        # lock while submit nests them the other way round — the
+        # coordinator deadlock shape (ISSUE 17)
+        def __init__(self):
+            self._qlock = threading.Lock()
+            self._drain_lock = threading.Lock()
+            self._t = threading.Thread(target=self.submit)
+
+        def submit(self):
+            with self._qlock:
+                with self._drain_lock:
+                    pass
+
+        def drain(self):
+            with self._drain_lock:
+                with self._qlock:                  # LOCK304
+                    pass
+
+
+    class CoordinatorClean:
+        # clean twin: releases each lock before taking the other (the
+        # submit path never waits while holding the queue lock)
+        def __init__(self):
+            self._qlock = threading.Lock()
+            self._drain_lock = threading.Lock()
+            self._t = threading.Thread(target=self.submit)
+
+        def submit(self):
+            with self._qlock:
+                pass
+            with self._drain_lock:
+                pass
+
+        def drain(self):
+            with self._drain_lock:
+                pass
+            with self._qlock:
+                pass
 """
 
 
@@ -949,7 +1022,21 @@ def test_lock_unguarded_write_detected_clean_twin_quiet(fixture_report):
     assert keys == {
         "LOCK301:fixpkg.locks:Chatty.start:_worker",
         "LOCK301:fixpkg.locks:SharedModel.observe:_ewma",
+        "LOCK301:fixpkg.locks:ShardedOwner.poke:_shards[].depth",
     }
+
+
+def test_lock_sharded_container_write_detected_locked_twin_quiet(
+        fixture_report):
+    """ISSUE 17: `self._shards[i].attr = v` in a thread-shared owner
+    must hold the element Shard's own lock; the subscripted
+    `with self._shards[i]._lock:` twin is quiet, and the shard's own
+    locked methods stay quiet."""
+    keys = _keys(fixture_report, "LOCK301")
+    assert "LOCK301:fixpkg.locks:ShardedOwner.poke:_shards[].depth" \
+        in keys
+    assert not any(":ShardedOwner.poke_safe:" in k for k in keys)
+    assert not any(":Shard." in k for k in keys)
 
 
 def test_lock_composition_reaches_controller_state(fixture_report):
@@ -981,8 +1068,20 @@ def test_lock_global_mutation_detected_guarded_twin_quiet(
 
 def test_lock_ordering_cycle_detected(fixture_report):
     keys = _keys(fixture_report, "LOCK304")
-    assert len(keys) == 1
-    assert "TwoLocks._a" in next(iter(keys))
+    assert any("TwoLocks._a" in k for k in keys)
+
+
+def test_lock_coordinator_order_cycle_detected_clean_twin_quiet(
+        fixture_report):
+    """ISSUE 17 coordinator shape: submit nests queue->drain while
+    drain nests drain->queue — a deadlock the moment a drain leader
+    waits while a submitter holds the queue lock.  The clean twin
+    releases each lock before taking the other and stays quiet."""
+    keys = _keys(fixture_report, "LOCK304")
+    assert any("Coordinator._drain_lock" in k or
+               "Coordinator._qlock" in k for k in keys)
+    assert not any("CoordinatorClean." in k for k in keys)
+    assert len(keys) == 2
 
 
 # -------------------------------------------------------- shard pass

@@ -488,8 +488,9 @@ def test_broker_nack_delay_exponential_capped_jittered():
         got, tok = b.dequeue(["service"], 2.0)
         assert got is not None and got.id == ev.id
         b.nack(ev.id, tok)
-        with b._lock:
-            deadline, eid = b._delay_heap[0]
+        shard = b.shard_of(ev)
+        with shard._lock:
+            deadline, eid = shard._delay_heap[0]
         assert eid == ev.id
         delay = deadline - time.time()
         base = min(0.5, 0.2 * 2 ** (n - 1))

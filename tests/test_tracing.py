@@ -171,6 +171,8 @@ def test_jsonl_sink(tmp_path):
     rec.event("t1", "create", job_id="j1")
     with rec.span("t1", "solve", waves=3):
         pass
+    # sink writes ride the spill drainer (ISSUE 17) — flush for the read
+    rec.flush()
     rows = [json.loads(ln) for ln in sink.read_text().splitlines()]
     assert [r["name"] for r in rows] == ["create", "solve"]
     assert rows[1]["attrs"]["waves"] == 3
