@@ -3198,12 +3198,15 @@ def lint_summary():
     try:
         from nomad_tpu.analysis import ANALYZER_VERSION, analyze, \
             pass_of
+        t0 = time.perf_counter()
         rep = analyze()
+        wall_s = round(time.perf_counter() - t0, 2)
         baselined_by_pass = {}
         for f in rep.suppressed:
             p = pass_of(f.rule)
             baselined_by_pass[p] = baselined_by_pass.get(p, 0) + 1
         out = {"version": ANALYZER_VERSION,
+               "wall_s": wall_s,
                "unsuppressed": len(rep.findings),
                "errors": len(rep.errors),
                "warnings": len(rep.warnings),

@@ -1,6 +1,6 @@
 """nomadlint: static invariant analyzer for the nomad_tpu package.
 
-Eight passes over a module-level call graph plus a dataflow layer
+Nine passes over a module-level call graph plus a dataflow layer
 (def-use chains, buffer-identity provenance, interprocedural
 summaries — see dataflow.py). No analyzed module is ever imported:
 everything is `ast` on source text, so the analyzer runs without JAX
@@ -42,6 +42,13 @@ or a device.
     lowercase dotted paths under a registered namespace (OBS801);
     names built at runtime are unbounded-cardinality hazards (OBS802,
     warn) that must carry a baseline justification naming the bound.
+  * lockset race detection (race_pass): interprocedural Eraser-style
+    guarded-by inference over the scale-out control plane — shared
+    attributes reachable from ≥2 thread roots must keep a non-empty
+    lock intersection over their writes (RACE901/902), check-then-act
+    windows are flagged (RACE903, warn), and no hot-path lock may be
+    held across a blocking call — device solve, fsync, RPC, waits
+    (LOCK305).
 
 Checked-in suppressions live in baseline.toml next to this file; every
 entry must carry a non-empty justification. Run `python -m
@@ -58,7 +65,7 @@ from .core import (AnalysisConfig, Finding, PackageIndex, Report,
                    pass_of, severity_of)
 from .baseline import Baseline, BaselineError, load_baseline
 
-ANALYZER_VERSION = "3.0"
+ANALYZER_VERSION = "4.0"
 
 # the directory CONTAINING the nomad_tpu package (analysis/ -> pkg -> root)
 _PKG_DIR = os.path.dirname(os.path.dirname(
@@ -75,7 +82,8 @@ def analyze(package_dir: Optional[str] = None,
             baseline: Optional[Baseline] = None,
             use_baseline: bool = True,
             config: Optional[AnalysisConfig] = None,
-            paths: Optional[List[str]] = None) -> Report:
+            paths: Optional[List[str]] = None,
+            cache_dir: Optional[str] = None) -> Report:
     """Run all passes; returns a Report with unsuppressed findings,
     suppressed count and the per-rule tally.
 
@@ -95,6 +103,7 @@ def analyze(package_dir: Optional[str] = None,
     from .score_pass import run_score_pass
     from .robust_pass import run_robust_pass
     from .obs_pass import run_obs_pass
+    from .race_pass import run_race_pass
     from .dataflow import DataflowEngine
 
     package_dir = package_dir or _PKG_DIR
@@ -105,7 +114,8 @@ def analyze(package_dir: Optional[str] = None,
             os.path.normpath(os.path.relpath(os.path.abspath(p),
                                              os.path.abspath(package_dir)))
             for p in paths}
-    index = PackageIndex.build(package_dir, package_name)
+    index = PackageIndex.build(package_dir, package_name,
+                               cache_dir=cache_dir)
     engine = DataflowEngine(index, cfg)
     findings: List[Finding] = []
     findings += run_fsm_pass(index, cfg)
@@ -118,6 +128,9 @@ def analyze(package_dir: Optional[str] = None,
     findings += run_score_pass(index, cfg, package_dir=package_dir)
     findings += run_robust_pass(index, cfg)
     findings += run_obs_pass(index, cfg)
+    # race pass sees prior findings so RACE901 never double-reports a
+    # write LOCK301 already covers syntactically
+    findings += run_race_pass(index, cfg, prior=findings)
     if only_files is not None:
         findings = [f for f in findings
                     if f.rule not in ("SCORE603", "SCORE604")

@@ -6,7 +6,7 @@ Exit-code contract (stable, scripted against by CI):
   1  at least one unsuppressed ERROR-tier finding
   2  baseline/config error (unjustified entry, unreadable file)
   3  unsuppressed WARN-tier findings only (advisory heuristics:
-     LOCK302 / SHARD403 / ALIAS503)
+     LOCK302 / SHARD403 / ALIAS503 / OBS802 / RACE903)
 
 `--no-baseline` is a REPORTING mode, not a gating mode: it lists every
 finding (each tagged with whether the checked-in baseline would
@@ -21,11 +21,22 @@ graph), but only findings in the named files are reported, and the
 registry-rot/coverage rules (SCORE603/SCORE604) are muted because a
 per-file view cannot judge them.  CI must keep running WITHOUT
 `--paths` so the whole-package invariants stay enforced.
+
+`--diff` resolves the changed-file set from `git diff --name-only
+HEAD` and feeds it to the same --paths machinery (the pre-commit
+ergonomic).  It refuses cleanly (exit 2) outside a git checkout.
+
+`--cache-dir DIR` turns on the on-disk incremental index cache:
+parsed ASTs are stored per file keyed by content hash, so repeat runs
+only re-parse what changed.  Off by default — CI runs cold on purpose
+so a poisoned cache can never mask a finding.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from . import (ANALYZER_VERSION, BaselineError, analyze,
@@ -38,6 +49,32 @@ def _exit_code(rep) -> int:
     if rep.warnings:
         return 3
     return 0
+
+
+def _diff_paths() -> list:
+    """Changed .py files from git (worktree vs HEAD, plus staged and
+    untracked), for --diff mode.  Raises RuntimeError outside a git
+    checkout or without git."""
+    here = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=here, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=here, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"git unavailable: {e}")
+    if out.returncode != 0:
+        raise RuntimeError(
+            (out.stderr or "git diff failed").strip())
+    names = out.stdout.splitlines()
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    return sorted({os.path.join(here, n) for n in names
+                   if n.endswith(".py") and os.path.exists(
+                       os.path.join(here, n))})
 
 
 def main(argv=None) -> int:
@@ -65,7 +102,31 @@ def main(argv=None) -> int:
                          "findings in these files (pre-commit); "
                          "SCORE603/SCORE604 are muted — CI must run "
                          "without --paths")
+    ap.add_argument("--diff", action="store_true",
+                    help="pre-commit mode: resolve changed files from "
+                         "`git diff --name-only HEAD` (plus untracked) "
+                         "and run as if passed via --paths; refuses "
+                         "outside a git checkout")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="on-disk incremental index cache (per-file "
+                         "content-hash keyed ASTs); off by default so "
+                         "CI always runs cold")
     args = ap.parse_args(argv)
+    if args.diff and args.paths:
+        print("--diff and --paths are mutually exclusive (--diff IS "
+              "a computed --paths)", file=sys.stderr)
+        return 2
+    if args.diff:
+        try:
+            diff_paths = _diff_paths()
+        except RuntimeError as e:
+            print(f"--diff needs a git checkout: {e}", file=sys.stderr)
+            return 2
+        if not diff_paths:
+            print(f"nomadlint v{ANALYZER_VERSION}: --diff found no "
+                  "changed .py files")
+            return 0
+        args.paths = diff_paths
     if args.paths and args.prune_stale:
         # a partial index makes most baseline entries look stale;
         # pruning on that view would wrongly delete live entries
@@ -76,7 +137,8 @@ def main(argv=None) -> int:
     bl_path = args.baseline or default_baseline_path()
     try:
         baseline = load_baseline(bl_path)
-        rep = analyze(baseline=baseline, paths=args.paths)
+        rep = analyze(baseline=baseline, paths=args.paths,
+                      cache_dir=args.cache_dir)
     except BaselineError as e:
         print(f"baseline error: {e}", file=sys.stderr)
         return 2
@@ -85,7 +147,8 @@ def main(argv=None) -> int:
             print(f"baseline error: {e}", file=sys.stderr)
             return 2
         baseline = None
-        rep = analyze(use_baseline=False, paths=args.paths)
+        rep = analyze(use_baseline=False, paths=args.paths,
+                      cache_dir=args.cache_dir)
 
     if args.prune_stale and rep.stale_baseline_keys:
         pruned = baseline.without(rep.stale_baseline_keys)

@@ -24,15 +24,21 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # severity tiers: "error" findings gate CI (exit 1); "warn" findings
 # are advisory heuristics (exit 3 when they are the only findings).
 # Everything not listed here is an error.
-WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503", "OBS802"})
+WARN_RULES = frozenset({"LOCK302", "SHARD403", "ALIAS503", "OBS802",
+                        "RACE903"})
 
 # rule-id prefix -> pass name (used by --json/by_pass and bench's
 # lint_summary so BENCH_DETAIL records per-pass lint state)
 RULE_PASSES: Tuple[Tuple[str, str], ...] = (
     ("FSM", "fsm"), ("JIT", "jit"), ("LOCK", "lock"),
     ("SHARD", "shard"), ("ALIAS", "alias"), ("SCORE", "score"),
-    ("ROBUST", "robust"), ("OBS", "obs"),
+    ("ROBUST", "robust"), ("OBS", "obs"), ("RACE", "race"),
 )
+
+# rules whose id prefix belongs to another pass: LOCK305 is produced by
+# the lockset race pass (it needs the interprocedural held-set fixpoint
+# the syntactic lock pass doesn't compute)
+_RULE_PASS_OVERRIDES = {"LOCK305": "race"}
 
 
 def severity_of(rule: str) -> str:
@@ -40,6 +46,8 @@ def severity_of(rule: str) -> str:
 
 
 def pass_of(rule: str) -> str:
+    if rule in _RULE_PASS_OVERRIDES:
+        return _RULE_PASS_OVERRIDES[rule]
     for prefix, name in RULE_PASSES:
         if rule.startswith(prefix):
             return name
@@ -187,6 +195,32 @@ class AnalysisConfig:
     obs_exclude_modules: Tuple[str, ...] = (
         "nomad_tpu.utils.metrics", "nomad_tpu.telemetry.series",
     )
+    # RACE9xx / LOCK305 scope: the planes whose thread-shared classes
+    # get Eraser-style guarded-by inference and blocking-under-lock
+    # checks (the scale-out control plane plus everything it locks).
+    race_module_prefixes: Tuple[str, ...] = (
+        "nomad_tpu.server", "nomad_tpu.state", "nomad_tpu.rpc",
+        "nomad_tpu.raft", "nomad_tpu.solver",
+        "nomad_tpu.scheduler.fleet",
+    )
+    # LOCK305: package functions that block BY CONTRACT (device solve,
+    # store index waits, raft proposal round-trips, RPC) — calling one
+    # with a hot-path lock held is an error even when the blocking op
+    # itself hides behind a resolution boundary.  fnmatch patterns
+    # over "module:qualname".
+    blocking_roots: Tuple[str, ...] = (
+        "nomad_tpu.solver.solve:*.solve",
+        "nomad_tpu.solver.resident:*.solve*",
+        "nomad_tpu.state.store:*.wait_for_index",
+        "nomad_tpu.state.store:*.wait_for_change",
+        "nomad_tpu.raft.node:RaftNode.propose*",
+        "nomad_tpu.rpc.client:RpcClient.call",
+        "nomad_tpu.rpc.transport:*.call",
+        "nomad_tpu.rpc.wire:send_frame",
+        "nomad_tpu.rpc.wire:recv_frame",
+        "nomad_tpu.scheduler.fleet:process_fleet",
+        "nomad_tpu.scheduler.fleet:SolveCoordinator.submit",
+    )
 
 
 class FuncInfo:
@@ -287,10 +321,18 @@ class PackageIndex:
 
     # ------------------------------------------------------------ build
     @classmethod
-    def build(cls, package_dir: str,
-              package_name: str) -> "PackageIndex":
+    def build(cls, package_dir: str, package_name: str,
+              cache_dir: Optional[str] = None) -> "PackageIndex":
+        """Index the package.  `cache_dir` (opt-in, off in CI) enables
+        the on-disk incremental cache: parsed ASTs are pickled per
+        file, keyed by content hash, so an unchanged file never
+        re-parses.  The key salts in the Python minor version — pickled
+        ast nodes do not travel across interpreters — and any cache
+        miss/corruption silently falls back to a fresh parse."""
         idx = cls(package_name)
         pkg_root = os.path.join(package_dir, package_name)
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
         for dirpath, dirnames, filenames in os.walk(pkg_root):
             dirnames[:] = sorted(d for d in dirnames
                                  if d != "__pycache__")
@@ -304,9 +346,8 @@ class PackageIndex:
                     mod = mod[: -len(".__init__")]
                 with open(path, "r", encoding="utf-8") as f:
                     src = f.read()
-                try:
-                    tree = ast.parse(src, filename=path)
-                except SyntaxError:
+                tree = _parse_cached(src, path, cache_dir)
+                if tree is None:
                     continue
                 idx._index_module(mod, rel, tree)
         idx._resolve_class_bases()
@@ -712,6 +753,43 @@ class PackageIndex:
             if any(fnmatch.fnmatchcase(base, p) for p in patterns):
                 out.append(k)
         return sorted(out)
+
+
+def _parse_cached(src: str, path: str,
+                  cache_dir: Optional[str]) -> Optional[ast.Module]:
+    """ast.parse with an optional content-hash-keyed pickle cache."""
+    if not cache_dir:
+        try:
+            return ast.parse(src, filename=path)
+        except SyntaxError:
+            return None
+    import hashlib
+    import pickle
+    import sys
+    salt = f"py{sys.version_info[0]}.{sys.version_info[1]}|"
+    digest = hashlib.sha256(
+        (salt + src).encode("utf-8")).hexdigest()
+    cpath = os.path.join(cache_dir, digest + ".ast.pkl")
+    try:
+        with open(cpath, "rb") as f:
+            tree = pickle.load(f)
+        if isinstance(tree, ast.Module):
+            return tree
+    except (OSError, pickle.PickleError, EOFError, AttributeError,
+            ValueError):
+        pass
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        return None
+    try:
+        tmp = cpath + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, cpath)
+    except OSError:
+        pass
+    return tree
 
 
 def _direct_defs(node) -> List[ast.AST]:

@@ -90,7 +90,8 @@ class DeploymentWatcher:
                     if dep.active():
                         self._check(dep)
                     else:
-                        self._state.pop(dep.id, None)
+                        with self._cv:
+                            self._state.pop(dep.id, None)
             except Exception:
                 _log.exception("deployment watcher pass failed")
             # block until new writes (health updates bump the store) or a
@@ -186,7 +187,8 @@ class DeploymentWatcher:
                 status=DEPLOYMENT_STATUS_SUCCESSFUL,
                 status_description=DEPLOYMENT_DESC_SUCCESSFUL),
             mark_stable=(dep.namespace, dep.job_id, dep.job_version))
-        self._state.pop(dep.id, None)
+        with self._cv:
+            self._state.pop(dep.id, None)
 
     def _fail(self, dep: Deployment, desc: str) -> None:
         """Fail the deployment; auto-revert to the latest stable job
@@ -211,7 +213,8 @@ class DeploymentWatcher:
         self.server.apply_deployment_status_update(DeploymentStatusUpdate(
             deployment_id=dep.id, status=DEPLOYMENT_STATUS_FAILED,
             status_description=desc))
-        self._state.pop(dep.id, None)
+        with self._cv:
+            self._state.pop(dep.id, None)
         if rollback_job is not None:
             self.server.revert_job(rollback_job)
         else:

@@ -218,11 +218,15 @@ class _Shard:
         t.start()
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
+        # check and act under ONE lock hold: the old shape (validate
+        # the token, release, re-enter through nack()) left a window
+        # where an ack/explicit-nack could slip in between — the
+        # RACE903 check-then-act class nomadlint now pins down
         with self._lock:
             u = self._unack.get(eval_id)
             if u is None or u.token != token:
                 return
-        self.nack(eval_id, token)
+            self._nack_locked(eval_id, token)
 
     def pause_nack_timeout(self, eval_id: str,
                            token: str) -> Optional[str]:
@@ -282,46 +286,51 @@ class _Shard:
 
     def nack(self, eval_id: str, token: str) -> Optional[str]:
         with self._lock:
-            u = self._unack.get(eval_id)
-            if u is None or u.token != token:
-                return "token mismatch"
-            if u.nack_timer:
-                u.nack_timer.cancel()
-            del self._unack[eval_id]
-            self._requeue.pop(eval_id, None)
-            self._nacks += 1
-            from ..utils.metrics import global_metrics as _m
-            _m.incr_counter("broker.nack")
-            ev = u.eval
-            # keep the per-job serialization slot held by the nacked eval
-            # until it is acked (reference Nack semantics) so a newer eval
-            # for the job can't jump ahead of the redelivery; the slot is
-            # only freed when the eval is parked for the failed-eval reaper
-            if self._deliveries.get(eval_id, 0) >= \
-                    self._broker.delivery_limit:
-                self._release_job_slot_locked(ev, eval_id)
-                # too many failed deliveries: park it for the leader reaper
-                self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
-                self._ready_since[ev.id] = _time.monotonic()
-                _tr.event(eval_id, "broker.nack", parked=True,
-                          deliveries=self._deliveries.get(eval_id, 0))
-                self._broker.notify_ready()
-                return None
-            # redeliver after a capped jittered exponential delay:
-            # linear compounding barely separates a flapping eval from
-            # healthy redeliveries, and unjittered delays re-collide a
-            # burst of nacked evals at every retry (thundering herd)
-            n = max(1, self._deliveries.get(eval_id, 1))
-            delay = min(self._broker.max_nack_delay_s,
-                        self._broker.initial_nack_delay_s * (2 ** (n - 1)))
-            delay *= 0.5 + self._nack_rng.random() / 2.0
-            _tr.event(eval_id, "broker.nack", parked=False,
-                      deliveries=self._deliveries.get(eval_id, 0),
-                      redeliver_delay_s=round(delay, 6))
-            deadline = _time.time() + delay
-            self._waiting[ev.id] = ev
-            heapq.heappush(self._delay_heap, (deadline, ev.id))
+            return self._nack_locked(eval_id, token)
+
+    def _nack_locked(self, eval_id: str, token: str) -> Optional[str]:
+        """Nack body; the caller holds self._lock (the nack timer's
+        check-then-act shares one hold with the requeue)."""
+        u = self._unack.get(eval_id)
+        if u is None or u.token != token:
+            return "token mismatch"
+        if u.nack_timer:
+            u.nack_timer.cancel()
+        del self._unack[eval_id]
+        self._requeue.pop(eval_id, None)
+        self._nacks += 1
+        from ..utils.metrics import global_metrics as _m
+        _m.incr_counter("broker.nack")
+        ev = u.eval
+        # keep the per-job serialization slot held by the nacked eval
+        # until it is acked (reference Nack semantics) so a newer eval
+        # for the job can't jump ahead of the redelivery; the slot is
+        # only freed when the eval is parked for the failed-eval reaper
+        if self._deliveries.get(eval_id, 0) >= \
+                self._broker.delivery_limit:
+            self._release_job_slot_locked(ev, eval_id)
+            # too many failed deliveries: park it for the leader reaper
+            self._ready.setdefault(FAILED_QUEUE, _Heap()).push(ev)
+            self._ready_since[ev.id] = _time.monotonic()
+            _tr.event(eval_id, "broker.nack", parked=True,
+                      deliveries=self._deliveries.get(eval_id, 0))
+            self._broker.notify_ready()
             return None
+        # redeliver after a capped jittered exponential delay:
+        # linear compounding barely separates a flapping eval from
+        # healthy redeliveries, and unjittered delays re-collide a
+        # burst of nacked evals at every retry (thundering herd)
+        n = max(1, self._deliveries.get(eval_id, 1))
+        delay = min(self._broker.max_nack_delay_s,
+                    self._broker.initial_nack_delay_s * (2 ** (n - 1)))
+        delay *= 0.5 + self._nack_rng.random() / 2.0
+        _tr.event(eval_id, "broker.nack", parked=False,
+                  deliveries=self._deliveries.get(eval_id, 0),
+                  redeliver_delay_s=round(delay, 6))
+        deadline = _time.time() + delay
+        self._waiting[ev.id] = ev
+        heapq.heappush(self._delay_heap, (deadline, ev.id))
+        return None
 
     # ------------------------------------------------------------ plumbing
     def pop_due_delayed(self) -> float:
