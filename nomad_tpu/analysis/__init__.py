@@ -65,7 +65,7 @@ from .core import (AnalysisConfig, Finding, PackageIndex, Report,
                    pass_of, severity_of)
 from .baseline import Baseline, BaselineError, load_baseline
 
-ANALYZER_VERSION = "4.0"
+ANALYZER_VERSION = "4.1"
 
 # the directory CONTAINING the nomad_tpu package (analysis/ -> pkg -> root)
 _PKG_DIR = os.path.dirname(os.path.dirname(

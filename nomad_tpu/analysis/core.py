@@ -220,6 +220,14 @@ class AnalysisConfig:
         "nomad_tpu.rpc.wire:recv_frame",
         "nomad_tpu.scheduler.fleet:process_fleet",
         "nomad_tpu.scheduler.fleet:SolveCoordinator.submit",
+        # pipelined hot path (ISSUE 19): the fetch/future-wait entry
+        # points block until the DEVICE finishes a round — holding a
+        # hot-path lock across one serializes every other worker behind
+        # the solve, exactly the stall the async split exists to remove.
+        "nomad_tpu.solver.resident:*.finish_stream",
+        "nomad_tpu.solver.solve:PendingSolve.wait",
+        "nomad_tpu.scheduler.fleet:fleet_finish",
+        "nomad_tpu.scheduler.fleet:SolveCoordinator.submit_nowait",
     )
 
 
@@ -578,7 +586,13 @@ class PackageIndex:
 
     def _local_var_types(self, fi: FuncInfo) -> Dict[str, str]:
         """Single-pass local inference: `x = Cls(...)` / annotated
-        params."""
+        params / loop vars and subscripts over self-attr containers
+        with a known element class (`for s in self._shards:` /
+        `s = self._shards[i]`).  The container cases keep the call
+        graph honest for the fan-out-over-helpers shape: a single
+        watcher thread iterating a list of shard objects is a call
+        edge into the shard class, and thread-rootset propagation
+        (race pass) depends on seeing it."""
         cache = getattr(self, "_lvt_cache", None)
         if cache is None:
             cache = self._lvt_cache = {}
@@ -586,14 +600,40 @@ class PackageIndex:
         if cached is not None:
             return cached
         mi = self.modules[fi.module]
+        ci = self.class_of_func(fi)
         ann = self._param_annotations(fi)
         out = dict(ann)
         for node in ast.walk(fi.node):
+            tgt = val = None
+            elem_only = False
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
-                t = self._expr_class(mi, ann, node.value)
-                if t:
-                    out.setdefault(node.targets[0].id, t)
+                tgt, val = node.targets[0].id, node.value
+            elif isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Name) \
+                        and it.func.id == "enumerate" and it.args:
+                    it = it.args[0]
+                    if isinstance(node.target, ast.Tuple) \
+                            and len(node.target.elts) == 2 \
+                            and isinstance(node.target.elts[1], ast.Name):
+                        tgt = node.target.elts[1].id
+                elif isinstance(node.target, ast.Name):
+                    tgt = node.target.id
+                val, elem_only = it, True
+            if tgt is None or val is None:
+                continue
+            t = None if elem_only else self._expr_class(mi, ann, val)
+            if t is None and ci is not None:
+                base = val.value if isinstance(val, ast.Subscript) else \
+                    (val if elem_only else None)
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    t = self._attr_elem_type(ci, base.attr)
+            if t:
+                out.setdefault(tgt, t)
         cache[fi.key] = out
         return out
 
@@ -638,6 +678,15 @@ class PackageIndex:
                 if t:
                     return self.method_on(t, meth)
                 return None
+            # self.attr[i].m() — container of known element class
+            if (isinstance(base, ast.Subscript)
+                    and isinstance(base.value, ast.Attribute)
+                    and isinstance(base.value.value, ast.Name)
+                    and base.value.value.id == "self" and ci):
+                t = self._attr_elem_type(ci, base.value.attr)
+                if t:
+                    return self.method_on(t, meth)
+                return None
             # var.m() / alias.m() / alias.sub.m()
             name = _dotted(fnode)
             if name:
@@ -666,6 +715,22 @@ class PackageIndex:
             c = self.classes[ck]
             if attr in c.attr_types:
                 return c.attr_types[attr]
+            stack.extend(c.bases)
+        return None
+
+    def _attr_elem_type(self, ci: ClassInfo, attr: str) -> Optional[str]:
+        """Element class of a self-attr container (mro walk), mirroring
+        `_attr_type` for `attr_elem_types`."""
+        seen = set()
+        stack = [ci.key]
+        while stack:
+            ck = stack.pop(0)
+            if ck in seen or ck not in self.classes:
+                continue
+            seen.add(ck)
+            c = self.classes[ck]
+            if attr in c.attr_elem_types:
+                return c.attr_elem_types[attr]
             stack.extend(c.bases)
         return None
 

@@ -9,7 +9,8 @@ and a delivery limit that shunts flapping evals to a `_failed` queue
 SHARDING (ISSUE 17): the broker is partitioned into S independent
 shards keyed by crc32(namespace, job) — per-shard lock, ready heaps,
 `_ready_since` insertion-order age tracking, job slots and nack
-timers.  A job maps to exactly one shard, so per-job serialization
+deadlines (a heap serviced by the broker's one delayed-watcher thread
+— never a timer thread per eval).  A job maps to exactly one shard, so per-job serialization
 holds by construction without any cross-shard coordination; evals
 without a job route by eval id.  Dequeue starts at the caller's home
 shard (its worker index) and steals from the other shards when the
@@ -82,15 +83,22 @@ class _Heap:
 
 
 class _Unack:
+    __slots__ = ("eval", "token", "nack_deadline")
+
     def __init__(self, ev: Evaluation, token: str):
         self.eval = ev
         self.token = token
-        self.nack_timer: Optional[threading.Timer] = None
+        # wall-clock redelivery deadline, or None while paused.  Armed
+        # entries also sit in the shard's `_nack_heap`; a pause/ack/nack
+        # invalidates lazily (the heap entry's deadline no longer
+        # matches), so no per-eval timer thread ever exists — the
+        # broker's single delayed-watcher services every deadline.
+        self.nack_deadline: Optional[float] = None
 
 
 class _Shard:
     """One broker partition: its own lock, ready heaps, job slots,
-    unacked set, delay heap and nack timers.  All cross-thread entry
+    unacked set, delay heap and nack-deadline heap.  All cross-thread entry
     points take `self._lock`; `_locked`-suffixed helpers document the
     caller already holds it.  Wake-ups for blocked dequeuers go through
     the owning broker's shared ready condition (`notify_ready`) — the
@@ -108,6 +116,14 @@ class _Shard:
         self._requeue: Dict[str, Evaluation] = {}  # token-gated re-enqueue
         self._waiting: Dict[str, Evaluation] = {}  # delayed (wait_until)
         self._delay_heap: List[tuple] = []
+        # (deadline, eval_id, token) redelivery deadlines for unacked
+        # evals, serviced by the broker's delayed watcher.  Replaces the
+        # per-eval threading.Timer of the pre-19 broker: at thousands of
+        # dequeues/s the timer threads alone (create+start+cancel ~45µs
+        # each, plus scheduler churn from the live-thread population)
+        # were the worker-scaling ceiling.  Entries are append-only and
+        # validated lazily against the _Unack's current deadline.
+        self._nack_heap: List[tuple] = []
         self._dequeues = 0
         self._nacks = 0
         # eval id -> monotonic enqueue time while sitting in a ready
@@ -134,6 +150,16 @@ class _Shard:
                 else:
                     self._enqueue_locked(ev, ev.type)
 
+    def enqueue_batch(self, evals: List[Evaluation]) -> None:
+        """Bulk enqueue under ONE lock hold with ONE dequeuer wakeup.
+        Per-eval enqueue costs ~3x the heap push itself in lock and
+        condition traffic; plan followups and saturated ingress arrive
+        in bursts, so coalescing is the hot-path shape."""
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev, ev.type, notify=False)
+        self._broker.notify_ready()
+
     def _process_waiting_enqueue_locked(self, ev: Evaluation,
                                         token: str) -> None:
         u = self._unack.get(ev.id)
@@ -142,7 +168,8 @@ class _Shard:
         else:
             self._enqueue_locked(ev, ev.type)
 
-    def _enqueue_locked(self, ev: Evaluation, queue: str) -> None:
+    def _enqueue_locked(self, ev: Evaluation, queue: str,
+                        notify: bool = True) -> None:
         if not self._broker.enabled_flag:
             return
         if ev.id in self._unack or ev.id in self._waiting:
@@ -163,31 +190,46 @@ class _Shard:
         self._ready.setdefault(queue, _Heap()).push(ev)
         self._ready_since[ev.id] = _time.monotonic()
         _tr.event(ev.id, "broker.enqueue", queue=queue, shard=self.index)
-        self._broker.notify_ready()
+        if notify:
+            self._broker.notify_ready()
 
     # ------------------------------------------------------------- dequeue
     def try_dequeue(self, sched_types: Sequence[str]
                     ) -> Tuple[Optional[Evaluation], str]:
         """Non-blocking: pop the best ready eval, register the unack and
-        start its nack timer.  Returns (eval, token) or (None, "")."""
+        arm its nack deadline.  Returns (eval, token) or (None, "")."""
+        out = self.try_dequeue_n(sched_types, 1)
+        if not out:
+            return None, ""
+        return out[0]
+
+    def try_dequeue_n(self, sched_types: Sequence[str], max_n: int
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Non-blocking bulk dequeue: pop up to `max_n` ready evals
+        under ONE lock hold (the fused-solve hot path — per-eval lock
+        round trips at batch 128 cost more than the pops themselves)."""
+        out: List[Tuple[Evaluation, str]] = []
         with self._lock:
-            ev, age = self._dequeue_locked(sched_types)
-            if ev is None:
-                return None, ""
-            # shard index rides in the token so ack/nack route without
-            # a broker-level eval->shard map (no shared lock on the
-            # ack path)
-            token = f"{self.index}.{generate_uuid()}"
-            u = _Unack(ev, token)
-            self._unack[ev.id] = u
-            self._deliveries[ev.id] = self._deliveries.get(ev.id, 0) + 1
-            self._dequeues += 1
-            self._start_nack_timer(u)
-            _tr.event(ev.id, "broker.dequeue",
-                      queue_age_s=round(age, 6),
-                      delivery=self._deliveries[ev.id],
-                      shard=self.index)
-            return ev, token
+            while len(out) < max_n:
+                ev, age = self._dequeue_locked(sched_types)
+                if ev is None:
+                    break
+                # shard index rides in the token so ack/nack route
+                # without a broker-level eval->shard map (no shared
+                # lock on the ack path)
+                token = f"{self.index}.{generate_uuid()}"
+                u = _Unack(ev, token)
+                self._unack[ev.id] = u
+                self._deliveries[ev.id] = \
+                    self._deliveries.get(ev.id, 0) + 1
+                self._dequeues += 1
+                self._arm_nack_locked(u)
+                _tr.event(ev.id, "broker.dequeue",
+                          queue_age_s=round(age, 6),
+                          delivery=self._deliveries[ev.id],
+                          shard=self.index)
+                out.append((ev, token))
+        return out
 
     def _dequeue_locked(self, sched_types: Sequence[str]
                         ) -> Tuple[Optional[Evaluation], float]:
@@ -210,34 +252,37 @@ class _Shard:
                 age = _time.monotonic() - t0
         return ev, age
 
-    def _start_nack_timer(self, u: _Unack) -> None:
-        t = threading.Timer(self._broker.nack_delay_s,
-                            self._nack_timeout, args=(u.eval.id, u.token))
-        t.daemon = True
-        u.nack_timer = t
-        t.start()
-
-    def _nack_timeout(self, eval_id: str, token: str) -> None:
-        # check and act under ONE lock hold: the old shape (validate
-        # the token, release, re-enter through nack()) left a window
-        # where an ack/explicit-nack could slip in between — the
-        # RACE903 check-then-act class nomadlint now pins down
-        with self._lock:
-            u = self._unack.get(eval_id)
-            if u is None or u.token != token:
-                return
-            self._nack_locked(eval_id, token)
+    def _arm_nack_locked(self, u: _Unack) -> None:
+        """Arm (or re-arm) the redelivery deadline.  Caller holds the
+        shard lock.  A prior heap entry for the same unack is not
+        removed — it carries a different deadline and fails the lazy
+        validation when it surfaces."""
+        deadline = _time.time() + self._broker.nack_delay_s
+        u.nack_deadline = deadline
+        heapq.heappush(self._nack_heap, (deadline, u.eval.id, u.token))
 
     def pause_nack_timeout(self, eval_id: str,
                            token: str) -> Optional[str]:
         with self._lock:
-            u = self._unack.get(eval_id)
-            if u is None or u.token != token:
-                return "token mismatch"
-            if u.nack_timer:
-                u.nack_timer.cancel()
-                u.nack_timer = None
-            return None
+            return self._pause_nack_locked(eval_id, token)
+
+    def _pause_nack_locked(self, eval_id: str,
+                           token: str) -> Optional[str]:
+        u = self._unack.get(eval_id)
+        if u is None or u.token != token:
+            return "token mismatch"
+        # the heap entry goes stale in place: the watcher skips any
+        # entry whose deadline no longer matches the live unack
+        u.nack_deadline = None
+        return None
+
+    def pause_nack_batch(self, pairs: List[Tuple[str, str]]
+                         ) -> List[Optional[str]]:
+        """Pause redelivery for many (eval_id, token) pairs under one
+        lock hold; returns per-pair errors aligned with the input."""
+        with self._lock:
+            return [self._pause_nack_locked(eid, tok)
+                    for eid, tok in pairs]
 
     def resume_nack_timeout(self, eval_id: str,
                             token: str) -> Optional[str]:
@@ -245,26 +290,34 @@ class _Shard:
             u = self._unack.get(eval_id)
             if u is None or u.token != token:
                 return "token mismatch"
-            self._start_nack_timer(u)
+            self._arm_nack_locked(u)
             return None
 
     # ------------------------------------------------------------ ack/nack
     def ack(self, eval_id: str, token: str) -> Optional[str]:
         with self._lock:
-            u = self._unack.get(eval_id)
-            if u is None or u.token != token:
-                return "token mismatch"
-            if u.nack_timer:
-                u.nack_timer.cancel()
-            del self._unack[eval_id]
-            self._deliveries.pop(eval_id, None)
-            ev = u.eval
-            _tr.event(eval_id, "broker.ack")
-            self._release_job_slot_locked(ev, eval_id)
-            requeue = self._requeue.pop(eval_id, None)
-            if requeue is not None:
-                self._enqueue_locked(requeue, requeue.type)
-            return None
+            return self._ack_locked(eval_id, token)
+
+    def ack_batch(self, pairs: List[Tuple[str, str]]
+                  ) -> List[Optional[str]]:
+        """Ack many (eval_id, token) pairs under one lock hold; returns
+        per-pair errors aligned with the input."""
+        with self._lock:
+            return [self._ack_locked(eid, tok) for eid, tok in pairs]
+
+    def _ack_locked(self, eval_id: str, token: str) -> Optional[str]:
+        u = self._unack.get(eval_id)
+        if u is None or u.token != token:
+            return "token mismatch"
+        del self._unack[eval_id]
+        self._deliveries.pop(eval_id, None)
+        ev = u.eval
+        _tr.event(eval_id, "broker.ack")
+        self._release_job_slot_locked(ev, eval_id)
+        requeue = self._requeue.pop(eval_id, None)
+        if requeue is not None:
+            self._enqueue_locked(requeue, requeue.type)
+        return None
 
     def _release_job_slot_locked(self, ev: Evaluation,
                                  eval_id: str) -> None:
@@ -294,8 +347,6 @@ class _Shard:
         u = self._unack.get(eval_id)
         if u is None or u.token != token:
             return "token mismatch"
-        if u.nack_timer:
-            u.nack_timer.cancel()
         del self._unack[eval_id]
         self._requeue.pop(eval_id, None)
         self._nacks += 1
@@ -334,9 +385,13 @@ class _Shard:
 
     # ------------------------------------------------------------ plumbing
     def pop_due_delayed(self) -> float:
-        """Promote delayed evals whose wait has expired (called by the
-        broker's single delayed-watcher thread).  Returns the seconds
-        until this shard's next deadline (or 0.1 when idle)."""
+        """Promote delayed evals whose wait has expired AND fire due
+        nack deadlines (called by the broker's single delayed-watcher
+        thread).  Returns the seconds until this shard's next deadline
+        (or 0.1 when idle).  Nack redelivery is a multi-second safety
+        net, so the watcher's 10-100ms cadence is far inside its
+        tolerance — and one thread servicing every deadline replaces
+        the one-Timer-thread-per-dequeue storm."""
         with self._lock:
             now = _time.time()
             wait = 0.1
@@ -350,15 +405,25 @@ class _Shard:
                         ev2 = copy.copy(ev)
                         ev2.wait_until = 0.0
                     self._enqueue_locked(ev2, ev2.type)
+            while self._nack_heap and self._nack_heap[0][0] <= now:
+                deadline, eid, token = heapq.heappop(self._nack_heap)
+                u = self._unack.get(eid)
+                if u is None or u.token != token \
+                        or u.nack_deadline != deadline:
+                    continue    # stale: acked, paused, or re-armed
+                # check and act under ONE lock hold (the RACE903
+                # check-then-act class): no window for an ack or an
+                # explicit nack to slip between validate and requeue
+                self._nack_locked(eid, token)
             if self._delay_heap:
                 wait = min(wait, max(0.0, self._delay_heap[0][0] - now))
+            if self._nack_heap:
+                wait = min(wait, max(0.0, self._nack_heap[0][0] - now))
             return wait
 
     def flush(self) -> None:
         with self._lock:
-            for u in self._unack.values():
-                if u.nack_timer:
-                    u.nack_timer.cancel()
+            self._nack_heap.clear()
             self._ready.clear()
             self._unack.clear()
             self._job_evals.clear()
@@ -581,6 +646,18 @@ class EvalBroker:
     def enqueue(self, ev: Evaluation) -> None:
         self.shard_of(ev).enqueue(ev)
 
+    def enqueue_batch(self, evals: List[Evaluation]) -> None:
+        """Bulk enqueue, grouped by shard so each shard takes its lock
+        once and wakes dequeuers once per group instead of per eval."""
+        if self.num_shards == 1:
+            self._shards[0].enqueue_batch(evals)
+            return
+        by_shard: Dict[int, List[Evaluation]] = {}
+        for ev in evals:
+            by_shard.setdefault(self.shard_of(ev).index, []).append(ev)
+        for idx, group in by_shard.items():
+            self._shards[idx].enqueue_batch(group)
+
     def enqueue_all(self, evals: List[Tuple[Evaluation, str]]) -> None:
         """Enqueue (eval, token) pairs; a matching token for an unacked
         eval defers the re-enqueue until that eval is acked.  Routing
@@ -635,11 +712,8 @@ class EvalBroker:
             if len(out) >= max_batch:
                 break
             shard = self._shards[(start + k) % self.num_shards]
-            while len(out) < max_batch:
-                ev, tok = shard.try_dequeue(sched_types)
-                if ev is None:
-                    break
-                out.append((ev, tok))
+            out.extend(shard.try_dequeue_n(sched_types,
+                                           max_batch - len(out)))
         # dequeue-batch size histogram (p50/p99 via the metrics
         # reservoir) — the observability face of the BatchController
         from ..utils.metrics import global_metrics as _m
@@ -663,12 +737,44 @@ class EvalBroker:
             return "token mismatch"
         return sh.resume_nack_timeout(eval_id, token)
 
+    def pause_nack_batch(self, pairs: Sequence[Tuple[str, str]]
+                         ) -> List[Optional[str]]:
+        """Pause redelivery for many (eval_id, token) pairs with one
+        lock hold per touched shard (the fused-batch hot path)."""
+        return self._batch_by_shard(pairs, "pause_nack_batch")
+
     # ------------------------------------------------------------ ack/nack
     def ack(self, eval_id: str, token: str) -> Optional[str]:
         sh = self._shard_by_token(eval_id, token)
         if sh is None:
             return "token mismatch"
         return sh.ack(eval_id, token)
+
+    def ack_batch(self, pairs: Sequence[Tuple[str, str]]
+                  ) -> List[Optional[str]]:
+        """Ack many (eval_id, token) pairs with one lock hold per
+        touched shard; per-pair errors aligned with the input."""
+        return self._batch_by_shard(pairs, "ack_batch")
+
+    def _batch_by_shard(self, pairs: Sequence[Tuple[str, str]],
+                        method: str) -> List[Optional[str]]:
+        """Group (eval_id, token) pairs by issuing shard and apply the
+        shard's batch method once per group, preserving input order in
+        the returned error list."""
+        out: List[Optional[str]] = [None] * len(pairs)
+        by_shard: Dict[int, List[Tuple[int, str, str]]] = {}
+        for i, (eid, tok) in enumerate(pairs):
+            sh = self._shard_by_token(eid, tok)
+            if sh is None:
+                out[i] = "token mismatch"
+                continue
+            by_shard.setdefault(sh.index, []).append((i, eid, tok))
+        for idx, group in by_shard.items():
+            errs = getattr(self._shards[idx], method)(
+                [(eid, tok) for _i, eid, tok in group])
+            for (i, _eid, _tok), err in zip(group, errs):
+                out[i] = err
+        return out
 
     def nack(self, eval_id: str, token: str) -> Optional[str]:
         sh = self._shard_by_token(eval_id, token)

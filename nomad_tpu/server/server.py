@@ -117,7 +117,8 @@ class Server:
         self.solve_coordinator = None
         if self.serving.coordinator and num_workers > 1:
             from ..scheduler.fleet import SolveCoordinator
-            self.solve_coordinator = SolveCoordinator(self)
+            self.solve_coordinator = SolveCoordinator(
+                self, pipeline=self.serving.pipeline)
         self.heartbeater = NodeHeartbeater(
             self._on_heartbeat_expired,
             min_heartbeat_ttl_s=min_heartbeat_ttl_s,

@@ -376,6 +376,9 @@ class ServingTier:
         "num_workers": ("NOMAD_TPU_NUM_WORKERS", int, 2),
         "group_commit": ("NOMAD_TPU_GROUP_COMMIT", int, 8),
         "coordinator": ("NOMAD_TPU_COORDINATOR", int, 1),
+        # double-buffered coordinator pipelining (ISSUE 19): dispatch
+        # round b+1 while round b's device solve is in flight
+        "pipeline": ("NOMAD_TPU_PIPELINE", int, 1),
         # leader soft-pause fraction of workers; -1 = auto (0 once the
         # broker is sharded — pausing dequeue parallelism defeats shard
         # homing — else the reference's 3/4)
@@ -403,6 +406,7 @@ class ServingTier:
         self.num_workers = max(1, k["num_workers"])
         self.group_commit = max(1, k["group_commit"])
         self.coordinator = bool(k["coordinator"])
+        self.pipeline = bool(k["pipeline"])
         self.worker_pause_fraction = k["worker_pause_fraction"]
         self.solve_model = EwmaSolveModel()
         self.batch_controller = BatchController(
@@ -426,6 +430,20 @@ class ServingTier:
             slow_burn=k["slo_slow_burn"],
             events=global_mesh_events, metrics=global_metrics)
 
+    def note_device_solve(self, n_evals: int, device_s: float) -> None:
+        """Feed the batch-sizing model the DEVICE-solve time of a fused
+        round, not its end-to-end wall.  Under the pipelined coordinator
+        a round's wall clock includes waiting out the previous round's
+        device occupancy plus reconcile/pack/plan-build overlap — feeding
+        that into `EwmaSolveModel` would make `predict()` roughly 2x the
+        marginal cost of one more batch, and the `BatchController` close
+        rule would over-drain (every candidate blows the inflated budget,
+        flipping to DRAIN mode under moderate load).  The SLO burn
+        accounting (`observe_batch`) still sees end-to-end wall — the
+        eval's latency is what it is — only the *sizing* model narrows
+        to the device stage."""
+        self.solve_model.observe(n_evals, device_s)
+
     def observe_batch(self, n_evals: int, wall_s: float) -> None:
         """One solved batch's SLO verdict: every eval in a batch that
         lands inside the latency budget is `good`, a blown batch
@@ -446,6 +464,7 @@ class ServingTier:
             "num_workers": self.num_workers,
             "group_commit": self.group_commit,
             "coordinator": self.coordinator,
+            "pipeline": self.pipeline,
             "last_target_batch": self.batch_controller.last_target(),
             "model_observations": self.solve_model.observations(),
             "admission": self.admission.stats(),

@@ -79,13 +79,13 @@ class Worker(threading.Thread):
                 self._readmit_tick(serving)
                 continue
             if len(batch) > 1:
-                # hold every member's redelivery timer for the duration
-                # of the fused work (see process_fleet, which re-pauses
-                # idempotently): an express-lane solve or a slow fused
-                # batch must not trigger spurious nack redelivery for
-                # the members still waiting their turn
-                for ev, token in batch:
-                    broker.pause_nack_timeout(ev.id, token)
+                # hold every member's redelivery deadline for the
+                # duration of the fused work (see process_fleet, which
+                # re-pauses idempotently): an express-lane solve or a
+                # slow fused batch must not trigger spurious nack
+                # redelivery for the members still waiting their turn
+                broker.pause_nack_batch(
+                    [(ev.id, token) for ev, token in batch])
             if serving is not None:
                 # brownout: degrade the solve wave budget while the
                 # queue is saturated (leftovers retry via the normal
@@ -94,8 +94,9 @@ class Worker(threading.Thread):
                 self.fleet_solver().set_degraded(
                     serving.admission.brownout_active())
             t0 = _t.monotonic()
+            fused = False
             try:
-                self._run_batch(serving, batch)
+                fused = self._run_batch(serving, batch)
             except Exception as exc:
                 # a poisoned eval must not kill the worker; the nack path
                 # redelivers it until the delivery limit parks it — but
@@ -108,7 +109,13 @@ class Worker(threading.Thread):
                     self.server.broker.nack(ev.id, token)
             if serving is not None:
                 wall = _t.monotonic() - t0
-                serving.solve_model.observe(len(batch), wall)
+                if not fused:
+                    # fused rounds feed the sizing model their DEVICE
+                    # stage from fleet_finish (note_device_solve): under
+                    # pipelining the round wall double-counts the
+                    # previous round's occupancy and would over-drain
+                    # the close rule
+                    serving.solve_model.observe(len(batch), wall)
                 # SLO burn-rate accounting + the first explicit-bucket
                 # histogram users (ISSUE 15): batch solve latency on
                 # the latency bounds, batch size on pow2 count bounds
@@ -139,13 +146,16 @@ class Worker(threading.Thread):
         return serving.batch_controller.target_batch(
             broker.ready_count(), broker.oldest_ready_age())
 
-    def _run_batch(self, serving, batch) -> None:
+    def _run_batch(self, serving, batch) -> bool:
+        """Run one dequeued batch; returns True when the fused
+        (coordinator / process_fleet) path handled the bulk lane, i.e.
+        the sizing model was already fed device time by fleet_finish."""
         from ..utils.tracing import global_tracer as _tr
         if len(batch) == 1:
             _tr.event(batch[0][0].id, "worker.batch", batch_size=1,
                       lane="single")
             self._process(*batch[0])
-            return
+            return False
         express, bulk = [], []
         bypass = serving.bypass_priority if serving is not None else None
         for ev, token in batch:
@@ -166,6 +176,7 @@ class Worker(threading.Thread):
             self._process(ev, token)
         if len(bulk) == 1:
             self._process(*bulk[0])
+            return False
         elif bulk:
             coordinator = getattr(self.server, "solve_coordinator", None)
             if coordinator is not None:
@@ -177,6 +188,8 @@ class Worker(threading.Thread):
             else:
                 from ..scheduler.fleet import process_fleet
                 process_fleet(self.server, self, bulk)
+            return True
+        return False
 
     def _readmit_tick(self, serving) -> None:
         """Pop admission-shed evals back into the broker once the queue

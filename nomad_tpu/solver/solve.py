@@ -335,6 +335,73 @@ class SolveOutput:
     trace: Dict = field(default_factory=dict)
 
 
+class PendingSolve:
+    """An in-flight fused solve: packed and dispatched to the device,
+    fetch + host fixup deferred.  `wait()` is the ONLY blocking step —
+    it materializes the device result, runs the host fixup walk and
+    returns the SolveOutput; idempotent, single-owner (the pipelined
+    coordinator's drain leader).
+
+    Timing stamps (perf_counter domain) let the caller account device
+    time as interval unions under pipelining:
+
+      t_dispatched     stamp right after the kernel launch returned
+      dispatch_wall_s  pack + launch wall (host-side dispatch cost)
+      fetch_wall_s     wall blocked inside wait() on the device result
+      finish_wall_s    host fixup walk wall
+    """
+
+    __slots__ = ("_solver", "_pb", "_sol_nodes", "_asks",
+                 "_allocs_by_node", "_by_dc", "_used_resident", "_res",
+                 "_t0", "_out", "t_dispatched", "pack_wall_s",
+                 "dispatch_wall_s", "fetch_wall_s", "finish_wall_s")
+
+    def __init__(self, solver, pb=None, sol_nodes=None, asks=None,
+                 allocs_by_node=None, by_dc=None,
+                 used_resident: bool = False, res=None, t0: float = 0.0,
+                 out: Optional[SolveOutput] = None):
+        self._solver = solver
+        self._pb = pb
+        self._sol_nodes = sol_nodes
+        self._asks = asks
+        self._allocs_by_node = allocs_by_node
+        self._by_dc = by_dc
+        self._used_resident = used_resident
+        self._res = res
+        self._t0 = t0
+        self._out = out
+        self.t_dispatched = t0
+        self.pack_wall_s = 0.0
+        self.dispatch_wall_s = 0.0
+        self.fetch_wall_s = 0.0
+        self.finish_wall_s = 0.0
+
+    def wait(self) -> SolveOutput:
+        """Block until the device result lands, then run the host fixup.
+        Safe to call again after completion (returns the cached output);
+        NOT safe to call concurrently from two threads."""
+        if self._out is not None:
+            return self._out
+        import time as _t
+        t0 = _t.perf_counter()
+        np.asarray(self._res.choice)   # blocks until the kernel is done
+        t1 = _t.perf_counter()
+        self.fetch_wall_s = t1 - t0
+        out = self._solver._finish_solve(
+            self._pb, self._sol_nodes, self._asks, self._res,
+            self._used_resident, self._allocs_by_node, self._by_dc,
+            self._t0)
+        self.finish_wall_s = _t.perf_counter() - t1
+        out.trace["dispatch_wall_s"] = round(self.dispatch_wall_s, 6)
+        out.trace["fetch_wall_s"] = round(self.fetch_wall_s, 6)
+        self._out = out
+        # drop the packed batch + device refs so a long-lived pending
+        # handle doesn't pin buffers
+        self._res = self._pb = self._sol_nodes = self._asks = None
+        self._allocs_by_node = self._by_dc = None
+        return out
+
+
 class Solver:
     """Stateful wrapper owning tensorizer memoization. One per scheduler
     worker (reference analog: the Stack owned by each scheduler).
@@ -513,8 +580,37 @@ class Solver:
         wave pass (ISSUE 7) and failed-capacity placements may come
         back with `Placement.evicted` victim ids instead of a failure.
         `_overlay_only`: what-if plan mode (see PlanSolverView)."""
+        return self.solve_async(
+            nodes, asks, allocs_by_node, by_dc, snapshot=snapshot,
+            proposed_delta=proposed_delta, preempt=preempt,
+            _overlay_only=_overlay_only).wait()
+
+    def solve_async(self, nodes: Sequence[Node],
+                    asks: Sequence[PlacementAsk],
+                    allocs_by_node: Optional[Dict[str, list]] = None,
+                    by_dc: Optional[Dict[str, int]] = None, *,
+                    snapshot=None, proposed_delta=None,
+                    preempt: bool = False,
+                    _overlay_only: bool = False) -> "PendingSolve":
+        """Dispatch phase of `solve`: pack and LAUNCH the kernel without
+        fetching the result.  Returns a PendingSolve whose `wait()`
+        blocks on the device fetch, runs the host fixup walk and yields
+        the SolveOutput — the seam the pipelined coordinator rides to
+        pack round b+1 while round b solves (the same dispatch/fetch
+        split `solve_stream_async`/`finish_stream` and
+        `device_health_raw`/`fetch_health` already use).
+
+        When the solve resolves to the host kernel the "dispatch" runs
+        it to completion (numpy has no async) and wait() is free; when
+        the watchdog is armed the solve also degrades to eager, because
+        the watchdog deadline must cover dispatch AND fetch as one
+        window — a device wedge surfacing only at the fetch would
+        escape a dispatch-only deadline."""
+        import time as _t
+        _solve_t0 = _t.perf_counter()
         if not asks:
-            return SolveOutput(placements=[])
+            return PendingSolve(self, out=SolveOutput(placements=[]),
+                                t0=_solve_t0)
         pb = None
         sol_nodes = nodes
         if snapshot is not None and self.resident_active(snapshot):
@@ -528,12 +624,31 @@ class Solver:
                 # the tensorizer's interners are shared with concurrent
                 # plan-view solves — serialize every pack through it
                 pb = self._tensorizer.pack(nodes, asks, allocs_by_node)
-        import time as _t
-        _solve_t0 = _t.perf_counter()
+        from .watchdog import global_watchdog
+        _t_pack_done = _t.perf_counter()
         res = _run_kernel(pb, host_mode=self._host,
                           max_waves=BROWNOUT_MAX_WAVES
                           if self._degraded else 0,
-                          preempt=preempt)
+                          preempt=preempt,
+                          materialize=global_watchdog.enabled)
+        pending = PendingSolve(self, pb=pb, sol_nodes=sol_nodes,
+                               asks=list(asks),
+                               allocs_by_node=allocs_by_node,
+                               by_dc=by_dc,
+                               used_resident=used_resident, res=res,
+                               t0=_solve_t0)
+        pending.t_dispatched = _t.perf_counter()
+        pending.pack_wall_s = _t_pack_done - _solve_t0
+        pending.dispatch_wall_s = pending.t_dispatched - _t_pack_done
+        return pending
+
+    def _finish_solve(self, pb: PackedBatch, sol_nodes, asks, res,
+                      used_resident: bool, allocs_by_node, by_dc,
+                      _solve_t0: float) -> SolveOutput:
+        """Fetch-side half of `solve`: result materialization happened
+        in PendingSolve.wait(); this walks the host fixup and builds
+        the SolveOutput."""
+        import time as _t
         trace_attrs = solve_trace_attrs(pb, res)
         trace_attrs["kernel_wall_s"] = round(
             _t.perf_counter() - _solve_t0, 6)
@@ -921,7 +1036,12 @@ def solve_trace_attrs(pb: PackedBatch, res) -> Dict:
 
 def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
                 pallas: str = "auto", max_waves: int = 0,
-                preempt: bool = False):
+                preempt: bool = False, materialize: bool = True):
+    """`materialize=False` is the async-dispatch mode: the device
+    kernel is launched but its result is NOT fetched — the caller owns
+    the later materialization (PendingSolve.wait).  Ignored on the host
+    path (numpy is eager) and forced on under the watchdog (its
+    deadline must cover the fetch)."""
     import numpy as _np
     has_spread = bool((_np.asarray(pb.sp_col[:, 0]) >= 0).any())
     # in-kernel preemption (ISSUE 7): only when the batch carries the
@@ -966,7 +1086,8 @@ def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
                            **ev_kw)
         # materialize under the watchdog deadline: an async dispatch
         # that only wedges at a later fetch would escape it
-        _np.asarray(res.choice)
+        if materialize or global_watchdog.enabled:
+            _np.asarray(res.choice)
         return res
 
     from .watchdog import global_watchdog

@@ -1701,6 +1701,39 @@ FIX_RACE = """
             with self._lock:
                 self.beat = self.beat + 1
             time.sleep(0.05)
+
+
+    def finish_round(pending):              # blocking BY CONTRACT via
+        return pending                      # the config's blocking_roots
+
+
+    class FetchUnderLock:                   # LOCK305: future-wait held
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.pending = None
+
+        def start(self):
+            threading.Thread(target=self.harvest, daemon=True).start()
+
+        def harvest(self):
+            with self._lock:
+                out = finish_round(self.pending)  # LOCK305 (root)
+                self.pending = None
+            return out
+
+
+    class FetchOutsideLock:                 # clean twin: snapshot under
+        def __init__(self):                 # the lock, fetch after it
+            self._lock = threading.Lock()
+            self.pending = None
+
+        def start(self):
+            threading.Thread(target=self.harvest, daemon=True).start()
+
+        def harvest(self):
+            with self._lock:
+                pending, self.pending = self.pending, None
+            return finish_round(pending)
 """
 
 # The race pass owns this fixture package outright: the lock pass is
@@ -1713,6 +1746,9 @@ RACE_CFG = AnalysisConfig(
     lock_module_prefixes=(),
     fsm_roots=(),
     scorer_sites=(),
+    # fixture-local stand-in for the package's fetch/future-wait entry
+    # points (finish_stream / PendingSolve.wait / fleet_finish)
+    blocking_roots=("racepkg.racemod:finish_round",),
 )
 
 
@@ -1753,11 +1789,14 @@ def test_race_check_then_act_detected(race_report):
 def test_blocking_under_lock_detected_polite_twin_clean(race_report):
     """LOCK305: time.sleep while a hot lock is held — both directly in
     the locked region and inside a helper whose entry lockset the
-    interprocedural fixpoint propagates.  The twin sleeping after
-    release is quiet."""
+    interprocedural fixpoint propagates — plus a config-declared
+    blocking root (the fetch/future-wait contract) called under the
+    lock.  The twins (sleep after release; snapshot under the lock,
+    fetch after it) are quiet."""
     assert _keys(race_report, "LOCK305") == {
         "LOCK305:racepkg.racemod:SleepyHolder._run:time.sleep",
-        "LOCK305:racepkg.racemod:SleepyHolder._sync:time.sleep"}
+        "LOCK305:racepkg.racemod:SleepyHolder._sync:time.sleep",
+        "LOCK305:racepkg.racemod:FetchUnderLock.harvest:finish_round"}
 
 
 def test_race_guard_inference_exports_guarded_by_map(tmp_path):

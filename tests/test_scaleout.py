@@ -588,3 +588,166 @@ def test_scaleout_smoke_sharded_workers_coordinator():
         assert counters.get("coordinator.rounds", 0) > 0
     finally:
         server.stop()
+
+
+# ------------------------------------------------------------------
+# Pipelined coordinator (ISSUE 19): seeded parity + async fan-back
+# ------------------------------------------------------------------
+def _coordinator_run(n_jobs, n_workers, pipeline, seed):
+    """One seeded scenario through a SolveCoordinator: shuffle the
+    dequeued evals, deal them round-robin to `n_workers` submitters,
+    release them against a paused coordinator with `pipeline` on or
+    off.  max_fused=4 forces multiple rounds, so the pipelined drain
+    actually overlaps round b+1's reconcile with round b's solve.
+    Returns (placements, eval statuses) — the full observable state."""
+    server = Server(num_workers=0)
+    server.start()
+    try:
+        _nodes, jobs = _dc_pinned_cluster(server, n_jobs)
+        for j in jobs:
+            server.register_job(j)
+        batch = server.broker.dequeue_batch(["service"], n_jobs, 1.0)
+        assert len(batch) == n_jobs
+        random.Random(seed).shuffle(batch)
+        coord = SolveCoordinator(server, max_fused=4, pipeline=pipeline)
+        assert coord.pipeline is bool(pipeline)
+        coord.pause()
+        workers = [Worker(server, ["service"], index=i)
+                   for i in range(n_workers)]
+        shares = [batch[k::n_workers] for k in range(n_workers)]
+        threads = [threading.Thread(target=coord.submit,
+                                    args=(workers[k], shares[k]))
+                   for k in range(n_workers) if shares[k]]
+        for t in threads:
+            t.start()
+        assert wait_until(lambda: coord.pending() == len(threads),
+                          timeout=5.0)
+        coord.resume()
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive()
+        assert server.broker.stats()["total_unacked"] == 0
+        statuses = {j.id: server.store.evals_by_job("default", j.id)[0]
+                    .status for j in jobs}
+        return _placements(server, jobs), statuses
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("n_workers", [2, 4, 8])
+@pytest.mark.parametrize("pallas", ["off", "score"])
+def test_pipelined_coordinator_matches_serialized(n_workers, pallas,
+                                                  monkeypatch):
+    """ISSUE 19 property: the async double-buffered drain must place
+    EXACTLY what the PR-17 serialized drain places — same placements,
+    same eval statuses — across worker counts and with the pallas
+    scoring kernel forced on (interpreted on CPU) or off.  Round b+1
+    reconciles against a snapshot that excludes round b's uncommitted
+    plans; with dc-pinned jobs the solves are independent, so any
+    divergence is a pipelining bug, not optimistic-concurrency slack."""
+    from nomad_tpu.solver import pallas_kernel as PK
+    monkeypatch.setenv("NOMAD_TPU_PALLAS",
+                       "0" if pallas == "off" else "1")
+    PK.enabled.cache_clear()
+    try:
+        n_jobs, seed = 8, 1900 + n_workers
+        serialized = _coordinator_run(n_jobs, n_workers, False, seed)
+        pipelined = _coordinator_run(n_jobs, n_workers, True, seed)
+        assert pipelined == serialized
+        assert all(len(v) == 2 for v in pipelined[0].values())
+    finally:
+        PK.enabled.cache_clear()
+
+
+def test_async_fanback_conservation_storm():
+    """InvariantHarness conservation over the fire-and-forget fan-back:
+    producers race admission, consumer threads dequeue, randomly nack,
+    pause the rest's deadlines in bulk and submit_nowait — acks happen
+    on the drain LEADER thread (another worker entirely) inside the
+    round's finish hook.  After the drain: no eval lost, no eval held,
+    the coordinator queue empty."""
+    broker = EvalBroker(nack_delay_s=30.0, initial_nack_delay_s=0.001,
+                        delivery_limit=20, shards=4)
+    broker.set_enabled(True)
+    be = BlockedEvals(broker)
+    be.set_enabled(True)
+    adm = AdmissionController(max_pending=64, protect_priority=101,
+                              brownout_high=0.9, brownout_low=0.5,
+                              brownout_after_s=0.001,
+                              ns_rate=5000.0, ns_burst=500.0)
+    h = InvariantHarness(event_log=MeshEventLog())
+    stop = threading.Event()
+    acked = set()
+    acked_lock = threading.Lock()
+
+    def _dispatch(_server, _worker, batch):
+        return list(batch)
+
+    def _finish(_server, _worker, rnd):
+        broker.ack_batch([(ev.id, tok) for ev, tok in rnd])
+        with acked_lock:
+            for ev, _tok in rnd:
+                h.note_outcome(ev.id, "acked")
+                acked.add(ev.id)
+
+    coord = SolveCoordinator(None, max_fused=8,
+                             dispatch_fn=_dispatch, finish_fn=_finish)
+
+    def producer(k):
+        rng = random.Random(1000 + k)
+        for i in range(60):
+            ev = mock.eval_(job_id=f"job-{k}-{i}",
+                            priority=rng.choice([30, 50, 70]))
+            h.note_enqueued(ev.id)
+            if adm.offer(ev, broker.ready_count()):
+                broker.enqueue(ev)
+            else:
+                be.shed(ev)
+                h.note_outcome(ev.id, "shed")
+            if rng.random() < 0.2:
+                time.sleep(0.001)
+
+    def consumer(k):
+        rng = random.Random(2000 + k)
+        while not stop.is_set():
+            batch = broker.dequeue_batch(["service"], 4, 0.02, home=k)
+            keep = []
+            for ev, tok in batch:
+                if rng.random() < 0.2:
+                    broker.nack(ev.id, tok)
+                else:
+                    keep.append((ev, tok))
+            if keep:
+                broker.pause_nack_batch(
+                    [(ev.id, tok) for ev, tok in keep])
+                coord.submit_nowait(k, keep)
+
+    producers = [threading.Thread(target=producer, args=(k,))
+                 for k in range(4)]
+    consumers = [threading.Thread(target=consumer, args=(k,))
+                 for k in range(4)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join(timeout=30.0)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        for ev in be.pop_shed(1000):
+            broker.enqueue(ev)
+        st = broker.stats()
+        if (st["total_ready"] == 0 and st["total_unacked"] == 0
+                and st["total_waiting"] == 0 and be.shed_count() == 0
+                and coord.pending() == 0):
+            break
+        time.sleep(0.02)
+    stop.set()
+    for t in consumers:
+        t.join(timeout=10.0)
+    st = broker.stats()
+    assert st["total_ready"] == 0 and st["total_unacked"] == 0 \
+        and st["total_waiting"] == 0
+    assert coord.pending() == 0
+    assert h.check_eval_conservation(broker)
+    assert h.check_shed_accounting(admission=adm)
+    h.raise_if_violated()
+    assert len(acked) == 4 * 60

@@ -107,6 +107,46 @@ def test_controller_untrained_model_is_conservative():
     assert t == 32
 
 
+def test_sizing_model_fed_device_time_not_round_wall():
+    """ISSUE 19: under the pipelined coordinator a round's end-to-end
+    wall ~= its own device time PLUS the previous round's in-flight
+    device occupancy (the fetch waits out both).  The sizing model must
+    be fed the device stage (`note_device_solve`), not the round wall:
+    at 20ms device / 40ms pipelined wall against a 50ms*0.6 budget the
+    device feed keeps the full batch open while the wall feed would
+    close the rule early."""
+    tier = ServingTier(overrides={"slo_budget_s": 0.05, "max_batch": 64,
+                                  "margin": 0.6, "num_workers": 1})
+    for _ in range(8):
+        tier.note_device_solve(64, 0.020)   # device stage, fits budget
+    assert tier.solve_model.predict(64) == pytest.approx(0.020, rel=0.05)
+    assert tier.batch_controller.target_batch(
+        ready=1000, oldest_age_s=0.0) == 64
+    # counterfactual: the same round observed as end-to-end wall (2x —
+    # double-counting the previous round's device interval) blows the
+    # 30ms effective budget at 64 and over-drains to a smaller batch
+    wall_model = EwmaSolveModel()
+    for _ in range(8):
+        wall_model.observe(64, 0.040)
+    wall_ctl = BatchController(wall_model, slo_budget_s=0.05,
+                               max_batch=64, margin=0.6)
+    assert wall_ctl.target_batch(ready=1000, oldest_age_s=0.0) < 64
+
+
+def test_device_feed_leaves_slo_burn_on_wall():
+    """The split is asymmetric by design: `note_device_solve` narrows
+    only the SIZING model to the device stage; the SLO latency verdict
+    (`observe_batch`) still judges end-to-end wall — an eval's latency
+    includes every stage it waited through."""
+    tier = ServingTier(overrides={"slo_budget_s": 0.05, "num_workers": 1})
+    tier.note_device_solve(8, 0.010)
+    before = tier.solve_model.observations()
+    tier.observe_batch(8, 0.120)            # blown batch: wall verdict
+    # the blown wall did NOT contaminate the sizing model
+    assert tier.solve_model.observations() == before
+    assert tier.solve_model.predict(8) == pytest.approx(0.010)
+
+
 # ----------------------------------------------------------- token bucket
 def test_token_bucket_burst_and_refill():
     b = TokenBucket(rate=1000.0, burst=3.0)
