@@ -1886,6 +1886,20 @@ def run_open_loop(n_nodes=2048, count=4, max_batch=128, fixed_batch=8,
 #: serialized reference leg alongside, so both ratios stay honest.
 PR17_RECORDED_BEST = 3768.0
 
+#: PR 19's recorded BENCH_DETAIL.json scaleout best (2x2 pipelined
+#: rounds): throughput and the leader-serial `device` stage wall over
+#: the 2s measured window.  ISSUE 20's lane acceptance binds on the
+#: recorded device stage — the lane sweep's best leg must cut it by
+#: >= 30% (serial scan depth B -> B/L shows up exactly there).
+PR19_RECORDED_BEST = 24409.7
+PR19_RECORDED_DEVICE_S = 1.721
+#: the same leg normalized per eval: device stage seconds over the 2s
+#: window's completed count (24409.7/s x 2s) — the lane acceptance
+#: compares device time PER EVAL, which survives window-length and
+#: machine-speed drift where the raw stage wall does not
+PR19_RECORDED_DEVICE_US_PER_EVAL = round(
+    PR19_RECORDED_DEVICE_S / (PR19_RECORDED_BEST * 2.0) * 1e6, 2)
+
 class _ScaleoutHarness:
     """N worker threads on an S-shard broker feeding the single
     resident solver through the REAL SolveCoordinator: the production
@@ -1895,7 +1909,8 @@ class _ScaleoutHarness:
     coordinator -> fused-solve serving path itself."""
 
     def __init__(self, rs, template_ask, count, n_workers, n_shards,
-                 fuse, slo_s, max_batch, max_pending, pipelined=True):
+                 fuse, slo_s, max_batch, max_pending, pipelined=True,
+                 lane_spec=None):
         import threading
 
         from nomad_tpu.scheduler.fleet import SolveCoordinator
@@ -1920,6 +1935,34 @@ class _ScaleoutHarness:
             max_pending=max_pending, protect_priority=80,
             ns_rate=1e9, ns_burst=1e9, brownout_after_s=0.25)
         self.coordinator = None
+        #: lane mode (ISSUE 20): each pipelined round dispatches up to
+        #: `round_b` member batches as ONE chunked scan-of-vmap call
+        #: (`solve_stream_async(..., lanes=L)`), padding ragged rounds
+        #: with zero-placement batches so every leg compiles exactly
+        #: one (lanes, B) kernel variant.  lane_spec keys:
+        #:   lanes      fixed width L (ignored when controller set)
+        #:   controller LaneWidthController -> adaptive width per round
+        #:   families   N dc-pinned family jobs cycled over lane slots
+        #:              (conflict-aware ordering via form_lanes)
+        #:   round_b    member batches per lane call (default: lanes)
+        self.lane_spec = dict(lane_spec) if lane_spec else None
+        if self.lane_spec is not None:
+            self.lane_ctrl = self.lane_spec.get("controller")
+            self.lane_width = (self.lane_ctrl.width if self.lane_ctrl
+                               else max(1, int(self.lane_spec["lanes"])))
+            self.lane_round_b = int(
+                self.lane_spec.get("round_b", 0)) or max(
+                self.lane_width,
+                self.lane_ctrl.max_width if self.lane_ctrl else 0)
+            self.lane_families = int(self.lane_spec.get("families", 0))
+            self._fam_rot = 0
+            self._lane_pb = {}       # (slot_kind, n) -> PackedBatch
+            self._lane_pad = {}      # slot -> zero-placement pad batch
+            self.lane_rounds = 0
+            self.lane_calls = 0
+            self.lane_bounced = 0
+            self.lane_committed = 0
+            self.lane_width_hist = []
         #: pipelined legs: the coordinator finish phase owns ack +
         #: latency accounting (the drain leader releases submitters
         #: only after fetch); serialized legs ack in the worker loop
@@ -1928,11 +1971,23 @@ class _ScaleoutHarness:
         #: pr17 reference leg keeps PR 17's per-eval pause/ack calls so
         #: the A/B measures the whole serving-path delta
         self.batched_ops = bool(pipelined)
+        #: worker back-off bound: stop dequeueing once this many
+        #: submissions are queued behind the in-flight round.  Lane
+        #: rounds fuse `round_b` member batches, so the backlog must
+        #: hold a whole round's worth before dequeueing pauses —
+        #: backing off at 1 would starve lane rounds down to one lane
+        self._pending_bound = (self.lane_round_b
+                               if self.lane_spec is not None else 1)
         if fuse and n_workers > 1:
             if pipelined:
+                fused_cap = max_batch * (self.lane_round_b
+                                         if self.lane_spec is not None
+                                         else 1)
                 self.coordinator = SolveCoordinator(
-                    None, max_fused=max_batch,
-                    dispatch_fn=self._dispatch_round,
+                    None, max_fused=fused_cap,
+                    dispatch_fn=(self._dispatch_lane_round
+                                 if self.lane_spec is not None
+                                 else self._dispatch_round),
                     finish_fn=self._finish_round)
                 self._coord_acks = True
             else:
@@ -1958,6 +2013,10 @@ class _ScaleoutHarness:
         #: excludes it.
         self.stages = {k: 0.0 for k in
                        ("pack", "dispatch", "device", "fetch", "apply")}
+        #: host->device bytes each round's dispatch actually shipped
+        #: (ISSUE 20 satellite: the staging-buffer + stream-stack-cache
+        #: work should drive steady-state rounds to ~0)
+        self.bytes_shipped = 0
         self._prev_fetch_done = 0.0
         #: pipelined-path packed-batch memo by chunk size: the template
         #: asks carry no per-eval state, so every round's chunk packs to
@@ -1979,6 +2038,13 @@ class _ScaleoutHarness:
         self.device_waves = 0
         self.solve_calls = 0
         self.stages = {k: 0.0 for k in self.stages}
+        self.bytes_shipped = 0
+        if self.lane_spec is not None:
+            self.lane_rounds = 0
+            self.lane_calls = 0
+            self.lane_bounced = 0
+            self.lane_committed = 0
+            self.lane_width_hist = []
 
     def ingress(self, ev):
         self.offered += 1
@@ -2013,7 +2079,7 @@ class _ScaleoutHarness:
         hold_age_s = self.controller.slo_budget_s * 0.25
         while not self.stop.is_set():
             if self._coord_acks and self.coordinator is not None \
-                    and self.coordinator.pending() >= 1:
+                    and self.coordinator.pending() >= self._pending_bound:
                 # pending bound (fire-and-forget legs): with a whole
                 # round already queued behind the in-flight one the
                 # device cannot go idle before this worker's next pass,
@@ -2151,9 +2217,151 @@ class _ScaleoutHarness:
             rnd.handles.append(
                 self.rs.solve_stream_async([pb], seeds=[self._seq]))
             rnd.waves.append(getattr(self.rs, "last_waves", None))
+            self.bytes_shipped += getattr(self.rs,
+                                          "last_dispatch_bytes", 0) or 0
             t2 = time.perf_counter()
             self.stages["pack"] += t1 - t0
             self.stages["dispatch"] += t2 - t1
+        rnd.t_dispatched = time.perf_counter()
+        return rnd
+
+    # ----------------------- lane round (ISSUE 20) ----------------------
+    # One fused solve call carries up to round_b member batches through
+    # the chunked scan-of-vmap: serial depth B -> B/L.  Ragged rounds
+    # are padded with zero-placement batches so every leg runs exactly
+    # one compiled (lanes, B) kernel variant — a mid-window retrace
+    # would eat the whole measured window.
+
+    def _lane_member_pb(self, slot, n):
+        """Member batch for lane `slot` holding `n` fused evals.  Each
+        slot carries a distinct synthetic job identity (a job may
+        appear in at most one batch per stream); the family variant
+        additionally pins each slot's job to one datacenter, which is
+        the conflict footprint form_lanes separates on."""
+        if self.lane_families:
+            f = slot % self.lane_families
+            key = ("fam", f, n)
+            pb = self._lane_pb.get(key)
+            if pb is None:
+                job = make_job(2, 9000 + f, self.count)
+                job.id = f"lane-fam-{f}"
+                job.name = job.id
+                job.datacenters = [f"dc{f % 4}"]
+                masks, _keys = self.rs.merge_asks(
+                    [asks_for(job)[0]] * n)
+                pb = self.rs.pack_batch(masks, job_keys={("fam", f)})
+                self._lane_pb[key] = pb
+            return pb, (f"dc{f % 4}",)
+        key = ("lane", slot, n)
+        pb = self._lane_pb.get(key)
+        if pb is None:
+            masks, _keys = self.rs.merge_asks([self.template_ask] * n)
+            pb = self.rs.pack_batch(masks, job_keys={("lane", slot)})
+            self._lane_pb[key] = pb
+        # template members share every node as footprint; the former
+        # has nothing to separate, so footprint is the slot itself
+        return pb, (slot,)
+
+    def _lane_pad_pb(self, i, like):
+        """Zero-placement pad batch: same tensors (same compiled
+        shape), n_place=0 so the kernel commits nothing for it."""
+        pad = self._lane_pad.get(i)
+        if pad is None:
+            import copy as _copy
+            pad = _copy.copy(like)
+            pad.n_place = 0
+            pad.job_keys = {("pad", i)}
+            self._lane_pad[i] = pad
+        return pad
+
+    def _dispatch_serial_tail(self, rnd, n_evs):
+        """Serial B=1 dispatch for a lane round's ragged remainder:
+        any eval count's pow2 `group_count_hint` bucket is already
+        compiled by the startup warm loop, so the tail never retraces
+        — only FULL max_batch member batches ride the lane call (a
+        ragged member would shift the static hint and retrace
+        mid-window)."""
+        t0 = time.perf_counter()
+        pb = self._pb_cache.get(n_evs)
+        if pb is None:
+            masks, _keys = self.rs.merge_asks(
+                [self.template_ask] * n_evs)
+            pb = self.rs.pack_batch(masks)
+            self._pb_cache[n_evs] = pb
+        t1 = time.perf_counter()
+        self._seq += 1
+        rnd.handles.append(
+            self.rs.solve_stream_async([pb], seeds=[self._seq]))
+        rnd.waves.append(getattr(self.rs, "last_waves", None))
+        self.bytes_shipped += getattr(self.rs,
+                                      "last_dispatch_bytes", 0) or 0
+        t2 = time.perf_counter()
+        self.stages["pack"] += t1 - t0
+        self.stages["dispatch"] += t2 - t1
+
+    def _dispatch_lane_round(self, _server, _worker, batch):
+        from nomad_tpu.scheduler.fleet import form_lanes
+        rnd = _PipeRound(list(batch))
+        rnd.t_dispatch_start = time.perf_counter()
+        evs = rnd.batch
+        lanes = self.lane_width
+        n_full = len(evs) // self.max_batch
+        if lanes <= 1 or n_full < 2:
+            # too few full member batches for a chunk: serial rounds
+            # (also the adaptive controller's width-1 regime)
+            for lo in range(0, len(evs), self.max_batch):
+                self._dispatch_serial_tail(
+                    rnd, min(self.max_batch, len(evs) - lo))
+            self.lane_rounds += 1
+            rnd.t_dispatched = time.perf_counter()
+            return rnd
+        t0 = time.perf_counter()
+        # adaptive legs dispatch B=width calls (every pow2 width's
+        # (L, B=L) variant is warmed); fixed legs dispatch B=round_b
+        # (the families leg runs round_b=2*width -> a 2-chunk scan)
+        call_b = lanes if self.lane_ctrl is not None \
+            else self.lane_round_b
+        members = []
+        for slot in range(n_full):
+            pb, footprint = self._lane_member_pb(
+                (self._fam_rot + slot) if self.lane_families else slot,
+                self.max_batch)
+            members.append((pb, footprint))
+        if self.lane_families:
+            self._fam_rot = (self._fam_rot + len(members)) \
+                % self.lane_families
+            # conflict-aware chunk formation: order members so each
+            # consecutive `lanes`-block holds disjoint dc footprints
+            members = form_lanes(members, lanes,
+                                 key_fn=lambda m: m[1])
+        t1 = time.perf_counter()
+        self.stages["pack"] += t1 - t0
+        for lo in range(0, len(members), call_b):
+            group = [pb for pb, _fp in members[lo:lo + call_b]]
+            while len(group) < call_b:
+                group.append(self._lane_pad_pb(len(group), group[-1]))
+            td = time.perf_counter()
+            seeds = []
+            for _ in group:
+                self._seq += 1
+                seeds.append(self._seq)
+            rnd.handles.append(self.rs.solve_stream_async(
+                group, seeds=seeds, lanes=lanes))
+            rnd.waves.append(getattr(self.rs, "last_waves", None))
+            raw = getattr(self.rs, "last_lane_counters", None)
+            if raw is not None:
+                # device scalars captured AT dispatch (the attribute is
+                # per-call state; the next dispatch overwrites it) and
+                # fetched in the finish phase after the solve completes
+                rnd.lane_raw.append(raw)
+            self.bytes_shipped += getattr(self.rs,
+                                          "last_dispatch_bytes", 0) or 0
+            self.stages["dispatch"] += time.perf_counter() - td
+            self.lane_calls += 1
+        rem = len(evs) - n_full * self.max_batch
+        if rem:
+            self._dispatch_serial_tail(rnd, rem)
+        self.lane_rounds += 1
         rnd.t_dispatched = time.perf_counter()
         return rnd
 
@@ -2182,6 +2390,28 @@ class _ScaleoutHarness:
         # wall double-counts the neighbor round's in-flight solve (see
         # ServingTier.note_device_solve)
         self.model.observe(len(rnd.batch), device)
+        if self.lane_spec is not None and rnd.lane_raw:
+            b = c = 0
+            for raw in rnd.lane_raw:
+                # per-member device arrays; the sum syncs AFTER the
+                # round's fetch, so this is a host add, not a stall
+                b += int(_np.asarray(raw["bounced"]).sum())
+                c += int(_np.asarray(raw["committed"]).sum())
+            self.lane_bounced += b
+            self.lane_committed += c
+            if self.lane_ctrl is not None:
+                rate = b / max(b + c, 1)
+                # device_frac: is the device stage still dominant over
+                # the leader-serial breakdown?  (fetch overlaps device,
+                # excluded — same rule as largest_stage)
+                host = sum(v for k, v in self.stages.items()
+                           if k not in ("device", "fetch"))
+                frac = self.stages["device"] \
+                    / max(self.stages["device"] + host, 1e-9)
+                w = self.lane_ctrl.record(rate, frac)
+                if w != self.lane_width:
+                    self.lane_width = w
+                self.lane_width_hist.append(w)
         t1 = time.perf_counter()
         self.broker.ack_batch([(ev.id, tok) for ev, tok in rnd.batch])
         lats = []
@@ -2197,20 +2427,23 @@ class _ScaleoutHarness:
 
 class _PipeRound:
     """One dispatched-not-fetched fused round in the bench harness."""
-    __slots__ = ("batch", "handles", "waves", "t_dispatch_start",
-                 "t_dispatched")
+    __slots__ = ("batch", "handles", "waves", "lane_raw",
+                 "t_dispatch_start", "t_dispatched")
 
     def __init__(self, batch):
         self.batch = batch       # [(Evaluation, token)]
         self.handles = []        # device-side packed results
         self.waves = []          # per-chunk device wave counters
+        self.lane_raw = []       # per-call lane counters (device
+        #                          scalars; fetched in finish)
         self.t_dispatch_start = 0.0
         self.t_dispatched = 0.0
 
 
 def _run_scaleout_leg(rs, template_ask, count, n_workers, n_shards,
                       fuse, duration_s, slo_s, max_batch, max_pending,
-                      used0, warmup_s=0.4, pipelined=True):
+                      used0, warmup_s=0.4, pipelined=True,
+                      lane_spec=None):
     """Saturate one (workers, shards, fuse) config and return its
     record: the feeder offers as fast as admission allows, so the
     completed rate IS the config's capacity."""
@@ -2238,7 +2471,7 @@ def _run_scaleout_leg(rs, template_ask, count, n_workers, n_shards,
     sys.setswitchinterval(0.0005)
     h = _ScaleoutHarness(rs, template_ask, count, n_workers, n_shards,
                          fuse, slo_s, max_batch, max_pending,
-                         pipelined=pipelined)
+                         pipelined=pipelined, lane_spec=lane_spec)
     c0 = _gm.dump()["counters"]
     workers = [threading.Thread(target=h.worker_loop, args=(i,),
                                 daemon=True) for i in range(n_workers)]
@@ -2283,7 +2516,7 @@ def _run_scaleout_leg(rs, template_ask, count, n_workers, n_shards,
     comparable = {k: v for k, v in h.stages.items() if k != "fetch"}
     largest = (max(comparable, key=comparable.get)
                if any(comparable.values()) else None)
-    return {
+    rec = {
         "workers": n_workers, "shards": n_shards, "fused": bool(fuse),
         "pipelined": bool(pipelined and fuse and n_workers > 1),
         "completed": h.completed,
@@ -2300,7 +2533,33 @@ def _run_scaleout_leg(rs, template_ask, count, n_workers, n_shards,
             - c0.get("coordinator.cross_worker_rounds", 0)),
         "stages_s": stages,
         "largest_stage": largest,
+        "bytes_shipped": h.bytes_shipped,
     }
+    if lane_spec is not None:
+        b, c = h.lane_bounced, h.lane_committed
+        rec["lanes"] = ("auto" if h.lane_ctrl is not None
+                        else h.lane_width)
+        rec["lane_rounds"] = h.lane_rounds
+        rec["lane_calls"] = h.lane_calls
+        rec["revalidation"] = {
+            "bounced": b, "committed": c,
+            "bounce_rate": round(b / max(b + c, 1), 4),
+        }
+        if h.lane_families:
+            rec["lane_families"] = h.lane_families
+        if h.lane_ctrl is not None:
+            hist = h.lane_width_hist
+            rec["lane_width_final"] = h.lane_width
+            # compressed trajectory: width after each round, run-length
+            # encoded so a 2s window's hundreds of rounds stay readable
+            traj = []
+            for w in hist:
+                if traj and traj[-1][0] == w:
+                    traj[-1][1] += 1
+                else:
+                    traj.append([w, 1])
+            rec["lane_width_trajectory"] = traj
+    return rec
 
 
 def _run_group_commit_leg(group_commit, n_plans=300, n_nodes=64):
@@ -2432,6 +2691,20 @@ def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
         masks, _keys = rs.merge_asks(asks)
         rs.solve_stream([rs.pack_batch(masks)], seeds=[1])
         k <<= 1
+    # lane-variant warmup (ISSUE 20): lanes and B are trace shapes, so
+    # each (lanes, B) pair the sweep dispatches compiles exactly once,
+    # here — a mid-window retrace would eat the whole measured window.
+    # (4, 8) is the families leg's 2-chunk scan; family batches share
+    # the template's tensor shapes, so the template warms them too.
+    for lane_l, lane_b in ((2, 2), (4, 4), (8, 8), (4, 8)):
+        pbs = []
+        for s in range(lane_b):
+            masks, _keys = rs.merge_asks(
+                [dataclasses.replace(template_ask, count=count)]
+                * max_batch)
+            pbs.append(rs.pack_batch(masks, job_keys={("lane", s)}))
+        rs.finish_stream(rs.solve_stream_async(
+            pbs, seeds=list(range(1, lane_b + 1)), lanes=lane_l))
     rs.reset_usage(used0=used0)
     startup_s = time.perf_counter() - t0
 
@@ -2495,6 +2768,48 @@ def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
                 f"occ={rec['device_occupancy']} "
                 f"largest={rec['largest_stage']} "
                 f"xw_rounds={rec['cross_worker_rounds']}\n")
+
+        # ---- lane sweep (ISSUE 20): chunked scan-of-vmap rounds ----
+        # All lane legs run 2 workers x 2 shards (the recorded PR-19
+        # best config); the L=1 serial reference IS that config's plain
+        # pipelined leg from the sweep above.  Lane legs fuse L member
+        # batches per round, so the admission bound scales with L to
+        # keep a full round of backlog behind the in-flight one.
+        from nomad_tpu.scheduler.fleet import LaneWidthController
+        lane_ref = next((r for r in out["sweep"]
+                         if r["workers"] == 2 and r["shards"] == 2),
+                        None)
+        out["lane_serial_reference"] = lane_ref
+        out["lane_sweep"] = []
+
+        def _lane_leg(spec, label, round_b):
+            rec = _run_scaleout_leg(
+                rs, template_ask, count, 2, 2, True, duration_s,
+                slo_s, max_batch, max_batch * round_b * 2, used0,
+                lane_spec=spec)
+            rec["leg"] = label
+            out["lane_sweep"].append(rec)
+            rv = rec.get("revalidation", {})
+            sys.stderr.write(
+                f"scaleout lane {label}: {rec['evals_per_sec']}/s "
+                f"p99={rec['p99_ms']}ms "
+                f"device={rec['stages_s'].get('device')}s "
+                f"bounce={rv.get('bounce_rate')} "
+                f"bytes={rec['bytes_shipped']}\n")
+            return rec
+
+        for lane_l in (2, 4, 8):
+            _lane_leg({"lanes": lane_l}, f"L={lane_l}", lane_l)
+        # dc-pinned families: 8 jobs pinned round-robin over 4 dcs,
+        # form_lanes packs each 4-lane chunk from disjoint dcs (the
+        # conflict-aware formation the coordinator hook exists for)
+        _lane_leg({"lanes": 4, "families": 8, "round_b": 8},
+                  "L=4 families=8", 8)
+        # adaptive width, run LAST: every pow2 (L, B=L) variant is
+        # already compiled, so the controller can roam freely
+        _lane_leg({"controller": LaneWidthController(max_width=8,
+                                                     start=2)},
+                  "L=auto", 8)
     finally:
         _gt.sample, _gt._sample_cut = old_sample, old_cut
 
@@ -2509,12 +2824,31 @@ def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
         if prev is not None and \
                 rec["evals_per_sec"] < prev["evals_per_sec"] * 0.95:
             monotone = False
+            # name the culprit stage (ISSUE 20 satellite): the stage
+            # whose leader-serial wall grew most vs the previous
+            # config — `fetch` overlaps `device` and is excluded, same
+            # rule as largest_stage.  At 8x8 the historical culprit is
+            # `dispatch`+`pack` (GIL contention: more dequeue threads
+            # splitting the same single drain leader's slices), not
+            # the device — which is why the auto-cap, not a solver
+            # change, is the right fix.
+            ps = prev.get("stages_s", {})
+            cs = rec.get("stages_s", {})
+            deltas = {k: round(cs.get(k, 0.0) - ps.get(k, 0.0), 3)
+                      for k in cs if k != "fetch"}
+            culprit = (max(deltas, key=deltas.get)
+                       if deltas else None)
             auto_cap = {
                 "workers": prev["workers"], "shards": prev["shards"],
+                "culprit_stage": culprit,
+                "stage_deltas_s": deltas,
                 "reason": (f"{rec['workers']}x{rec['shards']} regressed "
                            f"to {rec['evals_per_sec']}/s from "
                            f"{prev['evals_per_sec']}/s at "
-                           f"{prev['workers']}x{prev['shards']}"),
+                           f"{prev['workers']}x{prev['shards']}"
+                           + (f"; culprit stage: {culprit} "
+                              f"(+{deltas[culprit]}s)"
+                              if culprit else "")),
             }
             break
         prev = rec
@@ -2525,7 +2859,7 @@ def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
     # raw-throughput winner is recorded, but `best` must hold p99
     # inside the latency budget — a config that wins evals/s by letting
     # the queue blow the SLO is not the config to run
-    candidates = [base] + out["sweep"]
+    candidates = [base] + out["sweep"] + out["lane_sweep"]
     best_raw = max(candidates, key=lambda r: r["evals_per_sec"])
     slo_ok = [r for r in candidates if r["p99_ms"] is not None
               and r["p99_ms"] <= slo_ms]
@@ -2569,6 +2903,37 @@ def run_scaleout(n_nodes=2048, count=4, max_batch=128, slo_ms=50.0,
         "backend": "cpu (recorded profile; the issue's 10x target "
                    "binds on accelerator backends)",
     }
+    # ---- ISSUE 20 lane acceptance: best lane leg inside the SLO ----
+    lane_slo = [r for r in out["lane_sweep"]
+                if r["p99_ms"] is not None and r["p99_ms"] <= slo_ms]
+    lane_best = (max(lane_slo, key=lambda r: r["evals_per_sec"])
+                 if lane_slo
+                 else max(out["lane_sweep"],
+                          key=lambda r: r["evals_per_sec"]))
+    out["lane_best"] = lane_best
+    lane_dev_us = (lane_best["stages_s"].get("device", 0.0)
+                   / max(lane_best["completed"], 1) * 1e6)
+    out["acceptance"]["lane_best_evals_per_sec"] = \
+        lane_best["evals_per_sec"]
+    out["acceptance"]["lane_ge_40k_evals_per_sec"] = \
+        bool(lane_slo) and lane_best["evals_per_sec"] >= 40_000
+    out["acceptance"]["lane_ge_50k_stretch"] = \
+        bool(lane_slo) and lane_best["evals_per_sec"] >= 50_000
+    out["acceptance"]["lane_p99_ms"] = lane_best["p99_ms"]
+    out["acceptance"]["lane_bounce_rate"] = \
+        lane_best.get("revalidation", {}).get("bounce_rate")
+    out["acceptance"]["pr19_recorded_device_us_per_eval"] = \
+        PR19_RECORDED_DEVICE_US_PER_EVAL
+    out["acceptance"]["lane_device_us_per_eval"] = \
+        round(lane_dev_us, 2)
+    out["acceptance"]["device_stage_reduced_30pct"] = \
+        lane_dev_us <= 0.7 * PR19_RECORDED_DEVICE_US_PER_EVAL
+    out["acceptance"]["lane_backend_note"] = (
+        "cpu recorded profile: vmapped lanes serialize on a "
+        "single-core host, so the 40k and -30% device targets bind on "
+        "accelerator backends where lanes are data-parallel; the "
+        "conflict-aware formation result (families leg bounce rate vs "
+        "unformed L=4) is backend-independent")
     out["ok"] = bool(rel > 1.0
                      and out["acceptance"]["group_commit_amortizes_fsync"])
     if write_detail:

@@ -713,10 +713,13 @@ def _check_donated_reads(index: PackageIndex, fi,
                         if key:
                             events.append((_canon(key), node.lineno))
         if isinstance(node, ast.Assign):
+            # the rebind takes effect where the VALUE is produced, not
+            # where the (possibly earlier-line) target list starts —
+            # `(used, dev, out) = kernel(used, dev, x)` spans lines
+            rl = getattr(node.value, "lineno", node.lineno)
             for t in node.targets:
-                key = _expr_key(t)
-                if key:
-                    rebinds.append((_canon(key), node.lineno))
+                for key in _target_keys(t):
+                    rebinds.append((_canon(key), rl))
         if isinstance(node, (ast.Name, ast.Subscript, ast.Attribute)) \
                 and isinstance(getattr(node, "ctx", None), ast.Load):
             key = _expr_key(node)
@@ -749,6 +752,26 @@ def _check_donated_reads(index: PackageIndex, fi,
                      "updated buffer) or drop donate_argnums"))
             break
     return findings
+
+
+def _target_keys(t) -> List[str]:
+    """Assign-target expression keys, recursing through tuple/list
+    (and starred) targets — the chunked scan-of-vmap carry rebind
+    shape: the lane kernel returns the donated usage carry as the
+    leading elements of a flat result tuple, so
+    `(self._used, self._dev_used, out, ...) = _lane_stream_kernel(...)`
+    rebinds BOTH donated buffers in one statement.  Before this, only
+    single-target assigns registered as rebinds and the idiomatic
+    carry-threading call site false-positived as a dead read."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        keys: List[str] = []
+        for e in t.elts:
+            keys.extend(_target_keys(e))
+        return keys
+    if isinstance(t, ast.Starred):
+        return _target_keys(t.value)
+    key = _expr_key(t)
+    return [key] if key else []
 
 
 def _expr_key(node) -> Optional[str]:

@@ -48,6 +48,116 @@ def record_stage_metrics(stages: Dict[str, float],
         _m.observe_hist(f"{prefix}.{name}_s", float(v))
 
 
+def form_lanes(members: List, width: int, key_fn) -> List:
+    """Conflict-aware chunk formation (ISSUE 20): order `members` so
+    that every consecutive `width`-block — one lane chunk of the
+    chunked scan-of-vmap — holds members with pairwise-disjoint
+    conflict footprints wherever the workload allows.
+
+    `key_fn(member)` returns the member's footprint: an iterable of
+    hashable atoms (candidate-shortlist node ids, (dc, zone) pins,
+    namespace keys — whatever the caller can compute cheaply).  Two
+    members conflict when their footprints intersect; conflicting
+    members sharing a chunk solve against the same stale usage
+    snapshot and bounce at the cross-lane revalidation, so the former
+    keeps them in DIFFERENT chunks — serialized through the scan
+    carry — and fills each chunk from one independent set.
+
+    Greedy first-fit coloring: each color class keeps the union of
+    its members' footprints, and a member joins the first class whose
+    union it does not touch (disjoint-from-union implies pairwise
+    disjoint).  Classes then emit whole chunks; ragged tails are
+    re-packed across classes with the same disjointness check, so
+    conflicting tails serialize instead of sharing a chunk.  Pure
+    reorder: the result is a permutation of `members`, never a
+    drop — a bounced lane is a retry, a dropped member is a lost
+    eval."""
+    if width <= 1 or len(members) <= width:
+        return list(members)
+    classes: List[List] = []          # [union_footprint, [members]]
+    keys: Dict[int, frozenset] = {}
+    for m in members:
+        ks = frozenset(key_fn(m))
+        keys[id(m)] = ks
+        for cl in classes:
+            if not (cl[0] & ks):
+                cl[0] |= ks
+                cl[1].append(m)
+                break
+        else:
+            classes.append([set(ks), [m]])
+    out: List = []
+    tails: List = []
+    for _uni, group in classes:
+        n_full = (len(group) // width) * width
+        out.extend(group[:n_full])
+        tails.extend(group[n_full:])
+    while tails:
+        chunk: List = []
+        uni: set = set()
+        rest: List = []
+        for m in tails:
+            ks = keys[id(m)]
+            if len(chunk) < width and not (uni & ks):
+                chunk.append(m)
+                uni |= ks
+            else:
+                rest.append(m)
+        out.extend(chunk)
+        tails = rest
+    return out
+
+
+class LaneWidthController:
+    """Adaptive lane width for the chunked scan-of-vmap (ISSUE 20):
+    pow2 widths in [1, max_width], one step per observation.
+
+    Fed by the two signals the issue names: the measured cross-lane
+    bounce rate (ResidentSolver.lane_counters) and the PR-19 stage
+    accounting (is `device` still the dominant stage?).  Widen when
+    lanes are winning — bounce below `widen_below` AND the device
+    stage dominant, so more in-kernel parallelism attacks the actual
+    bottleneck; narrow when revalidation bounces above `narrow_above`
+    — a bounced lane re-solves through the retry path, so a high
+    bounce rate makes wide chunks slower than the serial depth they
+    save.  `patience` consecutive agreeing rounds are required per
+    step (hysteresis: one conflicted round must not collapse L), and
+    any disagreeing round resets the streak."""
+
+    def __init__(self, max_width: int = 8, start: int = 2,
+                 widen_below: float = 0.05, narrow_above: float = 0.25,
+                 patience: int = 2):
+        self.max_width = max(1, int(max_width))
+        self.width = min(max(1, int(start)), self.max_width)
+        self.widen_below = float(widen_below)
+        self.narrow_above = float(narrow_above)
+        self.patience = max(1, int(patience))
+        self._streak = 0          # +n widen votes, -n narrow votes
+        #: observation log (bounce_rate, device_frac, width) — the
+        #: bench's lane leg reports the trajectory
+        self.history: List[Tuple[float, float, int]] = []
+
+    def record(self, bounce_rate: float,
+               device_frac: float = 1.0) -> int:
+        """Feed one round's signals; returns the (possibly stepped)
+        width to use for the next round."""
+        self.history.append((float(bounce_rate), float(device_frac),
+                             self.width))
+        if bounce_rate > self.narrow_above:
+            self._streak = min(self._streak, 0) - 1
+        elif bounce_rate < self.widen_below and device_frac >= 0.5:
+            self._streak = max(self._streak, 0) + 1
+        else:
+            self._streak = 0
+        if self._streak >= self.patience and self.width < self.max_width:
+            self.width <<= 1
+            self._streak = 0
+        elif self._streak <= -self.patience and self.width > 1:
+            self.width >>= 1
+            self._streak = 0
+        return self.width
+
+
 class _Entry:
     def __init__(self, ev: Evaluation, token: str,
                  sched: GenericScheduler):
@@ -355,9 +465,22 @@ class SolveCoordinator:
 
     def __init__(self, server, max_fused: int = DEFAULT_MAX_FUSED,
                  solve_fn=None, pipeline: bool = True,
-                 dispatch_fn=None, finish_fn=None):
+                 dispatch_fn=None, finish_fn=None,
+                 lane_former=None, lane_controller=None):
         self.server = server
         self.max_fused = max(1, int(max_fused))
+        #: conflict-aware chunk formation (ISSUE 20): when set, the
+        #: drain leader reorders each round's combined member list via
+        #: `lane_former(members, width)` before dispatch, so the lane
+        #: kernel's consecutive L-blocks hold non-conflicting members
+        #: (`form_lanes` partially applied over a footprint key_fn is
+        #: the standard former).  `lane_controller` supplies the width
+        #: and is fed by the round's finish path (the bench's lane leg
+        #: and the sharded drain both read the solver's lane counters
+        #: there — the coordinator itself never blocks on a fetch to
+        #: learn the bounce rate).
+        self.lane_former = lane_former
+        self.lane_controller = lane_controller
         #: (server, worker, combined_batch) -> None; serialized custom
         #: path (bench A/B legs, tests) — disables pipelining
         self.solve_fn = solve_fn
@@ -487,6 +610,10 @@ class SolveCoordinator:
             rnd = None
             if round_subs:
                 combined = [pair for s in round_subs for pair in s.batch]
+                if self.lane_former is not None:
+                    w = (self.lane_controller.width
+                         if self.lane_controller is not None else 0)
+                    combined = self.lane_former(combined, w)
                 _m.add_sample("coordinator.fused_evals",
                               float(len(combined)))
                 if len(round_subs) > 1:

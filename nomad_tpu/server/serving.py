@@ -384,6 +384,16 @@ class ServingTier:
         # homing — else the reference's 3/4)
         "worker_pause_fraction": ("NOMAD_TPU_WORKER_PAUSE_FRACTION",
                                   float, -1.0),
+        # lane-parallel fused solve (ISSUE 20): starting lane width of
+        # the chunked scan-of-vmap (1 = the serial scan, bit-for-bit),
+        # the adaptive controller's pow2 ceiling, and its widen/narrow
+        # bounce-rate thresholds (fractions of lane placements bounced
+        # to STATUS_RETRY by the cross-lane revalidation)
+        "fused_lanes": ("NOMAD_TPU_FUSED_LANES", int, 1),
+        "max_lanes": ("NOMAD_TPU_MAX_LANES", int, 8),
+        "lane_widen_below": ("NOMAD_TPU_LANE_WIDEN_BELOW", float, 0.05),
+        "lane_narrow_above": ("NOMAD_TPU_LANE_NARROW_ABOVE", float,
+                              0.25),
     }
 
     def __init__(self, adaptive: bool = True,
@@ -408,6 +418,10 @@ class ServingTier:
         self.coordinator = bool(k["coordinator"])
         self.pipeline = bool(k["pipeline"])
         self.worker_pause_fraction = k["worker_pause_fraction"]
+        self.fused_lanes = max(1, k["fused_lanes"])
+        self.max_lanes = max(1, k["max_lanes"])
+        self.lane_widen_below = k["lane_widen_below"]
+        self.lane_narrow_above = k["lane_narrow_above"]
         self.solve_model = EwmaSolveModel()
         self.batch_controller = BatchController(
             self.solve_model, slo_budget_s=k["slo_budget_s"],
@@ -465,6 +479,8 @@ class ServingTier:
             "group_commit": self.group_commit,
             "coordinator": self.coordinator,
             "pipeline": self.pipeline,
+            "fused_lanes": self.fused_lanes,
+            "max_lanes": self.max_lanes,
             "last_target_batch": self.batch_controller.last_target(),
             "model_observations": self.solve_model.observations(),
             "admission": self.admission.stats(),

@@ -265,7 +265,7 @@ def resolve_shortlist_c(Np: int, TK: int, requested: int = 0) -> int:
                                     "shortlist_c", "mesh_axis",
                                     "mesh_shards", "has_preempt",
                                     "mesh_hosts", "mesh_nt", "tile_np",
-                                    "mesh_regions"))
+                                    "mesh_regions", "lane_axis"))
 def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_res, ask_desired, distinct, dc_ok, host_ok, coll0,
                  penalty,
@@ -282,7 +282,7 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                  ask_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
                  node_gid=None, owner_map=None, slot_map=None,
                  learned=None, mesh_regions=0,
-                 region_bias=None) -> SolveResult:
+                 region_bias=None, lane_axis=None) -> SolveResult:
     # has_distinct / has_devices: trace-time guarantees from the packer
     # that NO ask in this batch uses distinct_hosts / requests devices —
     # the per-wave conflict sort, blocking scatter, and device-fit
@@ -1168,9 +1168,24 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                 return (SL.win_s, SL.win_i, SL.tb_s, SL.tb_i, SL.nfeas,
                         SL.nexh, SL.ndim, SL.gany, SL, jnp.int32(0))
 
+            if lane_axis is not None:
+                # lane-uniform predicate (ISSUE 20): a psum over the
+                # lane vmap axis is UNBATCHED, so this cond stays a
+                # real branch under `jax.vmap(..., axis_name=lane_axis)`
+                # — a per-lane (batched) predicate would lower to
+                # select and run the full [Gp, Np] pass every wave for
+                # every lane, the PR 4 "pure overhead" that forced
+                # shortlists off on vmapped lanes.  Any lane losing its
+                # carried window sends ALL lanes through the full pass:
+                # conservative (extra rescores, counted in n_resc) and
+                # always exact, since the full pass is the escape hatch.
+                take_carried = lax.psum(
+                    jnp.int32(~SL.ok), lane_axis) == jnp.int32(0)
+            else:
+                take_carried = SL.ok
             (top_score, top_idx, tab_s, tab_i, n_feas_g, n_exh_g,
              dim_exh_g, grp_any, SL, resc) = lax.cond(
-                 SL.ok, carried_wave, full_wave, SL)
+                 take_carried, carried_wave, full_wave, SL)
         else:
             (top_score, top_idx, tab_s, tab_i, n_feas_g, n_exh_g,
              dim_exh_g, grp_any, SL, resc) = full_wave(SL)
@@ -1811,8 +1826,19 @@ def solve_kernel(avail, reserved, used0, valid, node_dc, attr_rank,
                         sl.nexh, sl.ndim, jnp.zeros(Gp, bool),
                         jnp.bool_(False))
 
+            if lane_axis is not None:
+                # same lane-uniform trick as the carried/full dispatch:
+                # rerank when ANY lane wants it (the result is gated
+                # per-lane by `pre_ok & sl_ok` below — a lane that
+                # reranked on a void premise keeps ok=False and its
+                # next wave runs the full pass, which rebuilds the
+                # window from scratch before anything reads it)
+                do_rerank = lax.psum(
+                    jnp.int32(pre_ok), lane_axis) > jnp.int32(0)
+            else:
+                do_rerank = pre_ok
             (nw_s, nw_i, ntb_s, ntb_i, n_nexh, n_ndim, n_gany,
-             sl_ok) = lax.cond(pre_ok, rerank, skip, SL)
+             sl_ok) = lax.cond(do_rerank, rerank, skip, SL)
             SL = SL._replace(win_s=nw_s, win_i=nw_i, tb_s=ntb_s,
                              tb_i=ntb_i, nfeas=n_feas_g,
                              nexh=n_nexh, ndim=n_ndim, gany=n_gany,

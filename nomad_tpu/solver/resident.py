@@ -83,6 +83,25 @@ def _env_shortlist_c() -> int:
             "or an integer shortlist width") from None
 
 
+def _env_fused_lanes() -> int:
+    """NOMAD_TPU_FUSED_LANES: unset/'1'/'serial' -> 1 (the serial
+    scan — the bit-identical legacy fused path); an integer > 1 opts
+    solve_stream into the lane-parallel chunked scan-of-vmap
+    (ISSUE 20).  Callers that widen per round (the adaptive lane-width
+    controller, fleet.LaneWidthController) pass `lanes=` per call
+    instead."""
+    import os
+    raw = os.environ.get("NOMAD_TPU_FUSED_LANES", "").strip().lower()
+    if raw in ("", "1", "serial"):
+        return 1
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        raise ValueError(
+            f"NOMAD_TPU_FUSED_LANES={raw!r} invalid: pass an integer "
+            "lane width (1 = serial scan)") from None
+
+
 def pack_out_compact(choice, score, status):
     """Device-side result compaction: node indices as int16, scores
     bitcast through bfloat16, status as int16 — [..., 2*TOP_K+1] int16,
@@ -201,7 +220,7 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
                mesh_shards=0, has_preempt=False, ev_res=None,
                ev_prio=None, mesh_hosts=0, mesh_nt=0, tile_np=0,
                node_gid=None, owner_map=None, slot_map=None,
-               mesh_regions=0):
+               mesh_regions=0, lane_axis=None):
     # host_ok / penalty may arrive BITPACKED from _stack_args (uint32
     # lanes, 1/8th the transport bytes of the dense bool planes);
     # unpack on device — dtype is static, so either form compiles once
@@ -238,7 +257,7 @@ def _solve_one(avail, reserved, valid, node_dc, attr_rank, dev_cap,
         mesh_shards=mesh_shards, mesh_hosts=mesh_hosts,
         mesh_nt=mesh_nt, tile_np=tile_np, node_gid=node_gid,
         owner_map=owner_map, slot_map=slot_map,
-        mesh_regions=mesh_regions, **ev_kw)
+        mesh_regions=mesh_regions, lane_axis=lane_axis, **ev_kw)
 
 
 @functools.partial(jax.jit,
@@ -371,6 +390,131 @@ def _stream_kernel(avail, reserved, valid, node_dc, attr_rank, dev_cap,
     return used_f, dev_used_f, out, evict, waves, rescores
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("lanes", "has_spread",
+                                    "group_count_hint", "max_waves",
+                                    "wave_mode", "has_distinct",
+                                    "has_devices", "stack_commit",
+                                    "compact", "pallas_mode",
+                                    "shortlist_c"))
+def _lane_stream_kernel(avail, reserved, valid, node_dc, attr_rank,
+                        dev_cap, used0, dev_used0, stacked, n_places,
+                        seeds, lanes=2, has_spread=True,
+                        group_count_hint=0, max_waves=0,
+                        wave_mode="while", has_distinct=True,
+                        has_devices=True, stack_commit=False,
+                        compact=True, pallas_mode="off", shortlist_c=0):
+    """Chunked scan-of-vmap fused stream (ISSUE 20): the serial scan of
+    `_stream_kernel` but L batches per scan step, each step `vmap`ing
+    the solve over its L lanes against the CARRIED usage snapshot and
+    then revalidating all L lanes' slot-0 commits in one in-kernel pass
+    — `_parallel_kernel.apply_batch`'s cumulative same-node credit
+    generalized from within-batch to cross-lane placement order (lane-
+    major: lane l's placement k revalidates at rank l*K + k).  Serial
+    depth drops from B to B/L; placements a sibling lane beat to a node
+    bounce to STATUS_RETRY with every score slot nulled — exactly the
+    `_parallel_kernel` contract, so the retry stream clears them.
+
+    Unlike `_parallel_kernel`, the lanes keep the caller's shortlist:
+    `lane_axis` makes the carried/full wave cond lane-UNIFORM (a psum
+    over the vmap axis is unbatched, so the cond stays a real branch —
+    see kernel.py), fixing the PR 4 cond→select overhead that forced
+    `shortlist_c=-1` and the pinned full-rescore on vmapped lanes.
+
+    B must be a multiple of `lanes` (the host pads with n_place=0 rows).
+    Preemption streams stay on the serial kernel: cross-lane
+    revalidation of EVICTION credits (usage that goes DOWN) has no
+    one-round conservative form.  Returns (used, dev_used, out [B,...],
+    waves [B], rescores [B], bounced [B], committed [B])."""
+    L = lanes
+    B = n_places.shape[0]
+    n_chunks = B // L
+    st_c = jax.tree_util.tree_map(
+        lambda v: v.reshape((n_chunks, L) + v.shape[1:]), dict(stacked))
+    np_c = n_places.reshape(n_chunks, L)
+    seed_c = seeds.reshape(n_chunks, L)
+    K = stacked["p_ask"].shape[1]
+    ks = jnp.arange(K)
+    lk = jnp.arange(L * K)
+
+    def chunk_step(carry, xs):
+        used, dev_used = carry
+        batch, n_place, seed = xs
+        res = jax.vmap(
+            lambda b, n, s: _solve_one(
+                avail, reserved, valid, node_dc, attr_rank, dev_cap,
+                used, dev_used, b, n, s, has_spread, group_count_hint,
+                max_waves, wave_mode, has_distinct, has_devices,
+                stack_commit, pallas_mode, shortlist_c,
+                lane_axis="lanes"),
+            axis_name="lanes")(batch, n_place, seed)
+        # ---- cross-lane revalidation (the serial plan applier) ----
+        # Flatten lane-major and replay apply_batch's arithmetic over
+        # the whole chunk: cumulative same-node credit in (lane,
+        # placement) order, conservative one-round semantics (a bounced
+        # placement's load still counts toward later same-node rows, so
+        # bounces can cascade — every one is STATUS_RETRY, never lost).
+        # Intra-lane placements re-earn their own solve's commits: the
+        # lane charged them against the same snapshot in the same
+        # order, so their cumulative fit re-checks true.
+        res_l = jnp.take_along_axis(
+            batch["ask_res"],
+            batch["p_ask"][:, :, None].astype(jnp.int32), axis=1)
+        dev_l = jnp.take_along_axis(
+            batch["dev_ask"],
+            batch["p_ask"][:, :, None].astype(jnp.int32), axis=1)
+        res_k = res_l.reshape(L * K, -1)
+        dev_k = dev_l.reshape(L * K, -1)
+        choice = res.choice.reshape(L * K, TOP_K)
+        score = res.score.reshape(L * K, TOP_K)
+        unfin = res.unfinished.reshape(L * K)
+        okf = (res.choice_ok[:, :, 0]
+               & (ks[None, :] < n_place[:, None])).reshape(L * K)
+        cand = choice[:, 0]
+        earlier = lk[None, :] < lk[:, None]
+        same = ((cand[None, :] == cand[:, None]) & okf[None, :]
+                & okf[:, None] & earlier)
+        prior = same.astype(jnp.float32) @ (res_k * okf[:, None])
+        prior_dev = same.astype(jnp.float32) @ (dev_k * okf[:, None])
+        fits = ((used[cand] + prior + res_k) <= avail[cand]).all(-1)
+        dev_fits = ((dev_used[cand] + prior_dev + dev_k)
+                    <= dev_cap[cand]).all(-1)
+        commit = okf & fits & dev_fits
+        cm = commit[:, None]
+        used = used.at[cand].add(res_k * cm)
+        dev_used = dev_used.at[cand].add(dev_k * cm)
+        # bounced placements lose ALL slots (their fall-through scores
+        # were solved against a stale snapshot and were never charged)
+        score = jnp.where(cm, score, NEG_INF)
+        status = jnp.where(commit, STATUS_COMMITTED,
+                           jnp.where(okf | unfin, STATUS_RETRY,
+                                     STATUS_FAILED))
+        score_l = score.reshape(L, K, TOP_K)
+        status_l = status.reshape(L, K)
+        if compact:
+            packed = jax.vmap(pack_out_compact)(res.choice, score_l,
+                                                status_l)
+        else:
+            packed = jnp.concatenate(
+                [res.choice.astype(jnp.float32), score_l,
+                 status_l.astype(jnp.float32)[..., None]], axis=-1)
+        bounced = (okf & ~commit).reshape(L, K).sum(axis=1)
+        committed = commit.reshape(L, K).astype(jnp.int32).sum(axis=1)
+        return ((used, dev_used),
+                (packed, res.n_waves, res.n_rescore,
+                 bounced.astype(jnp.int32), committed))
+
+    (used_f, dev_used_f), (out, waves, rescores, bounced, committed) = \
+        jax.lax.scan(chunk_step, (used0, dev_used0),
+                     (st_c, np_c, seed_c))
+
+    def _flat(a):
+        return a.reshape((B,) + a.shape[2:])
+
+    return (used_f, dev_used_f, _flat(out), _flat(waves),
+            _flat(rescores), _flat(bounced), _flat(committed))
+
+
 class ResidentSolver:
     """Streaming placement engine for one node snapshot.
 
@@ -390,7 +534,8 @@ class ResidentSolver:
                  stack_commit: bool = False, pallas: str = "auto",
                  delta_threshold: Optional[float] = None,
                  shortlist_c: Optional[int] = None,
-                 evict_e: int = 0):
+                 evict_e: int = 0,
+                 fused_lanes: Optional[int] = None):
         import os
         self.nodes = list(nodes)
         #: in-kernel preemption (ISSUE 7): > 0 packs top-E evictable-
@@ -419,6 +564,18 @@ class ResidentSolver:
         self.shortlist_c = (
             shortlist_c if shortlist_c is not None
             else _env_shortlist_c())
+        #: default lane width for solve_stream (ISSUE 20): 1 = the
+        #: serial scan, bit-identical legacy behavior; L > 1 solves L
+        #: batches per scan step (chunked scan-of-vmap) and revalidates
+        #: their commits cross-lane, bouncing losers to STATUS_RETRY.
+        #: NOMAD_TPU_FUSED_LANES overrides when the ctor arg is None;
+        #: solve_stream_async(lanes=) overrides per call.
+        self.fused_lanes = (int(fused_lanes) if fused_lanes is not None
+                            else _env_fused_lanes())
+        #: device-side revalidation counters of the last LANE-parallel
+        #: stream (None after a serial stream) — fetch via
+        #: lane_counters(), which the adaptive width controller feeds on
+        self.last_lane_counters = None
         #: per-batch wave counts of the LAST dispatched stream (device
         #: array; fetch syncs — instrumentation consumers only)
         self.last_waves = None
@@ -438,7 +595,17 @@ class ResidentSolver:
             "delta_applies": 0, "repack_fallbacks": 0,
             "last_delta_ratio": 0.0,
             "bytes_dispatched_delta": 0, "bytes_dispatched_full": 0,
+            # cumulative ask-plane bytes the stream dispatches shipped
+            # (ISSUE 20 satellite; per-round in last_dispatch_bytes)
+            "bytes_dispatched_ask": 0, "ask_dispatches": 0,
         }
+        #: pow2-bucketed staging buffers for the B>1 stacked ask planes
+        #: (ISSUE 20 satellite — see _staged_stack)
+        self._stage_cache: Dict = {}
+        #: B>1 repeated-stream device cache (ISSUE 20 satellite): the
+        #: stacked+device-put ask dict keyed on the identity tuple of
+        #: the stream's batches — see _stack_args
+        self._stream_stack_cache: Dict = {}
         #: bumps on every node-shape change; device-side stacked-batch
         #: caches are keyed on it so a stale ask plane is never reused
         self._node_epoch = 0
@@ -840,20 +1007,34 @@ class ResidentSolver:
         return out
 
     def solve_stream_async(self, batches: Sequence[PackedBatch],
-                           seeds: Optional[Sequence[int]] = None):
+                           seeds: Optional[Sequence[int]] = None,
+                           lanes: Optional[int] = None):
         """Dispatch a stream WITHOUT fetching: returns the device-side
         packed result (pass to finish_stream to unpack).  Lets callers
         pipeline independent streams (e.g. one per region/solver) so
         their transport round trips overlap — JAX dispatch is async, and
-        the carried usage updates device-side immediately."""
+        the carried usage updates device-side immediately.
+
+        `lanes` overrides the solver's `fused_lanes` width for this
+        call: > 1 routes multi-batch streams to the lane-parallel
+        chunked scan-of-vmap (ISSUE 20) — L batches solve per scan
+        step against the carried snapshot and revalidate cross-lane,
+        bouncing conflicts to STATUS_RETRY.  1 (the default) is the
+        serial scan, bit-identical to every earlier release.
+        Preemption streams always stay serial (the eviction pass has
+        no cross-lane revalidation form)."""
         self._check_stream_jobs(batches)
         self._check_batch_axis(batches)
+        has_distinct = self._has_distinct(batches)
+        preempt = self._preempt_on(has_distinct)
+        L = int(self.fused_lanes if lanes is None else lanes)
+        if L > 1 and len(batches) > 1 and not preempt:
+            return self._solve_lanes(batches, seeds, L, has_distinct)
+        self.last_lane_counters = None
         stacked = self._stack_args(batches)
         n_places = np.asarray([pb.n_place for pb in batches], np.int32)
         seed_arr = (np.zeros(len(batches), np.int32) if seeds is None
                     else np.asarray(list(seeds), np.int32))
-        has_distinct = self._has_distinct(batches)
-        preempt = self._preempt_on(has_distinct)
         (self._used, self._dev_used, out, self.last_evict,
          self.last_waves, self.last_rescore_waves) = _stream_kernel(
             self._dev_node["avail"], self._dev_node["reserved"],
@@ -871,6 +1052,65 @@ class ResidentSolver:
             pallas_mode=self.pallas, shortlist_c=self.shortlist_c,
             has_preempt=preempt)
         return out
+
+    def _solve_lanes(self, batches: Sequence[PackedBatch], seeds,
+                     L: int, has_distinct: bool):
+        """Lane-parallel stream dispatch (ISSUE 20): pad B up to a
+        multiple of L with zero-place rows (repeating the last batch's
+        planes — nothing solves, nothing commits, the padding never
+        leaves the device) and run the chunked scan-of-vmap kernel.
+        Revalidation counters stay device-side until lane_counters()."""
+        B = len(batches)
+        pad = (-B) % L
+        pbs = list(batches) + [batches[-1]] * pad
+        stacked = self._stack_args(pbs)
+        n_places = np.asarray(
+            [pb.n_place for pb in batches] + [0] * pad, np.int32)
+        seed_list = ([0] * B if seeds is None else list(seeds))
+        seed_arr = np.asarray(seed_list + [0] * pad, np.int32)
+        (self._used, self._dev_used, out, waves, rescores, bounced,
+         committed) = _lane_stream_kernel(
+            self._dev_node["avail"], self._dev_node["reserved"],
+            self._dev_node["valid"], self._dev_node["node_dc"],
+            self._dev_node["attr_rank"], self._dev_node["dev_cap"],
+            self._used, self._dev_used, stacked, n_places, seed_arr,
+            lanes=L, has_spread=self._has_spread(batches),
+            group_count_hint=self._group_count_hint(batches),
+            max_waves=self.max_waves,
+            # "while" drains when EVERY lane converges; the scan
+            # shape's per-wave skip cond is per-lane (batched) and
+            # would pay the whole wave budget under the vmap
+            wave_mode="while",
+            has_distinct=has_distinct,
+            has_devices=self._has_devices(batches),
+            stack_commit=self.stack_commit, compact=self._compact,
+            pallas_mode=self.pallas, shortlist_c=self.shortlist_c)
+        if pad:
+            out, waves, rescores = out[:B], waves[:B], rescores[:B]
+            bounced, committed = bounced[:B], committed[:B]
+        self.last_evict = None
+        self.last_waves = waves
+        self.last_rescore_waves = rescores
+        self.last_lane_counters = {
+            "lanes": L, "chunks": (B + pad) // L,
+            "bounced": bounced, "committed": committed}
+        return out
+
+    def lane_counters(self) -> Optional[Dict]:
+        """Fetch (one sync) the last lane-parallel stream's
+        revalidation counters: bounced/committed placement totals and
+        the bounce rate the adaptive lane-width controller feeds on
+        (fleet.LaneWidthController.note_round).  None after a serial
+        stream."""
+        lc = self.last_lane_counters
+        if lc is None:
+            return None
+        bounced = int(np.asarray(lc["bounced"]).sum())
+        committed = int(np.asarray(lc["committed"]).sum())
+        total = bounced + committed
+        return {"lanes": int(lc["lanes"]), "chunks": int(lc["chunks"]),
+                "bounced": bounced, "committed": committed,
+                "bounce_rate": (bounced / total) if total else 0.0}
 
     def _preempt_on(self, has_distinct: bool) -> bool:
         """Eviction waves run only when the planes are resident and
@@ -1142,6 +1382,18 @@ class ResidentSolver:
             if cached is not None and cached[0] == self._node_epoch:
                 self.last_dispatch_bytes = 0
                 return cached[1]
+        else:
+            # B>1 twin of the single-batch step cache (ISSUE 20
+            # satellite): a steady-state stream re-dispatching the SAME
+            # batch objects — the lane sweep's per-family packed memo,
+            # the retry drain — ships zero ask bytes.  Keyed on batch
+            # identity; the entry holds strong refs to the batches so
+            # the ids cannot be recycled while cached.
+            skey = tuple(id(pb) for pb in batches)
+            cached = self._stream_stack_cache.get(skey)
+            if cached is not None and cached[0] == self._node_epoch:
+                self.last_dispatch_bytes = 0
+                return cached[2]
         stacked = {}
         shipped = 0
         t = self.template
@@ -1176,7 +1428,8 @@ class ResidentSolver:
                             (B,) + self._default_host_ok.shape).copy())
                 stacked[name] = self._const_cache[key]
                 continue
-            arr = np.stack(mats)
+            arr = (self._staged_stack(name, mats) if B > 1
+                   else np.stack(mats))
             if name in ("host_ok", "penalty") and self._pack_bool_planes:
                 # ship the bool planes bitpacked (uint32 lanes, 8x
                 # fewer transport bytes); _solve_one unpacks on device
@@ -1185,12 +1438,26 @@ class ResidentSolver:
             shipped += arr.nbytes
             stacked[name] = arr
         self.last_dispatch_bytes = shipped
+        self.delta_counters["bytes_dispatched_ask"] += shipped
+        self.delta_counters["ask_dispatches"] += 1
         if B == 1:
             dev = {k: (self._put_ask(k, v) if isinstance(v, np.ndarray)
                        else v) for k, v in stacked.items()}
             batches[0].__dict__["_dev_stacked"] = (self._node_epoch, dev)
             return dev
-        return stacked
+        # device-put through a COPY: the staged planes are views into
+        # the rotating staging ring, and CPU device_put may alias that
+        # memory zero-copy — a later round refilling the ring would
+        # corrupt the cached device arrays through the alias
+        dev = {k: (self._put_ask(k, np.array(v))
+                   if isinstance(v, np.ndarray) else v)
+               for k, v in stacked.items()}
+        if len(self._stream_stack_cache) >= 4:
+            self._stream_stack_cache.pop(
+                next(iter(self._stream_stack_cache)))
+        self._stream_stack_cache[skey] = (self._node_epoch,
+                                          tuple(batches), dev)
+        return dev
 
     def _check_batch_axis(self, batches: Sequence[PackedBatch]) -> None:
         """A full repack can change the padded node axis; batches packed
@@ -1249,6 +1516,32 @@ class ResidentSolver:
         # default) — a cond would run every budget wave for every lane
         return self._unpack(out)
 
+    def _staged_stack(self, name: str, mats) -> np.ndarray:
+        """Pow2-bucketed preallocated staging for the fused path's
+        B>1 stacked ask planes (ISSUE 20 satellite): `np.stack`
+        allocates a fresh [B, ...] block per arg per round, which at
+        128-member rounds is the dispatch stage's single biggest host
+        cost — these buffers are keyed (arg, pow2(B), row shape) and
+        reused round over round, copying rows in place.  TWO buffers
+        rotate per key: CPU `device_put` may alias the host memory
+        zero-copy and the coordinator keeps exactly one round in
+        flight, so the previous round's dispatch can still be reading
+        buffer A while this round fills buffer B."""
+        B = len(mats)
+        bucket = 1 << max(0, (B - 1).bit_length())
+        key = (name, bucket, mats[0].shape, mats[0].dtype.str)
+        ring = self._stage_cache.get(key)
+        if ring is None:
+            ring = [np.empty((bucket,) + mats[0].shape, mats[0].dtype),
+                    np.empty((bucket,) + mats[0].shape, mats[0].dtype),
+                    0]
+            self._stage_cache[key] = ring
+        buf = ring[ring[2]]
+        ring[2] ^= 1
+        for i, m in enumerate(mats):
+            buf[i] = m
+        return buf[:B]
+
     # ------------------------------------------------ retrace guard
     @staticmethod
     def compile_count() -> int:
@@ -1260,7 +1553,8 @@ class ResidentSolver:
         Returns -1 when the probe is unavailable (jax version without
         _cache_size)."""
         total = 0
-        for fn in (_stream_kernel, _parallel_kernel):
+        for fn in (_stream_kernel, _parallel_kernel,
+                   _lane_stream_kernel):
             try:
                 total += fn._cache_size()
             except (AttributeError, TypeError):

@@ -985,11 +985,18 @@ class PlanSolverView:
         return self._inner.solve(*args, **kw)
 
 
-def solve_trace_attrs(pb: PackedBatch, res) -> Dict:
+def solve_trace_attrs(pb: PackedBatch, res,
+                      lane_counters: Optional[Dict] = None) -> Dict:
     """Flight-recorder attributes for one kernel run: the device wave/
     rescore/evict counters from the SolveResult plus the ISSUE-4
     two-tier modeled HBM bytes for this solve shape.  Pure read — the
-    result arrays were fetched by the caller's unpack anyway."""
+    result arrays were fetched by the caller's unpack anyway.
+
+    `lane_counters` (ISSUE 20): when the solve ran through the chunked
+    scan-of-vmap stream (ResidentSolver.lane_counters()), the lane
+    width and the cross-lane revalidation's bounce accounting join the
+    trace — the explainability surface the bit-identity property test
+    pins at L=1."""
     import numpy as _np
     waves = int(_np.asarray(res.n_waves))
     rescore = (int(_np.asarray(res.n_rescore))
@@ -1004,6 +1011,13 @@ def solve_trace_attrs(pb: PackedBatch, res) -> Dict:
              "shortlist_waves": waves - rescore,
              "evict_commits": evicted,
              "unfinished": int(_np.asarray(res.unfinished).sum())}
+    if lane_counters is not None:
+        attrs["lanes"] = int(lane_counters.get("lanes", 1))
+        attrs["lane_chunks"] = int(lane_counters.get("chunks", 0))
+        attrs["lane_bounced"] = int(lane_counters.get("bounced", 0))
+        attrs["lane_committed"] = int(lane_counters.get("committed", 0))
+        attrs["lane_bounce_rate"] = float(
+            lane_counters.get("bounce_rate", 0.0))
     try:
         # modeled bytes mirror ResidentSolver.wave_traffic's resolution
         # (best effort: a model failure must never fail a solve)
@@ -1081,9 +1095,14 @@ def _run_kernel(pb: PackedBatch, host_mode: str = "auto",
         inj = global_injections.get("device_solve")
         if inj is not None:
             inj.fire()
+        # lane_axis stays None on the one-shot path: the lane-uniform
+        # predicate form (psum over the vmap axis) only exists inside
+        # the chunked scan-of-vmap stream — a one-shot solve under a
+        # lane axis would trade its carried-window cond for a
+        # collective for no reason (ISSUE 20)
         res = solve_kernel(*_kernel_args(pb), has_spread=has_spread,
                            pallas_mode=pallas, max_waves=max_waves,
-                           **ev_kw)
+                           lane_axis=None, **ev_kw)
         # materialize under the watchdog deadline: an async dispatch
         # that only wedges at a later fetch would escape it
         if materialize or global_watchdog.enabled:

@@ -166,6 +166,26 @@ FIX_JIT = """
         return carry[0]               # rebound carry: fine
 
 
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def lane_scan_kernel(used, dev_used, stacked):
+        return used + 1, dev_used + 1, stacked.sum()
+
+
+    class LaneCarry:
+        # the ISSUE-20 scan-of-vmap carry shape: the lane kernel
+        # returns the donated usage carry as the LEADING elements of a
+        # flat result tuple, rebound in one tuple-target assign
+        def good_lane_solve(self, stacked):
+            (self._used, self._dev_used, out) = lane_scan_kernel(
+                self._used, self._dev_used, stacked)
+            return out, self._used.sum()    # rebound via tuple: fine
+
+        def bad_lane_solve(self, stacked):
+            (used2, dev2, out) = lane_scan_kernel(
+                self._used, self._dev_used, stacked)
+            return out + self._used.sum()                  # JIT204
+
+
     class EvPlanes:
         # the ISSUE-7 eviction-plane carry pattern: node planes held in
         # a dict attribute, donated through a local alias
@@ -953,7 +973,21 @@ def test_jit_donated_read_detected_rebind_twin_quiet(fixture_report):
     keys = _keys(fixture_report, "JIT204")
     assert "JIT204:fixpkg.jitmod:bad_caller:arr" in keys
     assert "JIT204:fixpkg.jitmod:bad_carry_reader:carry" in keys
-    assert len(keys) == 3       # + the aliased eviction-plane carry
+    # + the aliased eviction-plane carry + the unbound lane carry
+    # (both donated usage planes of the lane twin fire)
+    assert len(keys) == 5
+
+
+def test_jit_donated_lane_carry_tuple_rebind_quiet(fixture_report):
+    """ISSUE 20: the scan-of-vmap carry rebind — BOTH donated usage
+    buffers rebound by one tuple-target assign from the lane kernel's
+    flat result tuple — must stay quiet; the twin that binds the
+    results to fresh names while the donated attributes are read
+    again fires."""
+    keys = _keys(fixture_report, "JIT204")
+    assert not any(".good_lane_solve:" in k for k in keys)
+    assert "JIT204:fixpkg.jitmod:LaneCarry.bad_lane_solve:self._used" \
+        in keys
 
 
 def test_jit_donated_alias_carry_detected_twin_quiet(fixture_report):
